@@ -1,0 +1,60 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability set of Horovod (reference v0.21.3).
+
+Drop-in-style API::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    ...
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+
+Compiled collectives lower to ``jax.lax`` over a named mesh axis inside
+``jit``/``shard_map``; eager collectives run across processes (native TCP
+controller, multi-process JAX, or trivially for a single process).
+"""
+
+from .version import __version__
+
+from .core.basics import (
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, process_rank, process_count, mesh,
+    is_homogeneous, mpi_threads_supported,
+)
+from .core.exceptions import (
+    HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
+    NotInitializedError, DuplicateNameError,
+)
+from .ops.collective import (
+    Average, Sum, Adasum, Min, Max, Product,
+    allreduce, grouped_allreduce, allgather, broadcast, alltoall,
+    reducescatter, join, barrier,
+    allreduce_async, allgather_async, broadcast_async, alltoall_async,
+    poll, synchronize,
+)
+from .ops.compression import Compression
+from .optimizers import (
+    DistributedOptimizer, allreduce_gradients, grad, value_and_grad,
+    broadcast_parameters, broadcast_optimizer_state,
+    broadcast_object, allgather_object,
+)
+from .parallel import mesh as mesh_lib
+from . import elastic
+
+__all__ = [
+    "__version__",
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "process_rank",
+    "process_count", "mesh", "is_homogeneous", "mpi_threads_supported",
+    "HorovodTpuError", "HorovodInternalError", "HostsUpdatedInterrupt",
+    "NotInitializedError", "DuplicateNameError",
+    "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
+    "reducescatter", "join", "barrier",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "alltoall_async", "poll", "synchronize",
+    "Compression",
+    "DistributedOptimizer", "allreduce_gradients", "grad", "value_and_grad",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object",
+    "mesh_lib", "elastic",
+]

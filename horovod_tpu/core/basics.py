@@ -1,0 +1,200 @@
+"""init / shutdown / topology queries.
+
+Analog of the reference's ``HorovodBasics`` ctypes layer plus the C API it
+wraps (horovod/common/basics.py:22-75 → operations.cc:703-915).  TPU-native
+differences:
+
+* There is no singleton background thread to spawn for the compiled path —
+  XLA compiles collectives into the program. ``init()`` instead (a) resolves
+  the chip/process topology, (b) builds the global device mesh, and (c)
+  optionally attaches the native eager-path controller.
+* Topology resolution honors the launcher env contract first
+  (HOROVOD_RANK/SIZE/LOCAL_RANK/... — reference gloo_run.py:64-75) and falls
+  back to JAX's own multi-controller topology.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import state as _state
+from .config import Config
+from .exceptions import NotInitializedError
+from .state import global_state, _env_int
+from ..utils import logging as log
+
+
+def init(mesh=None,
+         axes: Optional[Sequence[str]] = None,
+         comm=None,
+         use_controller: Optional[bool] = None) -> None:
+    """Initialize the runtime.
+
+    Args:
+      mesh: optional pre-built ``jax.sharding.Mesh``. When None a 1-D mesh
+        named ``("data",)`` over all global devices is created (ICI-ordered via
+        ``mesh_utils.create_device_mesh``).
+      axes: when ``mesh`` is None, optional axis names for a multi-dim mesh
+        parsed from HVD_TPU_MESH_AXES (e.g. "data:8,model:4").
+      comm: ignored; accepted for API compatibility with ``hvd.init(comm)``.
+      use_controller: force-enable/disable the native eager-path controller.
+        Default: enabled iff the launcher exported a rendezvous address.
+    """
+    del comm
+    if global_state.initialized:
+        return
+
+    import jax
+
+    global_state.config = Config.from_env()
+
+    # --- topology ---------------------------------------------------------
+    global_state.process_rank = jax.process_index()
+    global_state.process_count = jax.process_count()
+    local_devices = jax.local_device_count()
+    total_devices = jax.device_count()
+
+    env_rank = _env_int("RANK")
+    env_size = _env_int("SIZE")
+    if env_rank is not None and env_size is not None:
+        # Launcher-provided chip topology (one launched process per slot).
+        global_state.rank = env_rank
+        global_state.size = env_size
+        global_state.local_rank = _env_int("LOCAL_RANK") or 0
+        global_state.local_size = _env_int("LOCAL_SIZE") or 1
+        global_state.cross_rank = _env_int("CROSS_RANK") or 0
+        global_state.cross_size = _env_int("CROSS_SIZE") or 1
+    else:
+        # Derive from JAX: rank = chip-rank of this process's first device.
+        global_state.rank = global_state.process_rank * local_devices
+        global_state.size = total_devices
+        global_state.local_rank = 0
+        global_state.local_size = local_devices
+        global_state.cross_rank = global_state.process_rank
+        global_state.cross_size = global_state.process_count
+
+    # --- mesh -------------------------------------------------------------
+    if mesh is not None:
+        global_state.mesh = mesh
+    else:
+        global_state.mesh = _build_default_mesh(axes)
+
+    # --- eager-path controller -------------------------------------------
+    if use_controller is None:
+        import os
+        use_controller = bool(
+            os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))
+    if use_controller:
+        from ..native import runtime as native_runtime
+        global_state.controller = native_runtime.attach()
+
+    global_state.elastic_enabled = global_state.config.elastic
+    global_state.initialized = True
+    log.debug(
+        "initialized: rank=%d size=%d local=%d/%d cross=%d/%d mesh=%s",
+        global_state.rank, global_state.size, global_state.local_rank,
+        global_state.local_size, global_state.cross_rank,
+        global_state.cross_size, global_state.mesh)
+
+
+def _build_default_mesh(axes: Optional[Sequence[str]] = None):
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+
+    spec = global_state.config.mesh_axes
+    if axes is None and spec:
+        # "data:8,model:4" → axes=("data","model"), shape=(8,4)
+        names, dims = [], []
+        for part in spec.split(","):
+            name, _, dim = part.partition(":")
+            names.append(name.strip())
+            dims.append(int(dim))
+        devices = mesh_utils.create_device_mesh(tuple(dims))
+        return jax.sharding.Mesh(devices, tuple(names))
+    n = jax.device_count()
+    try:
+        devices = mesh_utils.create_device_mesh((n,))
+    except Exception:
+        devices = np.array(jax.devices())
+    return jax.sharding.Mesh(devices, (_state.DATA_AXIS,))
+
+
+def shutdown() -> None:
+    """Tear down the runtime (reference: horovod_shutdown, operations.cc)."""
+    if global_state.controller is not None:
+        try:
+            global_state.controller.shutdown()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+    global_state.reset()
+
+
+def is_initialized() -> bool:
+    return global_state.initialized
+
+
+def _check_init():
+    if not global_state.initialized:
+        raise NotInitializedError()
+
+
+def rank() -> int:
+    """Global (chip-level) rank of this process's first device."""
+    _check_init()
+    return global_state.rank
+
+
+def size() -> int:
+    """Total number of chips across all processes."""
+    _check_init()
+    return global_state.size
+
+
+def local_rank() -> int:
+    _check_init()
+    return global_state.local_rank
+
+
+def local_size() -> int:
+    _check_init()
+    return global_state.local_size
+
+
+def cross_rank() -> int:
+    """Rank among hosts (one per node) — reference common.h:119-123."""
+    _check_init()
+    return global_state.cross_rank
+
+
+def cross_size() -> int:
+    _check_init()
+    return global_state.cross_size
+
+
+def process_rank() -> int:
+    _check_init()
+    return global_state.process_rank
+
+
+def process_count() -> int:
+    _check_init()
+    return global_state.process_count
+
+
+def mesh():
+    """The global device mesh created by init()."""
+    _check_init()
+    return global_state.mesh
+
+
+def is_homogeneous() -> bool:
+    """True when every node has the same number of chips."""
+    _check_init()
+    return global_state.size % max(global_state.cross_size, 1) == 0
+
+
+def mpi_threads_supported() -> bool:
+    """API-compat shim; there is no MPI in the TPU runtime."""
+    return False

@@ -1,0 +1,119 @@
+"""Environment-variable configuration knobs.
+
+The reference concentrates all runtime tunables in ``HOROVOD_*`` env vars
+(common.h:66-96, parsed in operations.cc:395-540 and utils/env_parser.cc).
+We accept both the original ``HOROVOD_*`` names (drop-in compatibility) and
+``HVD_TPU_*`` overrides; the TPU-specific name wins when both are set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Knob names (reference: common.h:66-96).
+FUSION_THRESHOLD = "FUSION_THRESHOLD"          # bytes
+CYCLE_TIME = "CYCLE_TIME"                      # ms, background loop cadence
+CACHE_CAPACITY = "CACHE_CAPACITY"              # response-cache entries
+TIMELINE = "TIMELINE"                          # filename
+TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
+AUTOTUNE = "AUTOTUNE"
+AUTOTUNE_LOG = "AUTOTUNE_LOG"
+LOG_LEVEL = "LOG_LEVEL"
+LOG_HIDE_TIME = "LOG_HIDE_TIME"
+STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
+STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
+HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
+BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
+ELASTIC = "ELASTIC"
+MESH_AXES = "MESH_AXES"                        # TPU-only: mesh axis spec
+
+_PREFIXES = ("HVD_TPU_", "HOROVOD_")
+
+
+def get_env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read a knob, preferring HVD_TPU_* over HOROVOD_*."""
+    for prefix in _PREFIXES:
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    val = get_env(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name: str, default: int) -> int:
+    val = get_env(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    val = get_env(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Parsed runtime configuration.
+
+    Defaults mirror the reference: 64 MB fusion buffer unless autotuning
+    (operations.cc:448 sets 128 MB when tuning), 1 ms cycle time, response
+    cache capacity 1024, stall warning at 60 s.
+    """
+
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    elastic: bool = False
+    mesh_axes: str = ""
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        cfg.fusion_threshold_bytes = get_int(
+            FUSION_THRESHOLD, cfg.fusion_threshold_bytes)
+        cfg.cycle_time_ms = get_float(CYCLE_TIME, cfg.cycle_time_ms)
+        cfg.cache_capacity = get_int(CACHE_CAPACITY, cfg.cache_capacity)
+        cfg.timeline_filename = get_env(TIMELINE, "") or ""
+        cfg.timeline_mark_cycles = get_bool(TIMELINE_MARK_CYCLES)
+        cfg.autotune = get_bool(AUTOTUNE)
+        cfg.autotune_log = get_env(AUTOTUNE_LOG, "") or ""
+        cfg.stall_check_disable = get_bool(STALL_CHECK_DISABLE)
+        cfg.stall_warning_time_seconds = get_float(
+            STALL_CHECK_TIME_SECONDS, cfg.stall_warning_time_seconds)
+        cfg.stall_shutdown_time_seconds = get_float(
+            STALL_SHUTDOWN_TIME_SECONDS, cfg.stall_shutdown_time_seconds)
+        cfg.hierarchical_allreduce = get_bool(HIERARCHICAL_ALLREDUCE)
+        cfg.hierarchical_allgather = get_bool(HIERARCHICAL_ALLGATHER)
+        cfg.elastic = get_bool(ELASTIC)
+        cfg.mesh_axes = get_env(MESH_AXES, "") or ""
+        if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
+            cfg.fusion_threshold_bytes = 128 * 1024 * 1024
+        return cfg

@@ -1,0 +1,51 @@
+"""Framework exceptions.
+
+Capability parity with the reference's ``horovod/common/exceptions.py:18-32``:
+``HorovodInternalError`` aborts the current training iteration and triggers an
+elastic restore; ``HostsUpdatedInterrupt`` re-runs rendezvous without restoring
+state (the host set changed but no worker failed).
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error requiring a reset of the collective runtime.
+
+    Raised when a collective fails mid-flight (peer died, slice became
+    unhealthy).  Under ``horovod_tpu.elastic.run`` this triggers
+    ``state.restore()`` followed by re-rendezvous.
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """The set of hosts changed; re-rendezvous without restoring state.
+
+    ``skip_sync`` mirrors the reference: when True the rejoining workers do
+    not need a state broadcast because no state was lost.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API that requires ``init()`` was called before initialization."""
+
+    def __init__(self, what: str = "operation"):
+        super().__init__(
+            f"{what} called before horovod_tpu.init(); call init() first")
+
+
+class DuplicateNameError(HorovodTpuError):
+    """Two in-flight eager collectives used the same tensor name.
+
+    Mirrors the reference's DUPLICATE_NAME_ERROR (common.h:169).
+    """
+
+
+class WorkersAvailableException(HorovodTpuError):
+    """Elastic driver: new workers are available for rendezvous."""

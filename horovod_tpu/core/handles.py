@@ -1,0 +1,76 @@
+"""Async handle management for the eager path.
+
+Analog of the reference's Torch ``HandleManager`` (torch/handle_manager.cc:60,
+torch/mpi_ops.py:843-882): ``*_async`` ops return an integer handle;
+``poll(handle)`` checks completion; ``synchronize(handle)`` blocks and returns
+the result.  On TPU the eager dispatch is already asynchronous (JAX dispatches
+to the device and returns futures), so a handle wraps either a dispatched
+``jax.Array`` or a native-controller request.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class Handle:
+    __slots__ = ("_result", "_error", "_done", "_poll_fn", "_wait_fn")
+
+    def __init__(self,
+                 result: Any = None,
+                 poll_fn: Optional[Callable[[], bool]] = None,
+                 wait_fn: Optional[Callable[[], Any]] = None):
+        self._result = result
+        self._error: Optional[BaseException] = None
+        self._done = poll_fn is None
+        self._poll_fn = poll_fn
+        self._wait_fn = wait_fn
+
+    def poll(self) -> bool:
+        if self._done:
+            return True
+        if self._poll_fn is not None and self._poll_fn():
+            self._done = True
+        return self._done
+
+    def wait(self) -> Any:
+        if not self._done and self._wait_fn is not None:
+            self._result = self._wait_fn()
+            self._done = True
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class HandleManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles: Dict[int, Handle] = {}
+
+    def allocate(self, handle: Handle) -> int:
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            self._handles[hid] = handle
+            return hid
+
+    def get(self, hid: int) -> Handle:
+        with self._lock:
+            if hid not in self._handles:
+                raise ValueError(f"unknown handle {hid}")
+            return self._handles[hid]
+
+    def poll(self, hid: int) -> bool:
+        return self.get(hid).poll()
+
+    def synchronize(self, hid: int) -> Any:
+        handle = self.get(hid)
+        result = handle.wait()
+        with self._lock:
+            self._handles.pop(hid, None)
+        return result
+
+
+handle_manager = HandleManager()

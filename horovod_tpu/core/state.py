@@ -1,0 +1,72 @@
+"""Global runtime state.
+
+Analog of the reference's ``HorovodGlobalState`` (global_state.h:43-132), but
+TPU-native: instead of a background-thread handle plus NCCL stream tables, the
+state owns the global ``jax.sharding.Mesh``, the process-level topology
+(rank/size/local/cross, reference common.h:119-123), the parsed ``Config`` and
+— once the native runtime is attached — the controller handle for the eager
+path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+from .config import Config
+
+# Default mesh axis name for data parallelism. Compiled collectives default to
+# reducing over this axis when no axis_name is given.
+DATA_AXIS = "data"
+
+
+@dataclasses.dataclass
+class GlobalState:
+    initialized: bool = False
+    config: Config = dataclasses.field(default_factory=Config)
+
+    # Chip-level topology (Horovod rank semantics: one rank per accelerator).
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    # Process-level topology (JAX multi-controller).
+    process_rank: int = 0
+    process_count: int = 1
+
+    # The global device mesh. 1-D over DATA_AXIS unless the user passed one.
+    mesh: Optional[Any] = None
+
+    # Native eager-path runtime (attached lazily; None in pure-compiled mode).
+    controller: Optional[Any] = None
+
+    # Elastic bookkeeping.
+    elastic_enabled: bool = False
+
+    def reset(self) -> None:
+        self.initialized = False
+        self.mesh = None
+        self.controller = None
+
+
+global_state = GlobalState()
+
+
+def _env_int(name: str) -> Optional[int]:
+    """Read a launcher-provided env int; both HOROVOD_ and HVD_TPU_ accepted.
+
+    The launcher→worker contract is pure environment variables, mirroring the
+    reference (gloo_run.py:64-75 exports HOROVOD_RANK/SIZE/LOCAL_RANK/...).
+    """
+    for prefix in ("HVD_TPU_", "HOROVOD_"):
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            try:
+                return int(val)
+            except ValueError:
+                return None
+    return None

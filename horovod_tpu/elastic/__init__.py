@@ -1,0 +1,4 @@
+"""Elastic training: state objects with commit/restore/sync and the retry
+loop (reference horovod/common/elastic.py:26-175)."""
+
+from .state import State, ObjectState, TpuState, run

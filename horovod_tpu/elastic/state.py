@@ -1,0 +1,238 @@
+"""Elastic state objects and the `run` retry loop.
+
+Capability parity with the reference (horovod/common/elastic.py:26-175):
+
+* ``State.commit()`` — snapshot to host memory + check for host updates.
+* ``State.restore()`` — roll back to the last committed snapshot after a
+  ``HorovodInternalError``.
+* ``State.sync()`` — broadcast state from rank 0 to (re)joining workers.
+* ``run(train_fn)`` — wraps a training function so collective failures
+  restore state and re-rendezvous, and host-set changes re-rendezvous
+  without restore (HostsUpdatedInterrupt, skip_sync honored).
+
+TPU-native reset: instead of the reference's cheap ``shutdown(); init()``
+(tensorflow/elastic.py:64-66), the TPU backend re-creates the mesh (and, when
+the world changed, re-initializes the distributed runtime) — see ``_reset``.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import queue
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..core.state import global_state
+from ..utils import logging as log
+
+
+class State:
+    """Base elastic state with commit/restore/sync and host-update checks."""
+
+    def __init__(self, **kwargs):
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages = queue.Queue()
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt if the host set changed since the last
+        commit (reference common/elastic.py:60-96)."""
+        updated = False
+        skip_sync = True
+        while not self._host_messages.empty():
+            timestamp, update_res = self._host_messages.get()
+            if timestamp > self._last_updated_timestamp:
+                self._last_updated_timestamp = timestamp
+                updated = True
+                # update_res True means only additions (no state lost).
+                skip_sync = skip_sync and bool(update_res)
+        if updated:
+            raise HostsUpdatedInterrupt(skip_sync=skip_sync)
+
+    # Subclass interface ---------------------------------------------------
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """Elastic state backed by arbitrary picklable attributes (reference
+    common/elastic.py ObjectState): everything passed as kwargs is
+    committed/restored/synced by value."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None, **kwargs):
+        if bcast_object is None:
+            from ..optimizers import broadcast_object
+            bcast_object = broadcast_object
+        self._bcast_object = bcast_object
+        self._saved_state = dict(kwargs)
+        super().__init__(**kwargs)
+
+    def save(self):
+        new_state = {}
+        for k in self._saved_state:
+            new_state[k] = copy.deepcopy(getattr(self, k))
+        self._saved_state = new_state
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            self._saved_state = synced
+            self.restore()
+
+
+class TpuState(ObjectState):
+    """Elastic state for JAX training: params/opt_state pytrees snapshotted
+    to host memory on commit, broadcast from rank 0 on sync (the analog of
+    TorchState handlers, torch/elastic/state.py:27-80)."""
+
+    def __init__(self, params=None, opt_state=None, **kwargs):
+        self._tree_keys = []
+        if params is not None:
+            self._tree_keys.append("params")
+            kwargs["params"] = params
+        if opt_state is not None:
+            self._tree_keys.append("opt_state")
+            kwargs["opt_state"] = opt_state
+        super().__init__(**kwargs)
+
+    def save(self):
+        # Device→host snapshot so a TPU reset cannot lose it.
+        for k in self._tree_keys:
+            setattr(self, "_host_" + k, jax.tree_util.tree_map(
+                lambda x: np.asarray(x), getattr(self, k)))
+        super().save()
+
+    def restore(self):
+        super().restore()
+        for k in self._tree_keys:
+            host = getattr(self, "_host_" + k, None)
+            if host is not None:
+                setattr(self, k, jax.tree_util.tree_map(
+                    lambda x: jax.numpy.asarray(x), host))
+
+    def sync(self):
+        from ..optimizers import broadcast_parameters
+        for k in self._tree_keys:
+            setattr(self, k, broadcast_parameters(getattr(self, k),
+                                                  root_rank=0))
+        # Sync the plain-object part too.
+        object_keys = [k for k in self._saved_state
+                       if k not in self._tree_keys]
+        if object_keys:
+            from ..optimizers import broadcast_object
+            synced = broadcast_object(
+                {k: getattr(self, k) for k in object_keys}, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+
+
+def _reset():
+    """TPU-native world reset: tear down and re-init the runtime so a new
+    rendezvous round can change the world size (reference
+    tensorflow/elastic.py:64-66 does shutdown()+init())."""
+    from ..core import basics
+    basics.shutdown()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator running ``func(state, ...)`` under the elastic retry loop
+    (reference common/elastic.py:151-175)."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    log.warning("collective failure; restoring last "
+                                "committed state and re-initializing")
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    log.info("host set updated; re-initializing")
+                    skip_sync = e.skip_sync
+                _reset()
+                state.on_reset()
+        finally:
+            notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+class WorkerNotificationManager:
+    """Receives host-update notifications from the elastic driver and fans
+    them out to registered State objects (reference
+    runner/elastic/worker.py)."""
+
+    def __init__(self):
+        self._listeners = []
+        self._service = None
+
+    def init(self):
+        if self._service is not None:
+            return
+        import os
+        addr = os.environ.get("HVD_TPU_NOTIFY_ADDR") or \
+            os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        port = os.environ.get("HVD_TPU_NOTIFY_PORT")
+        if not addr or not port:
+            return  # not running under the elastic launcher
+        from ..runner.notification import WorkerNotificationService
+        self._service = WorkerNotificationService(self)
+        self._service.start()
+
+    def register_listener(self, state: State):
+        self._listeners.append(state)
+
+    def remove_listener(self, state: State):
+        if state in self._listeners:
+            self._listeners.remove(state)
+
+    def handle_hosts_updated(self, timestamp, update_res):
+        for listener in self._listeners:
+            listener.on_hosts_updated(timestamp, update_res)
+
+
+notification_manager = WorkerNotificationManager()

@@ -1,0 +1,25 @@
+"""Bindings to the native (C++) eager-path runtime.
+
+The reference's core runtime is C++: background thread, TensorQueue,
+controller protocol, fusion buffer, response cache (horovod/common/*.cc).
+Our native runtime lives in ``horovod_tpu/native/src`` and is loaded via
+ctypes (the reference uses ctypes for its basics layer too,
+common/basics.py:22-75).  Until the shared library is built/attached this
+module exposes ``attach()`` returning None so the pure-JAX paths keep
+working.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def attach() -> Optional[object]:
+    """Attach the native controller if the shared library is available."""
+    try:
+        from . import controller
+        return controller.NativeController.from_env()
+    except Exception:
+        from ..utils import logging as log
+        log.debug("native runtime unavailable; eager path uses JAX regime")
+        return None

@@ -1,0 +1,286 @@
+"""Public collective ops: allreduce / allgather / broadcast / alltoall /
+reducescatter / join / barrier — with compiled and eager paths.
+
+The reference exposes seven ``EnqueueTensor*`` entry points feeding a
+background negotiation loop (operations.cc:919-1226).  TPU-native, each op is
+**two-mode** (the plan in SURVEY.md §7.3, mirroring the reference's TF
+graph/eager split at tensorflow/__init__.py:400-403):
+
+* **Compiled path** — called on tracers inside ``jit``/``shard_map``: lowers
+  directly to ``jax.lax`` collectives over a named mesh axis.  XLA schedules,
+  fuses and overlaps them on ICI; no controller, no fusion buffer — the
+  compiler owns what Horovod's background thread did at runtime.
+* **Eager path** — called on concrete arrays: dispatches through
+  ``ops.eager`` (native controller / multi-process JAX / single-process).
+
+Reduce-op codes match the reference C API (operations.cc:911-913).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import handles as _handles
+from ..core.state import global_state, DATA_AXIS
+from . import eager as _eager
+from .adasum import adasum_allreduce, adasum_tree
+
+
+class ReduceOp(int):
+    pass
+
+
+# Reference reduce-op codes: horovod_reduce_op_average/sum/adasum
+# (operations.cc:905-915); Min/Max/Product are post-0.21 additions kept for
+# forward compatibility.
+Average = ReduceOp(0)
+Sum = ReduceOp(1)
+Adasum = ReduceOp(2)
+Min = ReduceOp(3)
+Max = ReduceOp(4)
+Product = ReduceOp(5)
+
+
+def _is_tracer(tensor) -> bool:
+    try:
+        import jax
+        return isinstance(tensor, jax.core.Tracer)
+    except Exception:
+        return False
+
+
+def _default_axis(axis_name: Optional[str]) -> str:
+    if axis_name is not None:
+        return axis_name
+    return DATA_AXIS
+
+
+def _axis_size(axis_name: str) -> int:
+    from jax import lax
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def _compiled_allreduce(tensor, op: int, axis_name: str,
+                        prescale_factor: float, postscale_factor: float):
+    import jax.numpy as jnp
+    from jax import lax
+
+    if prescale_factor != 1.0:
+        tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
+    if op == Sum:
+        out = lax.psum(tensor, axis_name)
+    elif op == Average:
+        out = lax.pmean(tensor, axis_name)
+    elif op == Min:
+        out = lax.pmin(tensor, axis_name)
+    elif op == Max:
+        out = lax.pmax(tensor, axis_name)
+    elif op == Product:
+        out = jnp.prod(lax.all_gather(tensor, axis_name), axis=0)
+    elif op == Adasum:
+        out = adasum_allreduce(tensor, axis_name)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
+    """Build a stack-reducer callable((P, ...)) -> (...) for the eager path."""
+    def fn(stack):
+        import jax.numpy as jnp
+        x = stack
+        if prescale_factor != 1.0:
+            x = x * jnp.asarray(prescale_factor, dtype=stack.dtype)
+        if op == Sum:
+            out = x.sum(axis=0)
+        elif op == Average:
+            out = x.mean(axis=0)
+        elif op == Min:
+            out = x.min(axis=0)
+        elif op == Max:
+            out = x.max(axis=0)
+        elif op == Product:
+            out = jnp.prod(x, axis=0)
+        elif op == Adasum:
+            out = adasum_tree(x)
+        else:
+            raise ValueError(f"unknown reduce op {op}")
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+        return out
+    return fn
+
+
+def allreduce(tensor,
+              op: int = Average,
+              axis_name: Optional[str] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              name: Optional[str] = None):
+    """Allreduce a tensor across the communicator.
+
+    Inside jit/shard_map: reduces over mesh axis ``axis_name`` (default
+    "data").  Eagerly: reduces across processes.  Prescale/postscale mirror
+    the reference's fused scale kernels (nccl_operations.cc:153-172).
+    """
+    if _is_tracer(tensor):
+        return _compiled_allreduce(tensor, op, _default_axis(axis_name),
+                                   prescale_factor, postscale_factor)
+    return _eager.allreduce(
+        tensor, op_fn=_eager_op_fn(op, prescale_factor, postscale_factor),
+        name=name)
+
+
+def grouped_allreduce(tensors: Sequence,
+                      op: int = Average,
+                      axis_name: Optional[str] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      name: Optional[str] = None) -> List:
+    """Allreduce a group atomically (reference: EnqueueTensorAllreduces with a
+    shared group id, operations.cc:1041-1048; GroupTable group_table.h:30-59).
+    On the compiled path XLA fuses the group into combined collectives."""
+    return [
+        allreduce(t, op=op, axis_name=axis_name,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  name=None if name is None else f"{name}.{i}")
+        for i, t in enumerate(tensors)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, axis_name: Optional[str] = None,
+              name: Optional[str] = None):
+    """Gather tensors from all members, concatenated along dim 0.
+
+    Compiled path requires equal shapes (static under XLA); the eager path
+    supports unequal first dimensions like the reference
+    (controller.cc:576-648).
+    """
+    if _is_tracer(tensor):
+        from jax import lax
+        return lax.all_gather(tensor, _default_axis(axis_name), tiled=True)
+    return _eager.allgather(tensor, name=name)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, root_rank: int = 0, axis_name: Optional[str] = None,
+              name: Optional[str] = None):
+    """Broadcast the root member's value to all members."""
+    if _is_tracer(tensor):
+        import jax.numpy as jnp
+        from jax import lax
+        ax = _default_axis(axis_name)
+        # Masked psum: one reduction instead of a full gather; XLA lowers
+        # this to an ICI broadcast-like pattern.
+        idx = lax.axis_index(ax)
+        mask = (idx == root_rank).astype(tensor.dtype)
+        return lax.psum(tensor * mask, ax)
+    return _eager.broadcast(tensor, root_rank=root_rank, name=name)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor, splits: Optional[Sequence[int]] = None,
+             axis_name: Optional[str] = None, name: Optional[str] = None):
+    """Distribute dim-0 slices to each member; returns (received,
+    received_splits) on the eager path (reference operations.cc:1136-1198);
+    the compiled path requires equal splits (static shapes) and returns just
+    the received tensor."""
+    if _is_tracer(tensor):
+        from jax import lax
+        if splits is not None:
+            raise ValueError(
+                "compiled-path alltoall requires equal splits (splits=None); "
+                "uneven splits need the eager path")
+        return lax.all_to_all(tensor, _default_axis(axis_name),
+                              split_axis=0, concat_axis=0, tiled=True)
+    return _eager.alltoall(tensor, splits=splits, name=name)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+# ---------------------------------------------------------------------------
+
+def reducescatter(tensor, op: int = Average,
+                  axis_name: Optional[str] = None,
+                  name: Optional[str] = None):
+    """Reduce then scatter equal dim-0 chunks (rank i gets chunk i)."""
+    if _is_tracer(tensor):
+        from jax import lax
+        ax = _default_axis(axis_name)
+        out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
+        if op == Average:
+            out = out / _axis_size(ax)
+        elif op != Sum:
+            raise ValueError("compiled reducescatter supports Sum/Average")
+        return out
+    from . import eager
+    fn = _eager_op_fn(Sum if op == Sum else Average, 1.0, 1.0)
+    return eager.reducescatter(tensor, op_fn=fn, name=name)
+
+
+# ---------------------------------------------------------------------------
+# join / barrier
+# ---------------------------------------------------------------------------
+
+def join() -> int:
+    return _eager.join()
+
+
+def barrier() -> None:
+    _eager.barrier()
+
+
+# ---------------------------------------------------------------------------
+# async handle API (eager path; reference torch/mpi_ops.py:843-882)
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    result = allreduce(tensor, op=op, name=name,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return _handles.handle_manager.allocate(_handles.Handle(result=result))
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    result = allgather(tensor, name=name)
+    return _handles.handle_manager.allocate(_handles.Handle(result=result))
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    result = broadcast(tensor, root_rank=root_rank, name=name)
+    return _handles.handle_manager.allocate(_handles.Handle(result=result))
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    result = alltoall(tensor, splits=splits, name=name)
+    return _handles.handle_manager.allocate(_handles.Handle(result=result))
+
+
+def poll(handle: int) -> bool:
+    return _handles.handle_manager.poll(handle)
+
+
+def synchronize(handle: int):
+    return _handles.handle_manager.synchronize(handle)

@@ -1,0 +1,200 @@
+"""Eager (op-by-op) collectives across processes.
+
+The reference's eager contract is "any rank may enqueue named tensors in any
+order"; a background controller negotiates readiness and a data-plane backend
+(NCCL/MPI/Gloo) executes (operations.cc:919-1226, controller.cc:69-449).  On
+TPU there are three eager regimes, dispatched here in priority order:
+
+1. **Native controller attached** (launcher-run jobs): the C++ runtime
+   negotiates names across processes and executes over its TCP data plane
+   (the Gloo-analog) or hands fused HBM buffers to XLA.  This is the only
+   path with full dynamic-name negotiation semantics.
+2. **Multi-process JAX** (jax.distributed initialized): collectives are
+   expressed as a jitted global computation over a process-axis mesh —
+   the array is built from per-process shards, reduced in-graph over ICI/DCN,
+   and read back replicated.
+3. **Single process**: the communicator has one member; ops are identities
+   (sum over one contribution), matching Horovod semantics for size()==1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.state import global_state
+
+
+def _np(tensor):
+    return np.asarray(tensor)
+
+
+def _controller():
+    return global_state.controller
+
+
+def _process_mesh():
+    """A 1-D mesh with exactly one device per process, for process-level
+    eager collectives (regime 2)."""
+    import jax
+    devices = []
+    seen = set()
+    for d in jax.devices():
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            devices.append(d)
+    return jax.sharding.Mesh(np.array(devices), ("proc",))
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_process_mesh():
+    return _process_mesh()
+
+
+def _global_over_processes(x: np.ndarray):
+    """Build a (P, *x.shape) global array, shard p = process p's x."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _cached_process_mesh()
+    sharding = NamedSharding(mesh, P("proc"))
+    p = global_state.process_count
+    global_shape = (p,) + x.shape
+    local = jax.device_put(x[None], mesh.devices.flat[global_state.process_rank])
+    return jax.make_array_from_single_device_arrays(global_shape, sharding, [local])
+
+
+def _replicated_out(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def _run_global(fn, garr):
+    import jax
+    mesh = _cached_process_mesh()
+    out = jax.jit(fn, out_shardings=_replicated_out(mesh))(garr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def allreduce(tensor, op_fn, name: Optional[str] = None):
+    """op_fn: callable(stack: (P, ...) array) -> reduced array."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.allreduce(_np(tensor), op_fn=op_fn, name=name)
+    if global_state.process_count == 1:
+        x = _np(tensor)
+        return op_fn(x[None])
+    garr = _global_over_processes(_np(tensor))
+    return _run_global(op_fn, garr)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate along dim 0 across processes (unequal dim-0 allowed)."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.allgather(_np(tensor), name=name)
+    if global_state.process_count == 1:
+        return _np(tensor)
+    # Unequal first dims need a size exchange first; gather sizes, then pad,
+    # gather payloads, and slice (reference: controller.cc:576-648 does the
+    # same displacement math on the coordinator).
+    x = _np(tensor)
+    sizes = allreduce(
+        _one_hot_sizes(x.shape[0]), op_fn=lambda s: s.sum(0))
+    max_rows = int(sizes.max())
+    padded = np.zeros((max_rows,) + x.shape[1:], dtype=x.dtype)
+    padded[: x.shape[0]] = x
+    garr = _global_over_processes(padded)
+    gathered = _run_global(lambda a: a, garr)  # (P, max_rows, ...)
+    parts = [gathered[p, : int(sizes[p])] for p in range(len(sizes))]
+    return np.concatenate(parts, axis=0)
+
+
+def _one_hot_sizes(rows: int) -> np.ndarray:
+    sizes = np.zeros((global_state.process_count,), dtype=np.int64)
+    sizes[global_state.process_rank] = rows
+    return sizes
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.broadcast(_np(tensor), root_rank=root_rank, name=name)
+    if global_state.process_count == 1:
+        return _np(tensor)
+    garr = _global_over_processes(_np(tensor))
+    return _run_global(lambda a: a[root_rank], garr)
+
+
+def alltoall(tensor, splits: Optional[Sequence[int]] = None,
+             name: Optional[str] = None):
+    """Split dim 0 by ``splits`` (default: equal), piece i to process i;
+    returns (received, received_splits) like the reference
+    (operations.cc:1136-1198)."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.alltoall(_np(tensor), splits=splits, name=name)
+    x = _np(tensor)
+    p = global_state.process_count
+    if splits is None:
+        if x.shape[0] % p != 0:
+            raise ValueError(
+                f"alltoall dim0 {x.shape[0]} not divisible by size {p}")
+        splits = [x.shape[0] // p] * p
+    splits = list(splits)
+    if p == 1:
+        return x[: splits[0]], np.array(splits, dtype=np.int32)
+    # Exchange split tables, then route each segment via a padded gather.
+    split_table = allgather(np.array([splits], dtype=np.int64))  # (P, P)
+    offsets = np.concatenate([[0], np.cumsum(splits)]).astype(np.int64)
+    max_seg = int(split_table.max())
+    segs = np.zeros((p, max_seg) + x.shape[1:], dtype=x.dtype)
+    for dest in range(p):
+        seg = x[offsets[dest]: offsets[dest + 1]]
+        segs[dest, : seg.shape[0]] = seg
+    garr = _global_over_processes(segs)  # (P_src, P_dest, max_seg, ...)
+    me = global_state.process_rank
+    all_segs = _run_global(lambda a: a[:, me], garr)  # (P_src, max_seg, ...)
+    recv_splits = split_table[:, me]
+    parts = [all_segs[src, : int(recv_splits[src])] for src in range(p)]
+    return (np.concatenate(parts, axis=0),
+            recv_splits.astype(np.int32))
+
+
+def reducescatter(tensor, op_fn, name: Optional[str] = None):
+    """Reduce across processes then scatter equal dim-0 chunks."""
+    reduced = allreduce(tensor, op_fn=op_fn, name=name)
+    p = global_state.process_count
+    rows = reduced.shape[0]
+    if rows % p != 0:
+        raise ValueError(f"reducescatter dim0 {rows} not divisible by {p}")
+    chunk = rows // p
+    me = global_state.process_rank
+    return reduced[me * chunk: (me + 1) * chunk]
+
+
+def barrier() -> None:
+    ctl = _controller()
+    if ctl is not None:
+        ctl.barrier()
+        return
+    if global_state.process_count == 1:
+        return
+    allreduce(np.zeros((1,), dtype=np.float32), op_fn=lambda s: s.sum(0))
+
+
+def join() -> int:
+    """Signal this rank has no more data; returns last joined rank.
+
+    Reference: the Join op lets ranks with uneven data exit allreduce
+    gracefully with zero-filled proxies (operations.cc:1202-1226).  In the
+    eager regimes without a controller there is nothing pending to proxy, so
+    join degenerates to a barrier returning the highest rank.
+    """
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.join()
+    barrier()
+    return global_state.process_count - 1

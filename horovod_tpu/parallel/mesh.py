@@ -1,0 +1,80 @@
+"""Device-mesh construction and topology helpers.
+
+The reference's communicator axes are GLOBAL / LOCAL (per-node) / CROSS
+(one-per-node) built via MPI_COMM_TYPE_SHARED splits (mpi_context.cc:140-156).
+The TPU-native equivalent is a ``jax.sharding.Mesh`` whose axes map onto the
+physical interconnect: intra-slice axes ride ICI, the inter-slice axis rides
+DCN.  ``mesh_utils.create_device_mesh`` gives ICI-topology-aware device
+ordering; ``create_hybrid_device_mesh`` keeps the DCN axis outermost.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Canonical axis names used across the framework.
+DATA = "data"       # data parallel (allreduce axis)
+FSDP = "fsdp"       # sharded data parallel (zero-style weight sharding)
+TENSOR = "model"    # tensor/model parallel (megatron-style)
+SEQUENCE = "seq"    # sequence/context parallel (ring attention / ulysses)
+PIPELINE = "pipe"   # pipeline parallel
+EXPERT = "expert"   # expert parallel (MoE alltoall)
+
+
+def create_mesh(shape: Dict[str, int], devices=None, allow_split_physical_axes: bool = True):
+    """Create a Mesh from {axis_name: size}. Product must equal device count.
+
+    Axis order in ``shape`` is the logical-to-physical assignment order:
+    earlier axes change slowest, so put DCN-spanning axes (usually ``data``)
+    first and the most communication-intense axes (``model``/``seq``) last —
+    they land on adjacent ICI neighbors.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+
+    names = tuple(shape.keys())
+    dims = tuple(int(v) for v in shape.values())
+    if devices is None:
+        n = jax.device_count()
+    else:
+        n = len(devices)
+    total = int(np.prod(dims))
+    if total != n:
+        raise ValueError(f"mesh shape {shape} has {total} slots but there are "
+                         f"{n} devices")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            dims, devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes)
+    except Exception:
+        base = np.array(devices if devices is not None else jax.devices())
+        dev_array = base.reshape(dims)
+    return jax.sharding.Mesh(dev_array, names)
+
+
+def data_parallel_mesh():
+    """1-D mesh over all devices, axis "data" — the Horovod-equivalent
+    communicator."""
+    import jax
+    return create_mesh({DATA: jax.device_count()})
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse "data:8,model:4" → {"data": 8, "model": 4}."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        name, _, dim = part.partition(":")
+        out[name.strip()] = int(dim)
+    return out
+
+
+def local_mesh_axes(mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def axis_size(mesh, name: str) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[name]

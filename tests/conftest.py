@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU platform so compiled
+multi-chip collectives and shardings run without TPU hardware (the strategy
+SURVEY.md §4 prescribes: a cheap real backend on localhost, like the
+reference's Gloo-on-TCP-loopback)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Some environments force a hardware platform through jax.config at
+# interpreter startup (overriding env vars), so set the config explicitly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # backend already initialized with the XLA flag; count is set
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    """Each test starts uninitialized (init() is idempotent; tests that call
+    init() get a clean shutdown afterwards)."""
+    yield
+    import horovod_tpu as hvd
+    if hvd.is_initialized():
+        hvd.shutdown()

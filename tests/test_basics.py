@@ -1,0 +1,67 @@
+"""Basics: init/shutdown/topology queries (reference test/parallel pattern:
+rank/size sanity; here single-controller over 8 virtual devices)."""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_idempotent():
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.is_initialized()
+
+
+def test_topology_single_controller():
+    hvd.init()
+    assert hvd.size() == jax.device_count() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.process_count() == 1
+
+
+def test_not_initialized_raises():
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.rank()
+
+
+def test_mesh_created():
+    hvd.init()
+    mesh = hvd.mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == 8
+
+
+def test_env_rank_override(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "16")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "4")
+    monkeypatch.setenv("HOROVOD_CROSS_RANK", "0")
+    monkeypatch.setenv("HOROVOD_CROSS_SIZE", "4")
+    hvd.init(use_controller=False)
+    assert hvd.rank() == 3
+    assert hvd.size() == 16
+    assert hvd.local_rank() == 1
+    assert hvd.local_size() == 4
+    assert hvd.cross_size() == 4
+
+
+def test_shutdown_resets():
+    hvd.init()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+
+
+def test_custom_mesh_axes(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_MESH_AXES", "data:4,model:2")
+    hvd.init()
+    mesh = hvd.mesh()
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (4, 2)

@@ -1,0 +1,199 @@
+"""DistributedOptimizer / gradient-transform front-end tests (analog of the
+reference's optimizer tests in test/parallel/test_torch.py: wrapped optimizer
+must equal the serial optimizer applied to the rank-averaged gradient)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+
+N = 8
+
+
+def _mesh():
+    hvd.init()
+    return hvd.mesh()
+
+
+def _shmap(mesh, fn, in_specs, out_specs):
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+def test_distributed_optimizer_averages_gradients():
+    mesh = _mesh()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones((N, 4))}  # sharded over data axis → (1,4) shards
+    grads = {"w": jnp.arange(1, N + 1, dtype=jnp.float32)[:, None]
+             * jnp.ones((N, 4))}
+
+    def step(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return optax.apply_updates(p, updates)
+
+    out = jax.jit(_shmap(mesh, step,
+                         in_specs=({"w": P("data")}, {"w": P("data")}),
+                         out_specs={"w": P("data")}))(params, grads)
+    avg_grad = np.mean(np.arange(1, N + 1))
+    expected = 1.0 - 0.1 * avg_grad
+    np.testing.assert_allclose(np.asarray(out["w"]), expected, rtol=1e-6)
+
+
+def test_distributed_optimizer_sum_op():
+    mesh = _mesh()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Sum)
+    params = jnp.zeros((N, 2))
+    grads = jnp.ones((N, 2))
+
+    def step(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return optax.apply_updates(p, updates)
+
+    out = jax.jit(_shmap(mesh, step, in_specs=(P("data"), P("data")),
+                         out_specs=P("data")))(params, grads)
+    np.testing.assert_allclose(np.asarray(out), -0.1 * N, rtol=1e-6)
+
+
+def test_backward_passes_per_step_accumulates():
+    mesh = _mesh()
+    bpps = 3
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                  backward_passes_per_step=bpps)
+    params = jnp.zeros((N, 2))
+
+    def run(p):
+        state = tx.init(p)
+        for i in range(bpps):
+            g = jnp.full_like(p, float(i + 1))
+            updates, state = tx.update(g, state, p)
+            p = optax.apply_updates(p, updates)
+        return p
+
+    out = jax.jit(_shmap(mesh, run, in_specs=P("data"),
+                         out_specs=P("data")))(params)
+    # Updates 1,2 are zero; update 3 applies mean(1,2,3) = 2.0 once.
+    np.testing.assert_allclose(np.asarray(out), -2.0, rtol=1e-6)
+
+
+def test_adasum_optimizer_reduces_delta():
+    mesh = _mesh()
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum)
+    params = jnp.zeros((N, 4))
+    # Identical grads on every rank → adasum(delta) == delta.
+    grads = jnp.ones((N, 4))
+
+    def step(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return optax.apply_updates(p, updates)
+
+    out = jax.jit(_shmap(mesh, step, in_specs=(P("data"), P("data")),
+                         out_specs=P("data")))(params, grads)
+    np.testing.assert_allclose(np.asarray(out), -1.0, rtol=1e-5)
+
+
+def test_compression_roundtrip_in_optimizer():
+    mesh = _mesh()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                  compression=hvd.Compression.fp16)
+    params = jnp.ones((N, 4))
+    grads = jnp.full((N, 4), 2.0)
+
+    def step(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        new_p = optax.apply_updates(p, updates)
+        assert new_p.dtype == p.dtype  # decompressed back to fp32
+        return new_p
+
+    out = jax.jit(_shmap(mesh, step, in_specs=(P("data"), P("data")),
+                         out_specs=P("data")))(params, grads)
+    np.testing.assert_allclose(np.asarray(out), 1.0 - 0.2, rtol=1e-3)
+
+
+def test_grad_transform_allreduces():
+    mesh = _mesh()
+
+    def loss(w, x):
+        return jnp.sum(w * x)
+
+    dloss = hvd.grad(loss)
+
+    def fn(w, x):
+        return dloss(w, x)
+
+    w = jnp.ones((N, 3))
+    x = jnp.arange(1, N + 1, dtype=jnp.float32)[:, None] * jnp.ones((N, 3))
+    out = jax.jit(_shmap(mesh, fn, in_specs=(P("data"), P("data")),
+                         out_specs=P("data")))(w, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.mean(np.arange(1, N + 1)), rtol=1e-6)
+
+
+def test_value_and_grad_transform():
+    mesh = _mesh()
+
+    def loss(w):
+        return jnp.sum(w ** 2)
+
+    vg = hvd.value_and_grad(loss)
+
+    def fn(w):
+        v, g = vg(w)
+        return v[None], g
+
+    w = jnp.full((N, 2), 3.0)
+    v, g = jax.jit(_shmap(mesh, fn, in_specs=P("data"),
+                          out_specs=(P("data"), P("data"))))(w)
+    np.testing.assert_allclose(np.asarray(g), 6.0, rtol=1e-6)
+
+
+def test_broadcast_parameters_compiled():
+    mesh = _mesh()
+    params = {"w": jnp.arange(1, N + 1, dtype=jnp.float32)[:, None]
+              * jnp.ones((N, 4)),
+              "b": jnp.arange(N, dtype=jnp.float32)[:, None]}
+
+    def fn(p):
+        return hvd.broadcast_parameters(p, root_rank=2)
+
+    out = jax.jit(_shmap(mesh, fn,
+                         in_specs=({"w": P("data"), "b": P("data")},),
+                         out_specs={"w": P("data"), "b": P("data")}))(params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 3.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), 2.0)
+
+
+def test_eager_single_process_collectives():
+    hvd.init()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Sum)), x)
+    np.testing.assert_allclose(np.asarray(hvd.allgather(x)), x)
+    np.testing.assert_allclose(np.asarray(hvd.broadcast(x, root_rank=0)), x)
+    out, splits = hvd.alltoall(x[:1])
+    np.testing.assert_allclose(np.asarray(out), x[:1])
+    assert list(splits) == [1]
+    assert hvd.join() == 0
+    hvd.barrier()
+
+
+def test_async_handles():
+    hvd.init()
+    x = np.ones((4,), dtype=np.float32)
+    h = hvd.allreduce_async(x, op=hvd.Sum)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), x)
+
+
+def test_broadcast_and_allgather_object():
+    hvd.init()
+    obj = {"epoch": 3, "name": "test"}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+    assert hvd.allgather_object(obj) == [obj]
