@@ -36,21 +36,18 @@ def create_mesh(shape: Dict[str, int], devices=None, allow_split_physical_axes: 
 
     names = tuple(shape.keys())
     dims = tuple(int(v) for v in shape.values())
-    if devices is None:
-        n = jax.device_count()
-    else:
-        n = len(devices)
+    pool = list(devices) if devices is not None else jax.devices()
     total = int(np.prod(dims))
-    if total != n:
+    if total > len(pool):
         raise ValueError(f"mesh shape {shape} has {total} slots but there are "
-                         f"{n} devices")
+                         f"only {len(pool)} devices")
+    pool = pool[:total]
     try:
         dev_array = mesh_utils.create_device_mesh(
-            dims, devices=devices,
+            dims, devices=pool,
             allow_split_physical_axes=allow_split_physical_axes)
     except Exception:
-        base = np.array(devices if devices is not None else jax.devices())
-        dev_array = base.reshape(dims)
+        dev_array = np.array(pool).reshape(dims)
     return jax.sharding.Mesh(dev_array, names)
 
 

@@ -1,0 +1,112 @@
+"""Expert parallelism — switch-style Mixture-of-Experts with all-to-all
+token routing over a mesh axis.
+
+The reference's only layout-shuffling primitive is alltoall with uneven
+splits (operations.cc:1136-1198, SURVEY.md §2.3 "the only primitive that
+would serve EP/SP-style layouts").  TPU-native, expert parallelism is a
+first-class layer: top-1 gating with capacity, dispatch einsum into a
+(experts, capacity, d) buffer — static shapes so XLA can tile the MXU — and
+two ``lax.all_to_all`` exchanges riding ICI.  Dropped tokens (over capacity)
+pass through on the residual path, standard Switch Transformer semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    gate: jax.Array    # (d_model, n_experts_total) — replicated
+    w_in: jax.Array    # (n_local, d_model, d_ff)   — sharded over expert axis
+    w_out: jax.Array   # (n_local, d_ff, d_model)   — sharded over expert axis
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts_total: int,
+                    n_local: int, dtype=jnp.float32) -> MoEParams:
+    k1, k2, k3 = jax.random.split(key, 3)
+    s_in = 1.0 / math.sqrt(d_model)
+    s_out = 1.0 / math.sqrt(d_ff)
+    return MoEParams(
+        gate=(jax.random.normal(k1, (d_model, n_experts_total)) * s_in
+              ).astype(dtype),
+        w_in=(jax.random.normal(k2, (n_local, d_model, d_ff)) * s_in
+              ).astype(dtype),
+        w_out=(jax.random.normal(k3, (n_local, d_ff, d_model)) * s_out
+               ).astype(dtype),
+    )
+
+
+def moe_layer(params: MoEParams, x: jax.Array, axis_name: str,
+              capacity_factor: float = 1.25,
+              activation: Callable = jax.nn.gelu) -> jax.Array:
+    """Apply an expert-parallel MoE MLP to local tokens.
+
+    Args:
+      params: local shard of the MoE parameters (n_local experts held here).
+      x: (tokens, d_model) local token activations.
+      axis_name: the expert-parallel mesh axis (size P; total experts
+        E = P * n_local).
+    Returns:
+      (tokens, d_model) combined expert outputs (zeros for dropped tokens —
+      add the residual in the caller).
+    """
+    ep = lax.axis_size(axis_name)
+    t, d = x.shape
+    n_local = params.w_in.shape[0]
+    n_experts = ep * n_local
+    capacity = max(1, int(math.ceil(t / n_experts * capacity_factor)))
+
+    # --- top-1 gating with capacity ------------------------------------
+    logits = jnp.einsum("td,de->te", x, params.gate)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                      # (T,)
+    gate_prob = jnp.take_along_axis(probs, expert_idx[:, None],
+                                    axis=-1)[:, 0]               # (T,)
+    onehot = jax.nn.one_hot(expert_idx, n_experts,
+                            dtype=jnp.float32)                   # (T, E)
+    position = jnp.einsum("te,te->te", jnp.cumsum(onehot, axis=0) - 1.0,
+                          onehot)
+    keep = (position < capacity) & (onehot > 0)                  # (T, E)
+    pos_cap = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                             dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_cap                                            # (T, E, C)
+    combine = dispatch * gate_prob[:, None, None]                 # (T, E, C)
+
+    # --- dispatch: (T,E,C) x (T,d) -> (E,C,d), exchange over experts ----
+    x_send = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    x_send = x_send.reshape(ep, n_local, capacity, d)
+    # all_to_all: dim0 indexes destination rank before, source rank after.
+    x_recv = lax.all_to_all(x_send, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)                          # (P,L,C,d)
+    tokens = x_recv.transpose(1, 0, 2, 3).reshape(
+        n_local, ep * capacity, d)                                # (L,P*C,d)
+
+    # --- expert MLPs (batched over local experts; big MXU matmuls) ------
+    h = activation(jnp.einsum("lcd,ldf->lcf", tokens,
+                              params.w_in.astype(jnp.float32)))
+    y = jnp.einsum("lcf,lfd->lcd", h, params.w_out.astype(jnp.float32))
+
+    # --- return route: reverse the exchange, combine ---------------------
+    y = y.reshape(n_local, ep, capacity, d).transpose(1, 0, 2, 3)
+    y_back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)                          # (P,L,C,d)
+    y_back = y_back.reshape(n_experts, capacity, d)
+    out = jnp.einsum("tec,ecd->td", combine, y_back)
+    return out.astype(x.dtype)
+
+
+def moe_load_balancing_loss(x: jax.Array, gate: jax.Array,
+                            n_experts: int) -> jax.Array:
+    """Switch Transformer auxiliary load-balancing loss (mean over tokens of
+    fraction-routed × mean-prob per expert, scaled by E)."""
+    logits = jnp.einsum("td,de->te", x, gate)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, n_experts), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(frac * mean_prob)

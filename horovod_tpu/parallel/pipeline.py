@@ -1,0 +1,94 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule inside shard_map.
+
+Stages are laid out along a mesh axis; activations travel stage→stage over
+``lax.ppermute`` (one ICI hop when the pipeline axis is laid out along a
+physical ring).  The whole schedule is a ``lax.scan`` over
+``n_microbatches + n_stages - 1`` ticks, so XLA sees a static loop: forward
+sends are overlapped with the next microbatch's compute, and the backward
+pass — obtained by differentiating through the scan — reverses the permutes
+automatically.
+
+The reference framework has no pipeline support (SURVEY.md §2.3); this is
+TPU-native scope.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   x_microbatches: jax.Array,
+                   axis_name: str,
+                   remat: bool = True) -> jax.Array:
+    """Run ``stage_fn`` as a pipeline over ``axis_name``.
+
+    Args:
+      stage_fn: ``(params_for_this_stage, activation) -> activation`` with
+        identical activation shapes in and out (embed/unembed live outside
+        the pipeline).
+      stage_params: this member's stage parameters (shard the full stacked
+        stage dim over the pipeline axis in the caller's in_specs).
+      x_microbatches: (n_micro, mb, ...) input; consumed by stage 0.
+      axis_name: the pipeline mesh axis.
+      remat: rematerialize each stage in the backward pass.
+
+    Returns:
+      (n_micro, mb, ...) outputs — valid on the **last** stage; other stages
+      hold zeros (reduce with a stage mask, see ``last_stage_mask``).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    # Forward chain i -> i+1; the last stage sends to 0 (its payload is
+    # ignored there — stage 0 always injects a fresh microbatch) keeping the
+    # permutation a pure ring for ICI friendliness.
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    act0 = jnp.zeros_like(x_microbatches[0])
+    out0 = jnp.zeros_like(x_microbatches)
+
+    def body(carry, t):
+        act, outbuf = carry
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x_t = lax.dynamic_index_in_dim(x_microbatches, mb_idx, axis=0,
+                                       keepdims=False)
+        a_in = jnp.where(stage == 0, x_t, act)
+        y = fn(stage_params, a_in)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        write = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        updated = lax.dynamic_update_index_in_dim(outbuf, y, out_idx, axis=0)
+        outbuf = jnp.where(write, updated, outbuf)
+        act = lax.ppermute(y, axis_name, perm)
+        return (act, outbuf), None
+
+    (_, outbuf), _ = lax.scan(body, (act0, out0), jnp.arange(ticks))
+    return outbuf
+
+
+def last_stage_mask(axis_name: str) -> jax.Array:
+    """1.0 on the last pipeline stage, 0.0 elsewhere — for masking losses
+    computed from ``pipeline_apply`` output before a psum over the axis."""
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    return (stage == n_stages - 1).astype(jnp.float32)
+
+
+def stack_microbatches(batch: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) -> (n_micro, B // n_micro, ...)."""
+    if batch.shape[0] % n_micro != 0:
+        raise ValueError(
+            f"batch {batch.shape[0]} not divisible by {n_micro} microbatches")
+    return batch.reshape(n_micro, batch.shape[0] // n_micro, *batch.shape[1:])
+
+
+def unstack_microbatches(x: jax.Array) -> jax.Array:
+    """(n_micro, mb, ...) -> (n_micro * mb, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])

@@ -1,0 +1,123 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context training shards the sequence dimension across devices.  The
+reference framework has no attention code (it is model-agnostic middleware —
+SURVEY.md §5.7); the only primitive it offers for sequence layouts is
+alltoall.  TPU-native, we make sequence parallelism first-class with ring
+attention: Q stays resident, K/V shards rotate around the ring via
+``lax.ppermute`` (riding ICI neighbor links), and each step accumulates a
+blockwise-softmax partial (flash-attention online normalization, fp32
+accumulators).  Communication per step is the K/V block — overlap with the
+block matmul is XLA's latency-hiding scheduler's job.
+
+Layout: q, k, v are (batch, seq_local, heads, head_dim) shards of the global
+(batch, seq_local * ring_size, heads, head_dim) arrays, sequence-major across
+the axis: rank i holds positions [i*seq_local, (i+1)*seq_local).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_offset, kv_offset, causal, scale, m, l, o):
+    """One blockwise attention step with online softmax accumulation.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); m, l: (B, H, Sq); o: (B, Sq, H, D).
+    All accumulators fp32.
+    """
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale  # (B,H,Sq,Sk)
+    if causal:
+        sq = q.shape[1]
+        sk = k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = kv_offset + jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))               # (B,H,Sq)
+    # exp(_NEG_INF - _NEG_INF) would be 1; clamp so fully-masked blocks stay 0.
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    alpha = jnp.where(m <= _NEG_INF / 2, 0.0, alpha)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence-sharded axis via K/V ring rotation.
+
+    Call inside ``shard_map``; returns the local (B, Sq, H, D) output shard.
+    """
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    m = jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    q_offset = idx * sq
+
+    # Send K/V to the left neighbor each step; after t steps we hold the
+    # shard originating from rank (idx + t) % sp.
+    perm = [(i, (i - 1) % sp) for i in range(sp)]
+
+    def body(t, carry):
+        k_t, v_t, m_t, l_t, o_t = carry
+        kv_rank = (idx + t) % sp
+        kv_offset = kv_rank * sq
+        m_t, l_t, o_t = _block_attn(q, k_t, v_t, q_offset, kv_offset,
+                                    causal, scale, m_t, l_t, o_t)
+        k_nxt = lax.ppermute(k_t, axis_name, perm)
+        v_nxt = lax.ppermute(v_t, axis_name, perm)
+        return k_nxt, v_nxt, m_t, l_t, o_t
+
+    if sp == 1:
+        _, _, m, l, o = body(0, (k, v, m, l, o))
+    else:
+        # Static python loop: sp is small and static; lets XLA pipeline the
+        # ppermutes against the matmuls without a loop-carried dependence on
+        # trip count.
+        carry = (k, v, m, l, o)
+        for t in range(sp):
+            carry = body(t, carry)
+        _, _, m, l, o = carry
+
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Unsharded reference attention (same layout), used by tests and by the
+    flagship model when sequence parallelism is off."""
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = jnp.arange(sq)
+        k_pos = jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,88 @@
+"""Tensor (model) parallel building blocks — Megatron-style column/row
+parallel projections over a mesh axis, for use inside ``shard_map``.
+
+The reference framework is data-parallel only (SURVEY.md §2.3); tensor
+parallelism is part of this framework's TPU-native scope.  The math:
+
+* column-parallel: ``Y_shard = X @ W[:, shard]`` — no communication; the
+  activation comes out feature-sharded.
+* row-parallel: ``Y = psum_over_axis(X_shard @ W[shard, :])`` — one psum
+  (or reduce_scatter when the consumer is sequence-sharded, the
+  Megatron-SP fusion).
+
+Weights are stored pre-sharded (each member holds only its shard), so the
+framework never materializes the full matrix — FSDP-style memory scaling on
+top of TP.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel(x: jax.Array, w_shard: jax.Array,
+                    b_shard: Optional[jax.Array] = None) -> jax.Array:
+    """(..., d_in) @ (d_in, d_out/P) -> (..., d_out/P); no communication."""
+    y = jnp.einsum("...i,io->...o", x, w_shard)
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel(x_shard: jax.Array, w_shard: jax.Array, axis_name: str,
+                 b: Optional[jax.Array] = None,
+                 scatter_sequence: bool = False) -> jax.Array:
+    """(..., d_in/P) @ (d_in/P, d_out) -> psum -> (..., d_out).
+
+    With ``scatter_sequence=True`` the psum becomes a reduce_scatter over the
+    sequence dimension (dim -2), returning a sequence-sharded activation —
+    the Megatron sequence-parallel fusion that halves the bytes on the wire.
+    """
+    partial = jnp.einsum("...i,io->...o", x_shard, w_shard)
+    if scatter_sequence:
+        y = lax.psum_scatter(partial, axis_name, scatter_dimension=partial.ndim - 2,
+                             tiled=True)
+    else:
+        y = lax.psum(partial, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def gather_sequence(x: jax.Array, axis_name: str, dim: int = 1) -> jax.Array:
+    """All-gather a sequence-sharded activation back to full length along
+    ``dim`` (entry into a tensor-parallel region)."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def vocab_parallel_logits(x: jax.Array, embed_shard: jax.Array,
+                          axis_name: str) -> jax.Array:
+    """Compute logits against a vocab-sharded embedding: each member holds
+    vocab/P rows; the full logits stay sharded on the vocab dim."""
+    return jnp.einsum("...d,vd->...v", x, embed_shard)
+
+
+def vocab_parallel_cross_entropy(logits_shard: jax.Array, labels: jax.Array,
+                                 vocab_shard_size: int,
+                                 axis_name: str) -> jax.Array:
+    """Cross-entropy over vocab-sharded logits without gathering the full
+    vocab: two psums (max and sum-exp) plus a masked label pick."""
+    idx = lax.axis_index(axis_name)
+    lo = idx * vocab_shard_size
+    lf = logits_shard.astype(jnp.float32)
+    local_max = lf.max(axis=-1)
+    global_max = lax.pmax(local_max, axis_name)
+    shifted = lf - global_max[..., None]
+    sum_exp = lax.psum(jnp.exp(shifted).sum(axis=-1), axis_name)
+    # Pick the label logit if it lives in this shard, else 0; psum completes.
+    local_label = labels - lo
+    in_shard = (local_label >= 0) & (local_label < vocab_shard_size)
+    safe_label = jnp.clip(local_label, 0, vocab_shard_size - 1)
+    picked = jnp.take_along_axis(shifted, safe_label[..., None],
+                                 axis=-1)[..., 0]
+    label_logit = lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+    return jnp.log(sum_exp) - label_logit
