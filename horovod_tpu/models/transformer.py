@@ -1,0 +1,348 @@
+"""Flagship model: Transformer LM composed with dp / pp / tp / sp / ep.
+
+The reference framework is model-agnostic middleware; its benchmark models
+(ResNet-50, BERT — BASELINE.md) are external.  This framework ships its
+models, and this one is the flagship: a decoder-only Transformer whose
+training step exercises every parallelism axis the framework supports:
+
+* **dp**   — batch sharded over the ``dp`` mesh axis; gradient reduction is
+  inserted by AD/XLA when the step is differentiated over the mesh.
+* **pp**   — layers split into stages over ``pp``; GPipe microbatch schedule
+  (parallel/pipeline.py) with ppermute hops.
+* **tp**   — Megatron column/row parallel attention heads and MLP over the
+  ``mp`` axis (parallel/tensor_parallel.py).
+* **sp**   — sequence parallelism over the same ``mp`` axis: the residual
+  stream stays sequence-sharded (Megatron-SP); ``attn_mode="ring"`` keeps it
+  sharded *through* attention via ring attention
+  (parallel/ring_attention.py).
+* **ep**   — optional switch-MoE MLPs with experts sharded over the ``dp``
+  axis and all_to_all routing (parallel/moe.py).
+
+Compute dtype defaults to bfloat16 (MXU-native); normalization, softmax and
+loss accumulate in fp32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import moe as moe_lib
+from ..parallel import pipeline as pp_lib
+from ..parallel import ring_attention as ra
+from ..parallel import tensor_parallel as tp
+
+
+class TransformerConfig(NamedTuple):
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 8
+    seq_len: int = 512
+    n_experts: int = 0            # 0 → dense MLP; >0 → switch MoE
+    capacity_factor: float = 1.25
+    attn_mode: str = "megatron"   # "megatron" (tp heads) | "ring" (sp ring)
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+class ParallelConfig(NamedTuple):
+    dp: int = 1
+    pp: int = 1
+    mp: int = 1                   # shared tensor/sequence axis
+    n_microbatches: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, str, str]:
+        return ("dp", "pp", "mp")
+
+
+def _split(key, n):
+    return jax.random.split(key, n)
+
+
+def init_params(key, cfg: TransformerConfig,
+                par: ParallelConfig) -> Dict[str, Any]:
+    """Initialize the full (unsharded) parameter pytree; shardings are
+    applied by ``param_specs`` + jit in_shardings."""
+    d, ff, v, s = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.seq_len
+    h, hd = cfg.n_heads, cfg.head_dim
+    n_pp = par.pp
+    if cfg.n_layers % n_pp != 0:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp {n_pp}")
+    lps = cfg.n_layers // n_pp  # layers per stage
+    k = iter(_split(key, 16))
+    std = 0.02
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def rand(kk, *shape, scale=std):
+        return (jax.random.normal(kk, shape) * scale).astype(jnp.float32)
+
+    params: Dict[str, Any] = {
+        "embed": rand(next(k), v, d),
+        "pos": rand(next(k), s, d),
+        "final_norm": norm_init(d),
+        "layers": {
+            "ln1": norm_init(n_pp, lps, d),
+            "ln2": norm_init(n_pp, lps, d),
+            "wqkv": rand(next(k), n_pp, lps, d, 3 * h * hd),
+            "wo": rand(next(k), n_pp, lps, h * hd, d,
+                       scale=std / math.sqrt(2 * cfg.n_layers)),
+        },
+    }
+    if cfg.n_experts > 0:
+        if cfg.n_experts % par.dp != 0:
+            raise ValueError("n_experts must be divisible by dp (=ep) degree")
+        params["layers"]["gate"] = rand(next(k), n_pp, lps, d, cfg.n_experts)
+        params["layers"]["w_in"] = rand(next(k), n_pp, lps, cfg.n_experts,
+                                        d, ff)
+        params["layers"]["w_out"] = rand(
+            next(k), n_pp, lps, cfg.n_experts, ff, d,
+            scale=std / math.sqrt(2 * cfg.n_layers))
+    else:
+        params["layers"]["w1"] = rand(next(k), n_pp, lps, d, ff)
+        params["layers"]["w2"] = rand(next(k), n_pp, lps, ff, d,
+                                      scale=std / math.sqrt(2 * cfg.n_layers))
+    return params
+
+
+def param_specs(cfg: TransformerConfig, par: ParallelConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching ``init_params`` (mesh axes dp/pp/mp)."""
+    megatron = cfg.attn_mode == "megatron"
+    layers: Dict[str, Any] = {
+        "ln1": P("pp"),
+        "ln2": P("pp"),
+        # Megatron: qkv column-parallel (heads over mp), wo row-parallel.
+        # Ring: attention weights replicated over mp (sequence stays sharded).
+        "wqkv": P("pp", None, None, "mp") if megatron else P("pp"),
+        "wo": P("pp", None, "mp", None) if megatron else P("pp"),
+    }
+    if cfg.n_experts > 0:
+        layers["gate"] = P("pp")
+        layers["w_in"] = P("pp", None, "dp", None, None)   # experts over dp
+        layers["w_out"] = P("pp", None, "dp", None, None)
+    else:
+        layers["w1"] = P("pp", None, None, "mp")
+        layers["w2"] = P("pp", None, "mp", None)
+    return {
+        "embed": P(),
+        "pos": P(),
+        "final_norm": P(),
+        "layers": layers,
+    }
+
+
+def _rmsnorm(x, scale):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention_block(cfg: TransformerConfig, lp: Dict[str, jax.Array],
+                     x: jax.Array) -> jax.Array:
+    """x: (mb, s_local, d) sequence-sharded over mp. Returns residual add."""
+    h_heads, hd = cfg.n_heads, cfg.head_dim
+    hnorm = _rmsnorm(x, lp["ln1"])
+    # wqkv layout: (d, h*3*hd) with heads outermost in the fused dim, so an
+    # mp shard of the fused dim is a whole-head slice (q,k,v interleaved
+    # per head), making column-parallel == head-parallel.
+    if cfg.attn_mode == "megatron":
+        # gather sequence → heads-sharded attention → scatter sequence back.
+        hg = tp.gather_sequence(hnorm, "mp", dim=1)          # (mb, S, d)
+        qkv = tp.column_parallel(hg, lp["wqkv"].astype(x.dtype))
+        mb, s_full = qkv.shape[0], qkv.shape[1]
+        local_heads = qkv.shape[-1] // (3 * hd)
+        qkv = qkv.reshape(mb, s_full, local_heads, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        o = ra.full_attention(q, k, v, causal=True)
+        o = o.reshape(mb, s_full, local_heads * hd)
+        return tp.row_parallel(o, lp["wo"].astype(x.dtype), "mp",
+                               scatter_sequence=True)
+    else:  # ring attention: sequence stays sharded through attention
+        qkv = jnp.einsum("bsd,de->bse", hnorm, lp["wqkv"].astype(x.dtype))
+        mb, s_local = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(mb, s_local, h_heads, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        o = ra.ring_attention(q, k, v, axis_name="mp", causal=True)
+        o = o.reshape(mb, s_local, h_heads * hd)
+        return jnp.einsum("bse,ed->bsd", o, lp["wo"].astype(x.dtype))
+
+
+def _mlp_block(cfg: TransformerConfig, lp: Dict[str, jax.Array],
+               x: jax.Array) -> jax.Array:
+    hnorm = _rmsnorm(x, lp["ln2"])
+    if cfg.n_experts > 0:
+        mb, s_local, d = hnorm.shape
+        tok = hnorm.reshape(mb * s_local, d)
+        mp_params = moe_lib.MoEParams(
+            gate=lp["gate"].astype(jnp.float32),
+            w_in=lp["w_in"],    # (E_local, d, ff) after dp sharding
+            w_out=lp["w_out"],
+        )
+        y = moe_lib.moe_layer(mp_params, tok, "dp",
+                              capacity_factor=cfg.capacity_factor)
+        return y.reshape(mb, s_local, d).astype(x.dtype)
+    hg = tp.gather_sequence(hnorm, "mp", dim=1)
+    u = jax.nn.gelu(tp.column_parallel(hg, lp["w1"].astype(x.dtype)))
+    return tp.row_parallel(u, lp["w2"].astype(x.dtype), "mp",
+                           scatter_sequence=True)
+
+
+def _make_stage_fn(cfg: TransformerConfig):
+    """stage_fn(stage_params, act) scanning this stage's layers."""
+
+    def layer_fn(act, lp):
+        act = act + _attention_block(cfg, lp, act)
+        act = act + _mlp_block(cfg, lp, act)
+        return act, None
+
+    def stage_fn(stage_params, act):
+        body = layer_fn
+        if cfg.remat:
+            body = jax.checkpoint(layer_fn)
+        out, _ = lax.scan(body, act, stage_params)
+        return out
+
+    return stage_fn
+
+
+def forward_loss(cfg: TransformerConfig, par: ParallelConfig,
+                 params: Dict[str, Any], tokens: jax.Array,
+                 labels: jax.Array) -> jax.Array:
+    """Per-device loss body; call inside shard_map over mesh (dp, pp, mp).
+
+    tokens/labels: (B_local, S) int32 shards (batch over dp).
+    Returns a replicated scalar loss.
+    """
+    s_full = cfg.seq_len
+    mp_size = lax.axis_size("mp")
+    s_local = s_full // mp_size
+    mp_idx = lax.axis_index("mp")
+
+    # Embedding (replicated weights; computed once per device, then the
+    # sequence chunk for this mp member is sliced off → sp-sharded stream).
+    emb = params["embed"][tokens] + params["pos"][None]
+    x = lax.dynamic_slice_in_dim(emb, mp_idx * s_local, s_local, axis=1)
+    x = x.astype(cfg.dtype)
+
+    # Pipeline over pp with GPipe microbatching.
+    xs = pp_lib.stack_microbatches(x, par.n_microbatches)
+    stage_params = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    stage_fn = _make_stage_fn(cfg)
+    out = pp_lib.pipeline_apply(stage_fn, stage_params, xs, axis_name="pp",
+                                remat=cfg.remat)
+    hidden = pp_lib.unstack_microbatches(out)            # (B_local, s_local, d)
+
+    # Final norm + tied logits + CE on the local sequence chunk.
+    hidden = _rmsnorm(hidden, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", hidden.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    labels_local = lax.dynamic_slice_in_dim(labels, mp_idx * s_local,
+                                            s_local, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels_local[..., None], axis=-1)[..., 0]
+    loss_local = -jnp.mean(ll)
+
+    # Average over sequence chunks (mp) and batch shards (dp); the loss is
+    # only valid on the last pipeline stage → masked psum over pp.
+    loss = lax.pmean(lax.pmean(loss_local, "mp"), "dp")
+    loss = lax.psum(loss * pp_lib.last_stage_mask("pp"), "pp")
+    return loss
+
+
+def make_loss_fn(cfg: TransformerConfig, par: ParallelConfig, mesh):
+    """Global-array loss: shard_map of ``forward_loss`` over (dp, pp, mp)."""
+    from jax import shard_map
+    specs = param_specs(cfg, par)
+    data_spec = P("dp")
+
+    def loss_of(params, tokens, labels):
+        fn = shard_map(
+            lambda p, t, l: forward_loss(cfg, par, p, t, l),
+            mesh=mesh, in_specs=(specs, data_spec, data_spec),
+            out_specs=P(), check_vma=False)
+        return fn(params, tokens, labels)
+
+    return loss_of
+
+
+def serial_forward_loss(cfg: TransformerConfig, params: Dict[str, Any],
+                        tokens: jax.Array, labels: jax.Array) -> jax.Array:
+    """Unsharded oracle computing the same math as ``forward_loss`` (dense
+    MLP only) — used by tests to validate the sharded step end to end."""
+    assert cfg.n_experts == 0, "serial oracle covers the dense configuration"
+    x = (params["embed"][tokens] + params["pos"][None]).astype(cfg.dtype)
+    hd = cfg.head_dim
+    n_pp, lps = params["layers"]["ln1"].shape[:2]
+    for st in range(n_pp):
+        for li in range(lps):
+            lp = {k: v[st, li] for k, v in params["layers"].items()}
+            h = _rmsnorm(x, lp["ln1"])
+            qkv = jnp.einsum("bsd,de->bse", h, lp["wqkv"].astype(x.dtype))
+            b, s = qkv.shape[:2]
+            qkv = qkv.reshape(b, s, cfg.n_heads, 3, hd)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            o = ra.full_attention(q, k, v, causal=True)
+            x = x + jnp.einsum("bse,ed->bsd", o.reshape(b, s, -1),
+                               lp["wo"].astype(x.dtype))
+            h = _rmsnorm(x, lp["ln2"])
+            u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                       lp["w1"].astype(x.dtype)))
+            x = x + jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
+    hidden = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", hidden.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: TransformerConfig, par: ParallelConfig, mesh,
+                    optimizer):
+    """Build a jitted train step over the (dp, pp, mp) mesh.
+
+    Returns (train_step, shard_params) where ``train_step(params, opt_state,
+    tokens, labels) -> (params, opt_state, loss)``.  Differentiation happens
+    *outside* shard_map, so gradient reductions over every axis come from AD
+    transposes — no hand-written grad sync.
+    """
+    specs = param_specs(cfg, par)
+    loss_of = make_loss_fn(cfg, par, mesh)
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    from jax.sharding import NamedSharding
+
+    def shard_params(params):
+        return jax.device_put(
+            params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P)))
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    return jitted, shard_params
+
+
+def synthetic_batch(key, cfg: TransformerConfig, batch: int):
+    kt, kl = jax.random.split(key)
+    tokens = jax.random.randint(kt, (batch, cfg.seq_len), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=1)
+    return tokens, labels

@@ -1,0 +1,110 @@
+"""Flagship transformer: the fully-sharded (dp×pp×mp) training step must
+match the unsharded serial oracle in loss and gradients; MoE and ring modes
+must run and train."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=4, seq_len=32,
+    dtype=jnp.float32, remat=False)
+PAR = tfm.ParallelConfig(dp=2, pp=2, mp=2, n_microbatches=2)
+BATCH = 4
+
+
+def _setup(cfg=CFG, par=PAR):
+    hvd.init()
+    mesh = create_mesh({"dp": par.dp, "pp": par.pp, "mp": par.mp})
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, par)
+    tokens, labels = tfm.synthetic_batch(jax.random.PRNGKey(1), cfg, BATCH)
+    return mesh, params, tokens, labels
+
+
+def test_sharded_loss_matches_serial():
+    mesh, params, tokens, labels = _setup()
+    loss_of = tfm.make_loss_fn(CFG, PAR, mesh)
+    loss = jax.jit(loss_of)(params, tokens, labels)
+    expected = tfm.serial_forward_loss(CFG, params, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(expected), rtol=1e-5)
+
+
+def test_sharded_grads_match_serial():
+    mesh, params, tokens, labels = _setup()
+    loss_of = tfm.make_loss_fn(CFG, PAR, mesh)
+    g_sharded = jax.jit(jax.grad(loss_of))(params, tokens, labels)
+    g_serial = jax.grad(
+        lambda p: tfm.serial_forward_loss(CFG, p, tokens, labels))(params)
+    flat_s, _ = jax.tree_util.tree_flatten_with_path(g_sharded)
+    flat_r = dict(jax.tree_util.tree_flatten_with_path(g_serial)[0])
+    checked = 0
+    for path, leaf in flat_s:
+        ref = flat_r[path]
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref), rtol=2e-3, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+        checked += 1
+    assert checked >= 8
+
+
+def test_ring_mode_matches_serial():
+    cfg = CFG._replace(attn_mode="ring")
+    mesh, params, tokens, labels = _setup(cfg)
+    loss_of = tfm.make_loss_fn(cfg, PAR, mesh)
+    loss = jax.jit(loss_of)(params, tokens, labels)
+    expected = tfm.serial_forward_loss(CFG, params, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(expected), rtol=1e-4)
+
+
+def test_train_step_descends_loss():
+    mesh, params, tokens, labels = _setup()
+    tx = optax.adam(1e-2)
+    step, shard_params = tfm.make_train_step(CFG, PAR, mesh, tx)
+    params = shard_params(params)
+    opt_state = tx.init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_mode_trains():
+    cfg = CFG._replace(n_experts=4, capacity_factor=2.0)
+    mesh, params, tokens, labels = _setup(cfg)
+    tx = optax.adam(1e-2)
+    step, shard_params = tfm.make_train_step(cfg, PAR, mesh, tx)
+    params = shard_params(params)
+    opt_state = tx.init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_expert_grads_sharded_over_dp():
+    cfg = CFG._replace(n_experts=4, capacity_factor=2.0)
+    mesh, params, tokens, labels = _setup(cfg)
+    loss_of = tfm.make_loss_fn(cfg, PAR, mesh)
+    g = jax.jit(jax.grad(loss_of))(params, tokens, labels)
+    # Expert weights exist and receive gradient signal somewhere.
+    assert float(jnp.abs(g["layers"]["w_in"]).sum()) > 0.0
+
+
+def test_bf16_compiles_and_runs():
+    cfg = CFG._replace(dtype=jnp.bfloat16, remat=True)
+    mesh, params, tokens, labels = _setup(cfg)
+    tx = optax.sgd(1e-2)
+    step, shard_params = tfm.make_train_step(cfg, PAR, mesh, tx)
+    params = shard_params(params)
+    opt_state = tx.init(params)
+    params, opt_state, loss = step(params, opt_state, tokens, labels)
+    assert np.isfinite(float(loss))
