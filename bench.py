@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 synthetic training throughput (images/sec/chip).
+
+Mirrors the reference's synthetic benchmark harness
+(examples/pytorch/pytorch_synthetic_benchmark.py:106-115: warmup, timed
+batches, img/sec) on the TPU-native stack: bfloat16 ResNet-50 v1.5, SGD with
+momentum via hvd.DistributedOptimizer, data-parallel over all visible chips.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline compares against the reference's only published absolute
+throughput sample: 1656.82 img/s on 16 P100 GPUs = 103.55 img/s/GPU
+(ResNet-101, batch 64 — docs/benchmarks.rst:27-41; BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import horovod_tpu as hvd
+    from horovod_tpu.models import resnet
+
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    depth = int(os.environ.get("BENCH_DEPTH", "50"))
+    width = int(os.environ.get("BENCH_WIDTH", "64"))
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n_dev = mesh.devices.size
+    batch = per_chip_batch * n_dev
+
+    cfg = resnet.ResNetConfig(depth=depth, num_classes=1000, width=width,
+                              dtype=jnp.bfloat16)
+    params, stats = resnet.init_params(jax.random.PRNGKey(0), cfg)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = tx.init(params)
+    images, labels = resnet.synthetic_batch(jax.random.PRNGKey(1), batch,
+                                            image_size=image_size)
+
+    def step(params, stats, opt_state, images, labels):
+        def inner(p, s, o, im, lb):
+            def loss_fn(p):
+                logits, new_s = resnet.apply(p, s, im, cfg)
+                return resnet.cross_entropy_loss(logits, lb), new_s
+            (loss, new_s), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, new_s, o, jax.lax.pmean(loss, "data")
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P()), check_vma=False)(
+                params, stats, opt_state, images, labels)
+
+    rep = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+    params = jax.device_put(params, rep)
+    stats = jax.device_put(stats, rep)
+    opt_state = jax.device_put(opt_state, rep)
+    images = jax.device_put(images, data_sh)
+    labels = jax.device_put(labels, data_sh)
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    for _ in range(warmup):
+        params, stats, opt_state, loss = jstep(params, stats, opt_state,
+                                               images, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, stats, opt_state, loss = jstep(params, stats, opt_state,
+                                               images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    per_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": f"resnet{depth}_synthetic_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Small MLP classifier — the MNIST end-to-end slice model (reference's
+examples/pytorch/pytorch_mnist.py is the minimum-viable config in
+BASELINE.json)."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(key, sizes: Sequence[int] = (784, 512, 512, 10)):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for kk, din, dout in zip(keys, sizes[:-1], sizes[1:]):
+        std = math.sqrt(2.0 / din)
+        params.append({
+            "w": (jax.random.normal(kk, (din, dout)) * std).astype(
+                jnp.float32),
+            "b": jnp.zeros((dout,), jnp.float32)})
+    return params
+
+
+def apply(params, x):
+    h = x.reshape(x.shape[0], -1)
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def loss_fn(params, x, labels):
+    logits = apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))

@@ -1,0 +1,184 @@
+"""ResNet-50 v1.5 — the reference's headline benchmark model (BASELINE.md:
+examples/pytorch/pytorch_synthetic_benchmark.py, docs/benchmarks.rst).
+
+Pure-functional JAX implementation, NHWC (TPU-native conv layout), bfloat16
+compute with fp32 parameters and batch-norm statistics.  Batch norm supports
+cross-replica synchronization over a mesh axis — capability parity with the
+reference's SyncBatchNormalization (tensorflow/sync_batch_norm.py,
+torch/sync_batch_norm.py) where mean/var are allreduced across ranks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+STAGE_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+BOTTLENECK = {50, 101, 152}
+
+
+class ResNetConfig(NamedTuple):
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    sync_bn_axis: Optional[str] = None   # mesh axis for cross-replica BN
+    bn_momentum: float = 0.9
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = math.sqrt(2.0 / fan_in)
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * std).astype(
+        jnp.float32)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(key, cfg: ResNetConfig) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    blocks = STAGE_BLOCKS[cfg.depth]
+    bottleneck = cfg.depth in BOTTLENECK
+    expansion = 4 if bottleneck else 1
+    keys = iter(jax.random.split(key, 1024))
+    params: Dict[str, Any] = {"stem": {
+        "conv": _conv_init(next(keys), 7, 7, 3, cfg.width),
+        "bn": _bn_init(cfg.width)}}
+    stats: Dict[str, Any] = {"stem": _bn_stats(cfg.width)}
+    cin = cfg.width
+    for si, nblocks in enumerate(blocks):
+        cmid = cfg.width * (2 ** si)
+        cout = cmid * expansion
+        stage_p, stage_s = [], []
+        for bi in range(nblocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            bp: Dict[str, Any] = {}
+            bs: Dict[str, Any] = {}
+            if bottleneck:
+                shapes = [(1, 1, cin, cmid), (3, 3, cmid, cmid),
+                          (1, 1, cmid, cout)]
+            else:
+                shapes = [(3, 3, cin, cmid), (3, 3, cmid, cout)]
+            for ci, (kh, kw, ci_, co_) in enumerate(shapes):
+                bp[f"conv{ci}"] = _conv_init(next(keys), kh, kw, ci_, co_)
+                bp[f"bn{ci}"] = _bn_init(co_)
+                bs[f"bn{ci}"] = _bn_stats(co_)
+            if bi == 0 and (stride != 1 or cin != cout):
+                bp["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                bp["proj_bn"] = _bn_init(cout)
+                bs["proj_bn"] = _bn_stats(cout)
+            stage_p.append(bp)
+            stage_s.append(bs)
+            cin = cout
+        params[f"stage{si}"] = stage_p
+        stats[f"stage{si}"] = stage_s
+    head_std = 1.0 / math.sqrt(cin)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes))
+              * head_std).astype(jnp.float32),
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32)}
+    return params, stats
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _batch_norm(x, bn, stats, cfg: ResNetConfig, training: bool):
+    """BN in fp32; with ``sync_bn_axis`` the batch moments are allreduced
+    over the mesh axis (reference SyncBatchNormalization semantics).
+    Returns (normalized, new_stats)."""
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        mean_sq = jnp.mean(xf * xf, axis=(0, 1, 2))
+        if cfg.sync_bn_axis is not None:
+            mean = lax.pmean(mean, cfg.sync_bn_axis)
+            mean_sq = lax.pmean(mean_sq, cfg.sync_bn_axis)
+        var = mean_sq - mean * mean
+        m = cfg.bn_momentum
+        new_stats = {"mean": m * stats["mean"] + (1 - m) * mean,
+                     "var": m * stats["var"] + (1 - m) * var}
+    else:
+        mean, var = stats["mean"], stats["var"]
+        new_stats = stats
+    inv = lax.rsqrt(var + 1e-5)
+    out = (xf - mean) * inv * bn["scale"] + bn["bias"]
+    return out.astype(x.dtype), new_stats
+
+
+def apply(params, stats, images, cfg: ResNetConfig,
+          training: bool = True) -> Tuple[jax.Array, Dict]:
+    """Forward pass: images (N, H, W, 3) → logits (N, classes).
+
+    Returns (logits, new_batch_stats).
+    """
+    bottleneck = cfg.depth in BOTTLENECK
+    x = images.astype(cfg.dtype)
+    new_stats: Dict[str, Any] = {}
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, new_stats["stem"] = _batch_norm(x, params["stem"]["bn"],
+                                       stats["stem"], cfg, training)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    n_convs = 3 if bottleneck else 2
+    for si in range(4):
+        stage_p = params[f"stage{si}"]
+        stage_s = stats[f"stage{si}"]
+        out_stage = []
+        for bi, (bp, bs) in enumerate(zip(stage_p, stage_s)):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            shortcut = x
+            h = x
+            nbs: Dict[str, Any] = {}
+            for ci in range(n_convs):
+                # v1.5: stride lives on the 3x3 conv (index 1 in bottleneck).
+                s = stride if ci == (1 if bottleneck else 0) else 1
+                h = _conv(h, bp[f"conv{ci}"], stride=s)
+                h, nbs[f"bn{ci}"] = _batch_norm(h, bp[f"bn{ci}"],
+                                                bs[f"bn{ci}"], cfg, training)
+                if ci < n_convs - 1:
+                    h = jax.nn.relu(h)
+            if "proj" in bp:
+                shortcut = _conv(shortcut, bp["proj"], stride=stride)
+                shortcut, nbs["proj_bn"] = _batch_norm(
+                    shortcut, bp["proj_bn"], bs["proj_bn"], cfg, training)
+            x = jax.nn.relu(h + shortcut)
+            out_stage.append(nbs)
+        new_stats[f"stage{si}"] = out_stage
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_stats
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(
+        jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0])
+
+
+def synthetic_batch(key, batch: int, image_size: int = 224,
+                    num_classes: int = 1000):
+    ki, kl = jax.random.split(key)
+    images = jax.random.normal(ki, (batch, image_size, image_size, 3),
+                               dtype=jnp.float32)
+    labels = jax.random.randint(kl, (batch,), 0, num_classes,
+                                dtype=jnp.int32)
+    return images, labels

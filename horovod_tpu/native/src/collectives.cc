@@ -1,0 +1,411 @@
+#include "collectives.h"
+
+#include <poll.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+namespace hvdtpu {
+
+namespace {
+
+// --- fp16 / bf16 <-> fp32 (reference half.cc capability, portable) --------
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) { mant <<= 1; exp--; }
+      mant &= 0x3ffu;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp + 127 - 15) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalf(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffffu;
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = 14 - exp;
+    return static_cast<uint16_t>(sign | (mant >> shift));
+  }
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);
+  return static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+}
+
+inline float Bf16ToFloat(uint16_t b) {
+  uint32_t f = static_cast<uint32_t>(b) << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  // round-to-nearest-even
+  uint32_t rounding = ((f >> 16) & 1u) + 0x7fffu;
+  return static_cast<uint16_t>((f + rounding) >> 16);
+}
+
+// --- reduction kernels -----------------------------------------------------
+
+template <typename T>
+void ReduceT(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:  // divide happens at unpack
+    case ReduceOp::ADASUM:   // handled elsewhere; fallthrough sum for safety
+      for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) dst[i] *= src[i];
+      break;
+  }
+}
+
+template <typename U, float (*ToF)(U), U (*FromF)(float)>
+void Reduce16(U* dst, const U* src, int64_t n, ReduceOp op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(dst[i]);
+    float b = ToF(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b; break;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+void ReduceBuf(void* dst, const void* src, int64_t count, DataType dtype,
+               ReduceOp op) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      ReduceT(static_cast<float*>(dst), static_cast<const float*>(src),
+              count, op);
+      break;
+    case DataType::FLOAT64:
+      ReduceT(static_cast<double*>(dst), static_cast<const double*>(src),
+              count, op);
+      break;
+    case DataType::INT32:
+      ReduceT(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+              count, op);
+      break;
+    case DataType::INT64:
+      ReduceT(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+              count, op);
+      break;
+    case DataType::UINT8:
+    case DataType::BOOL:
+      ReduceT(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+              count, op);
+      break;
+    case DataType::INT8:
+      ReduceT(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+              count, op);
+      break;
+    case DataType::FLOAT16:
+      Reduce16<uint16_t, HalfToFloat, FloatToHalf>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, op);
+      break;
+    case DataType::BFLOAT16:
+      Reduce16<uint16_t, Bf16ToFloat, FloatToBf16>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, op);
+      break;
+  }
+}
+
+// Full-duplex transfer: simultaneously stream nsend bytes to send_sock and
+// nrecv bytes from recv_sock, multiplexed with poll() — deadlock-free even
+// when both directions exceed kernel socket buffers.
+Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
+                  Socket* recv_sock, uint8_t* recv_buf, size_t nrecv) {
+  size_t sent = 0, received = 0;
+  while (sent < nsend || received < nrecv) {
+    struct pollfd fds[2];
+    int nf = 0;
+    int send_i = -1, recv_i = -1;
+    if (sent < nsend) {
+      fds[nf] = {send_sock->fd(), POLLOUT, 0};
+      send_i = nf++;
+    }
+    if (received < nrecv) {
+      fds[nf] = {recv_sock->fd(), POLLIN, 0};
+      recv_i = nf++;
+    }
+    if (::poll(fds, nf, 60000) <= 0)
+      return Status::Error("collective transfer timeout/poll error");
+    if (send_i >= 0 && (fds[send_i].revents & (POLLOUT | POLLERR))) {
+      ssize_t k = ::send(send_sock->fd(), send_buf + sent,
+                         std::min<size_t>(nsend - sent, 1 << 20),
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Error("send failed in collective");
+      if (k > 0) sent += k;
+    }
+    if (recv_i >= 0 && (fds[recv_i].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = ::recv(recv_sock->fd(), recv_buf + received,
+                         std::min<size_t>(nrecv - received, 1 << 20),
+                         MSG_DONTWAIT);
+      if (k == 0) return Status::Aborted("peer closed during collective");
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Error("recv failed in collective");
+      if (k > 0) received += k;
+    }
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      float* p = static_cast<float*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::FLOAT64: {
+      double* p = static_cast<double*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::FLOAT16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf(HalfToFloat(p[i]) * factor);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBf16(Bf16ToFloat(p[i]) * factor);
+      break;
+    }
+    case DataType::INT32: {
+      int32_t* p = static_cast<int32_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int32_t>(p[i] * factor);
+      break;
+    }
+    case DataType::INT64: {
+      int64_t* p = static_cast<int64_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int64_t>(p[i] * factor);
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
+                     ReduceOp op) {
+  const int size = net.size();
+  const int rank = net.rank();
+  if (size == 1 || count == 0) return Status::OK();
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  const size_t elem = DataTypeSize(dtype);
+
+  // Segment boundaries (last segment may be short).
+  const int64_t seg = (count + size - 1) / size;
+  auto seg_start = [&](int s) { return std::min<int64_t>(seg * s, count); };
+  auto seg_count = [&](int s) {
+    return std::min<int64_t>(seg, count - seg_start(s));
+  };
+
+  Socket* right = net.peer((rank + 1) % size);
+  Socket* left = net.peer((rank - 1 + size) % size);
+  std::vector<uint8_t> scratch(seg * elem);
+
+  // Reduce-scatter: after step t each rank holds the full reduction of
+  // segment (rank+1) mod size at the end.
+  for (int t = 0; t < size - 1; ++t) {
+    int send_s = ((rank - t) % size + size) % size;
+    int recv_s = ((rank - t - 1) % size + size) % size;
+    Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
+                           seg_count(send_s) * elem, left, scratch.data(),
+                           seg_count(recv_s) * elem);
+    if (!st.ok()) return st;
+    ReduceBuf(buf + seg_start(recv_s) * elem, scratch.data(),
+              seg_count(recv_s), dtype, op);
+  }
+  // Allgather: circulate the reduced segments.
+  for (int t = 0; t < size - 1; ++t) {
+    int send_s = ((rank + 1 - t) % size + size) % size;
+    int recv_s = ((rank - t) % size + size) % size;
+    Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
+                           seg_count(send_s) * elem, left,
+                           buf + seg_start(recv_s) * elem,
+                           seg_count(recv_s) * elem);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherv(Network& net, uint8_t* buf,
+                      const std::vector<int64_t>& bytes,
+                      const std::vector<int64_t>& offsets) {
+  const int size = net.size();
+  const int rank = net.rank();
+  if (size == 1) return Status::OK();
+  Socket* right = net.peer((rank + 1) % size);
+  Socket* left = net.peer((rank - 1 + size) % size);
+  for (int t = 0; t < size - 1; ++t) {
+    int send_b = ((rank - t) % size + size) % size;
+    int recv_b = ((rank - t - 1) % size + size) % size;
+    Status st = FullDuplex(right, buf + offsets[send_b], bytes[send_b],
+                           left, buf + offsets[recv_b], bytes[recv_b]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
+  const int size = net.size();
+  const int rank = net.rank();
+  if (size == 1 || nbytes == 0) return Status::OK();
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  // Rotate so root is position 0 in the chain.
+  int pos = ((rank - root) % size + size) % size;
+  if (pos > 0) {
+    Socket* prev = net.peer((rank - 1 + size) % size);
+    Status st = prev ? prev->RecvAll(buf, nbytes)
+                     : Status::Error("no peer");
+    if (!st.ok()) return st;
+  }
+  if (pos < size - 1) {
+    Socket* next = net.peer((rank + 1) % size);
+    Status st = next ? next->SendAll(buf, nbytes)
+                     : Status::Error("no peer");
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status PairwiseAlltoallv(Network& net, const uint8_t* send,
+                         const std::vector<int64_t>& send_bytes,
+                         uint8_t* recv,
+                         const std::vector<int64_t>& recv_bytes) {
+  const int size = net.size();
+  const int rank = net.rank();
+  std::vector<int64_t> soff(size + 1, 0), roff(size + 1, 0);
+  for (int i = 0; i < size; ++i) {
+    soff[i + 1] = soff[i] + send_bytes[i];
+    roff[i + 1] = roff[i] + recv_bytes[i];
+  }
+  // Self copy.
+  memcpy(recv + roff[rank], send + soff[rank], send_bytes[rank]);
+  for (int d = 1; d < size; ++d) {
+    int to = (rank + d) % size;
+    int from = (rank - d + size) % size;
+    Status st = FullDuplex(net.peer(to), send + soff[to], send_bytes[to],
+                           net.peer(from), recv + roff[from],
+                           recv_bytes[from]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+namespace {
+
+template <typename T>
+void AdasumPair(T* a, const T* b, int64_t n) {
+  double dot = 0, na = 0, nb = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double x = static_cast<double>(a[i]);
+    double y = static_cast<double>(b[i]);
+    dot += x * y;
+    na += x * x;
+    nb += y * y;
+  }
+  double ac = na > 0 ? 1.0 - dot / (2.0 * na) : 1.0;
+  double bc = nb > 0 ? 1.0 - dot / (2.0 * nb) : 1.0;
+  for (int64_t i = 0; i < n; ++i)
+    a[i] = static_cast<T>(ac * static_cast<double>(a[i]) +
+                          bc * static_cast<double>(b[i]));
+}
+
+template <typename T>
+void AdasumTree(std::vector<std::vector<uint8_t>>& bufs, int64_t n) {
+  // Pair (0,1),(2,3)... then pairs-of-pairs — same tree as ops/adasum.py.
+  size_t m = bufs.size();
+  std::vector<int> live(m);
+  for (size_t i = 0; i < m; ++i) live[i] = static_cast<int>(i);
+  while (live.size() > 1) {
+    std::vector<int> nxt;
+    for (size_t i = 0; i + 1 < live.size(); i += 2) {
+      AdasumPair(reinterpret_cast<T*>(bufs[live[i]].data()),
+                 reinterpret_cast<const T*>(bufs[live[i + 1]].data()), n);
+      nxt.push_back(live[i]);
+    }
+    if (live.size() % 2 == 1) nxt.push_back(live.back());
+    live = nxt;
+  }
+  if (live[0] != 0) bufs[0] = bufs[live[0]];
+}
+
+}  // namespace
+
+Status AdasumAllreduce(Network& net, void* vbuf, int64_t count,
+                       DataType dtype) {
+  const int size = net.size();
+  if (size == 1 || count == 0) return Status::OK();
+  if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64)
+    return Status::InvalidArgument(
+        "eager Adasum supports float32/float64");
+  const size_t elem = DataTypeSize(dtype);
+  const size_t nbytes = count * elem;
+  // Gather all contributions (simple but exact; VHDD schedule is a later
+  // optimization — the compiled path handles large tensors).
+  std::vector<std::vector<uint8_t>> bufs(size);
+  std::vector<int64_t> bytes(size, nbytes), offsets(size);
+  std::vector<uint8_t> gathered(nbytes * size);
+  for (int i = 0; i < size; ++i) offsets[i] = i * nbytes;
+  memcpy(gathered.data() + net.rank() * nbytes, vbuf, nbytes);
+  Status st = RingAllgatherv(net, gathered.data(), bytes, offsets);
+  if (!st.ok()) return st;
+  for (int i = 0; i < size; ++i)
+    bufs[i].assign(gathered.begin() + i * nbytes,
+                   gathered.begin() + (i + 1) * nbytes);
+  if (dtype == DataType::FLOAT32)
+    AdasumTree<float>(bufs, count);
+  else
+    AdasumTree<double>(bufs, count);
+  memcpy(vbuf, bufs[0].data(), nbytes);
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

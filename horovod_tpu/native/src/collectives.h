@@ -1,0 +1,48 @@
+// CPU data-plane collectives over the TCP full mesh.
+//
+// Capability parity with the reference's CPU op backends (MPI/Gloo ops,
+// ops/mpi_operations.cc, ops/gloo_operations.cc): ring allreduce
+// (reduce-scatter + allgather, the bandwidth-optimal schedule NCCL uses),
+// chain broadcast, ring allgatherv, pairwise alltoallv; dtype-dispatched
+// reduction kernels incl. fp16/bf16 with fp32 accumulation
+// (reference half.cc), Adasum (gather + coefficient tree, ops/adasum/).
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+#include "net.h"
+
+namespace hvdtpu {
+
+// In-place allreduce of buf (count elements of dtype) across all ranks.
+Status RingAllreduce(Network& net, void* buf, int64_t count, DataType dtype,
+                     ReduceOp op);
+
+// buf holds this rank's my_bytes at offset offsets[rank]; fills the rest.
+// offsets/bytes per rank; buf has total size sum(bytes).
+Status RingAllgatherv(Network& net, uint8_t* buf,
+                      const std::vector<int64_t>& bytes,
+                      const std::vector<int64_t>& offsets);
+
+// In-place broadcast of buf from root (chain schedule).
+Status ChainBroadcast(Network& net, void* buf, int64_t nbytes, int root);
+
+// send: concatenated segments for each destination (send_bytes[d] each);
+// recv: filled with segments from each source (recv_bytes[s] each).
+Status PairwiseAlltoallv(Network& net, const uint8_t* send,
+                         const std::vector<int64_t>& send_bytes,
+                         uint8_t* recv,
+                         const std::vector<int64_t>& recv_bytes);
+
+// Adasum allreduce: allgather all contributions, reduce with the adaptive
+// coefficient binary tree (same numerics as ops/adasum.py / reference
+// adasum.h:385-395). Float dtypes only.
+Status AdasumAllreduce(Network& net, void* buf, int64_t count,
+                       DataType dtype);
+
+// Elementwise scale in place (used for prescale/postscale/average).
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvdtpu

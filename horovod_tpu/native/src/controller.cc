@@ -1,0 +1,293 @@
+#include "controller.h"
+
+#include <cstdio>
+#include <sstream>
+
+namespace hvdtpu {
+
+namespace {
+
+std::string ShapeStr(const std::vector<int64_t>& s) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < s.size(); ++i) os << (i ? "," : "") << s[i];
+  os << "]";
+  return os.str();
+}
+
+// Cross-rank consistency validation (reference controller.cc:482-706).
+std::string Validate(const std::map<int32_t, Request>& by_rank) {
+  const Request* first = nullptr;
+  int32_t first_rank = 0;
+  for (const auto& [rank, q] : by_rank) {
+    if (!first) {
+      first = &q;
+      first_rank = rank;
+      continue;
+    }
+    std::ostringstream err;
+    if (q.type != first->type) {
+      err << "mismatched collective type between rank " << first_rank
+          << " and rank " << rank;
+      return err.str();
+    }
+    if (q.dtype != first->dtype) {
+      err << "mismatched dtype between rank " << first_rank << " and rank "
+          << rank;
+      return err.str();
+    }
+    if (q.op != first->op) {
+      err << "mismatched reduce op between rank " << first_rank
+          << " and rank " << rank;
+      return err.str();
+    }
+    if (q.prescale != first->prescale || q.postscale != first->postscale) {
+      err << "mismatched prescale/postscale factors";
+      return err.str();
+    }
+    if (q.type == RequestType::ALLREDUCE ||
+        q.type == RequestType::BROADCAST) {
+      if (q.shape != first->shape) {
+        err << "mismatched shape: rank " << first_rank << " has "
+            << ShapeStr(first->shape) << ", rank " << rank << " has "
+            << ShapeStr(q.shape);
+        return err.str();
+      }
+    }
+    if (q.type == RequestType::ALLGATHER && !q.shape.empty() &&
+        !first->shape.empty()) {
+      // All dims but the first must match (controller.cc:576-648).
+      if (std::vector<int64_t>(q.shape.begin() + 1, q.shape.end()) !=
+          std::vector<int64_t>(first->shape.begin() + 1,
+                               first->shape.end())) {
+        err << "mismatched allgather trailing dims";
+        return err.str();
+      }
+    }
+    if (q.type == RequestType::BROADCAST && q.root_rank != first->root_rank) {
+      err << "mismatched broadcast root";
+      return err.str();
+    }
+  }
+  return "";
+}
+
+int64_t NumElements(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+}  // namespace
+
+Status Controller::Exchange(const RequestList& mine, ResponseList* out) {
+  Writer w;
+  SerializeRequestList(mine, w);
+  if (net_->rank() == 0) {
+    std::vector<RequestList> lists(net_->size());
+    lists[0] = mine;
+    for (int r = 1; r < net_->size(); ++r) {
+      std::vector<uint8_t> frame;
+      Status st = net_->peer(r)->RecvFrame(frame);
+      if (!st.ok()) return st;
+      Reader rd(frame.data(), frame.size());
+      lists[r] = DeserializeRequestList(rd);
+    }
+    ResponseList rl = Coordinate(lists);
+    Writer rw;
+    SerializeResponseList(rl, rw);
+    for (int r = 1; r < net_->size(); ++r) {
+      Status st = net_->peer(r)->SendFrame(rw.buf);
+      if (!st.ok()) return st;
+    }
+    *out = rl;
+  } else {
+    Status st = net_->coordinator()->SendFrame(w.buf);
+    if (!st.ok()) return st;
+    std::vector<uint8_t> frame;
+    st = net_->coordinator()->RecvFrame(frame);
+    if (!st.ok()) return st;
+    Reader rd(frame.data(), frame.size());
+    *out = DeserializeResponseList(rd);
+  }
+  return Status::OK();
+}
+
+ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
+  const int size = net_->size();
+  ResponseList rl;
+
+  // Absorb flags + requests.
+  for (int r = 0; r < size; ++r) {
+    if (lists[r].join) joined_.insert(r);
+    if (lists[r].barrier) barriered_.insert(r);
+    if (lists[r].shutdown) shutdown_.insert(r);
+    for (auto& q : lists[r].requests) {
+      auto it = table_.find(q.name);
+      if (it == table_.end()) {
+        PendingTensor pt;
+        pt.first = q;
+        pt.first_report = std::chrono::steady_clock::now();
+        pt.by_rank[r] = q;
+        table_.emplace(q.name, std::move(pt));
+        arrival_order_.push_back(q.name);
+      } else {
+        it->second.by_rank[r] = q;
+      }
+    }
+  }
+
+  // Find ready tensors (reported by every non-joined rank), preserving
+  // arrival order for deterministic fusion across iterations.
+  std::vector<std::string> ready;
+  for (const auto& name : arrival_order_) {
+    auto it = table_.find(name);
+    if (it == table_.end()) continue;
+    size_t needed = 0;
+    for (int r = 0; r < size; ++r)
+      if (!joined_.count(r)) needed++;
+    size_t have = 0;
+    for (const auto& [r, q] : it->second.by_rank)
+      if (!joined_.count(r)) have++;
+    if (have >= needed && needed > 0) ready.push_back(name);
+  }
+
+  // Build responses: validate, then fuse compatible allreduces under the
+  // threshold (FuseResponses, controller.cc:777-914).
+  Response* open_fusion = nullptr;
+  int64_t open_bytes = 0;
+  for (const auto& name : ready) {
+    PendingTensor& pt = table_[name];
+    std::string err = Validate(pt.by_rank);
+    const Request& q = pt.first;
+    if (!err.empty()) {
+      Response resp;
+      resp.type = q.type;
+      resp.names = {name};
+      resp.error = err;
+      rl.responses.push_back(resp);
+      open_fusion = nullptr;
+    } else if (q.type == RequestType::ALLREDUCE) {
+      int64_t bytes = NumElements(q.shape) * DataTypeSize(q.dtype);
+      bool fusible =
+          open_fusion != nullptr && open_fusion->dtype == q.dtype &&
+          open_fusion->op == q.op && open_fusion->prescale == q.prescale &&
+          open_fusion->postscale == q.postscale &&
+          open_bytes + bytes <= cfg_.fusion_threshold_bytes;
+      if (fusible) {
+        open_fusion->names.push_back(name);
+        open_fusion->sizes.push_back(NumElements(q.shape));
+        open_bytes += bytes;
+      } else {
+        Response resp;
+        resp.type = q.type;
+        resp.names = {name};
+        resp.dtype = q.dtype;
+        resp.op = q.op;
+        resp.prescale = q.prescale;
+        resp.postscale = q.postscale;
+        resp.sizes = {NumElements(q.shape)};
+        rl.responses.push_back(resp);
+        open_fusion = &rl.responses.back();
+        open_bytes = bytes;
+      }
+    } else if (q.type == RequestType::ALLGATHER) {
+      Response resp;
+      resp.type = q.type;
+      resp.names = {name};
+      resp.dtype = q.dtype;
+      // sizes = first dims per rank (0 for joined ranks).
+      for (int r = 0; r < size; ++r) {
+        auto itq = pt.by_rank.find(r);
+        resp.sizes.push_back(
+            itq == pt.by_rank.end() || itq->second.shape.empty()
+                ? 0 : itq->second.shape[0]);
+      }
+      rl.responses.push_back(resp);
+      open_fusion = nullptr;
+    } else if (q.type == RequestType::BROADCAST) {
+      Response resp;
+      resp.type = q.type;
+      resp.names = {name};
+      resp.dtype = q.dtype;
+      resp.root_rank = q.root_rank;
+      resp.sizes = {NumElements(q.shape)};
+      rl.responses.push_back(resp);
+      open_fusion = nullptr;
+    } else if (q.type == RequestType::ALLTOALL) {
+      Response resp;
+      resp.type = q.type;
+      resp.names = {name};
+      resp.dtype = q.dtype;
+      // sizes = row-split matrix, row-major [src * size + dst]; joined
+      // ranks contribute zero rows.
+      resp.sizes.assign(static_cast<size_t>(size) * size, 0);
+      for (int r = 0; r < size; ++r) {
+        auto itq = pt.by_rank.find(r);
+        if (itq == pt.by_rank.end()) continue;
+        for (int d = 0; d < size && d < (int)itq->second.splits.size(); ++d)
+          resp.sizes[static_cast<size_t>(r) * size + d] =
+              itq->second.splits[d];
+      }
+      rl.responses.push_back(resp);
+      open_fusion = nullptr;
+    }
+    table_.erase(name);
+  }
+  if (!ready.empty()) {
+    // Compact arrival order.
+    std::vector<std::string> rest;
+    for (const auto& n : arrival_order_)
+      if (table_.count(n)) rest.push_back(n);
+    arrival_order_ = std::move(rest);
+  }
+
+  // Join: when every rank has joined, release and report the last rank.
+  if (!joined_.empty() && static_cast<int>(joined_.size()) == size) {
+    rl.last_joined_rank = *joined_.rbegin();
+    joined_.clear();
+  }
+  // Barrier: release when all ranks are waiting.
+  if (static_cast<int>(barriered_.size()) == size) {
+    rl.barrier_release = true;
+    barriered_.clear();
+  }
+  // Shutdown once every rank asked for it.
+  if (static_cast<int>(shutdown_.size()) == size) rl.shutdown = true;
+
+  CheckStalls(rl);
+  return rl;
+}
+
+void Controller::CheckStalls(ResponseList& rl) {
+  auto now = std::chrono::steady_clock::now();
+  for (auto& [name, pt] : table_) {
+    double age = std::chrono::duration<double>(now - pt.first_report).count();
+    if (cfg_.stall_shutdown_s > 0 && age > cfg_.stall_shutdown_s) {
+      Response resp;
+      resp.type = pt.first.type;
+      resp.names = {name};
+      resp.error = "stalled for " + std::to_string((int)age) +
+                   "s; missing ranks exceeded shutdown window";
+      rl.responses.push_back(resp);
+      continue;
+    }
+    if (!pt.stall_warned && age > cfg_.stall_warning_s) {
+      pt.stall_warned = true;
+      std::string missing;
+      for (int r = 0; r < net_->size(); ++r)
+        if (!pt.by_rank.count(r) && !joined_.count(r))
+          missing += (missing.empty() ? "" : ",") + std::to_string(r);
+      fprintf(stderr,
+              "[hvd_tpu coordinator] WARNING: tensor %s submitted by some "
+              "ranks but rank(s) [%s] have not yet (%.0fs); possible stall\n",
+              name.c_str(), missing.c_str(), age);
+    }
+  }
+  // Purge entries flagged as errors by the stall shutdown above.
+  for (const auto& resp : rl.responses)
+    if (!resp.error.empty())
+      for (const auto& n : resp.names) table_.erase(n);
+}
+
+}  // namespace hvdtpu

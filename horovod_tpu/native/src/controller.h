@@ -1,0 +1,64 @@
+// Coordinator/worker control-plane protocol.
+//
+// Capability parity with the reference Controller (controller.h:37-223,
+// controller.cc:69-449 ComputeResponseList): workers announce ready tensors
+// each cycle; rank 0 counts announcements per tensor, validates cross-rank
+// consistency (dtype/shape/op/root/scale — controller.cc:482-706), fuses
+// ready allreduces under the fusion threshold (FuseResponses,
+// controller.cc:777-914), and broadcasts the ResponseList.  Join / barrier /
+// shutdown ride the same rounds.  The transport is the synchronous
+// gather+bcast of MPIController (mpi_controller.cc:108-199) over TCP.
+// A StallInspector (stall_inspector.h:31-100) flags tensors reported by
+// some-but-not-all ranks past a warning window.
+#pragma once
+
+#include <chrono>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "net.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+struct ControllerConfig {
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  double stall_warning_s = 60.0;
+  double stall_shutdown_s = 0.0;  // 0 = never
+};
+
+class Controller {
+ public:
+  Controller(Network* net, const ControllerConfig& cfg)
+      : net_(net), cfg_(cfg) {}
+
+  // Synchronous round: every rank calls this every cycle. Returns the
+  // coordinator's ResponseList.
+  Status Exchange(const RequestList& mine, ResponseList* out);
+
+ private:
+  ResponseList Coordinate(std::vector<RequestList>& lists);
+  void CheckStalls(ResponseList& rl);
+
+  struct PendingTensor {
+    Request first;                       // first-reported metadata
+    std::map<int32_t, Request> by_rank;  // all reports
+    std::chrono::steady_clock::time_point first_report;
+    bool stall_warned = false;
+  };
+
+  Network* net_;
+  ControllerConfig cfg_;
+  // Coordinator-only state (persists across rounds).
+  std::map<std::string, PendingTensor> table_;
+  std::vector<std::string> arrival_order_;
+  std::set<int32_t> joined_;
+  std::set<int32_t> barriered_;
+  std::set<int32_t> shutdown_;
+  int32_t last_join_rank_ = -1;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,242 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+
+namespace hvdtpu {
+
+Socket::~Socket() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+Status Socket::SendAll(const void* data, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("send: ") + strerror(errno));
+    }
+    p += k;
+    n -= k;
+  }
+  return Status::OK();
+}
+
+Status Socket::RecvAll(void* data, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd_, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("recv: ") + strerror(errno));
+    }
+    if (k == 0) return Status::Aborted("peer closed connection");
+    p += k;
+    n -= k;
+  }
+  return Status::OK();
+}
+
+Status Socket::SendFrame(const std::vector<uint8_t>& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  Status s = SendAll(&len, 4);
+  if (!s.ok()) return s;
+  return SendAll(payload.data(), payload.size());
+}
+
+Status Socket::RecvFrame(std::vector<uint8_t>& payload) {
+  uint32_t len = 0;
+  Status s = RecvAll(&len, 4);
+  if (!s.ok()) return s;
+  payload.resize(len);
+  return RecvAll(payload.data(), len);
+}
+
+namespace {
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+int Listen(uint16_t port, uint16_t* bound_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *bound_port = ntohs(addr.sin_port);
+  return fd;
+}
+
+int DialRetry(const std::string& host, uint16_t port, int attempts = 600) {
+  for (int i = 0; i < attempts; ++i) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[16];
+    snprintf(portstr, sizeof(portstr), "%u", port);
+    if (getaddrinfo(host.c_str(), portstr, &hints, &res) != 0 || !res) {
+      usleep(100000);
+      continue;
+    }
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0 &&
+        ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      freeaddrinfo(res);
+      SetNoDelay(fd);
+      return fd;
+    }
+    if (fd >= 0) ::close(fd);
+    freeaddrinfo(res);
+    usleep(100000);  // coordinator may not be up yet; retry for ~60 s
+  }
+  return -1;
+}
+
+bool ParseAddr(const std::string& addr, std::string* host, uint16_t* port) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = addr.substr(0, pos);
+  *port = static_cast<uint16_t>(atoi(addr.c_str() + pos + 1));
+  return true;
+}
+
+std::string LocalHostname() {
+  char buf[256];
+  if (gethostname(buf, sizeof(buf)) == 0) return buf;
+  return "127.0.0.1";
+}
+
+}  // namespace
+
+std::unique_ptr<Network> Network::Connect(int rank, int size,
+                                          const std::string& coord_addr,
+                                          Status* status) {
+  std::string coord_host;
+  uint16_t coord_port = 0;
+  if (!ParseAddr(coord_addr, &coord_host, &coord_port)) {
+    *status = Status::InvalidArgument("bad coordinator address " + coord_addr);
+    return nullptr;
+  }
+  std::unique_ptr<Network> net(new Network(rank, size));
+
+  // Every rank listens; rank 0 on the well-known port.
+  uint16_t my_port = 0;
+  int listen_fd = Listen(rank == 0 ? coord_port : 0, &my_port);
+  if (listen_fd < 0) {
+    *status = Status::Error("cannot bind listener");
+    return nullptr;
+  }
+
+  if (rank == 0) {
+    // Accept size-1 workers; each announces {rank, host, port}.
+    std::vector<std::string> table(size);
+    table[0] = LocalHostname() + ":" + std::to_string(my_port);
+    for (int i = 1; i < size; ++i) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        *status = Status::Error("accept failed");
+        return nullptr;
+      }
+      SetNoDelay(fd);
+      auto sock = std::make_unique<Socket>(fd);
+      int32_t peer_rank;
+      if (!sock->RecvAll(&peer_rank, 4).ok()) {
+        *status = Status::Error("handshake recv failed");
+        return nullptr;
+      }
+      std::vector<uint8_t> addr_buf;
+      sock->RecvFrame(addr_buf);
+      table[peer_rank].assign(addr_buf.begin(), addr_buf.end());
+      net->peers_[peer_rank] = std::move(sock);
+    }
+    // Broadcast the address table.
+    std::vector<uint8_t> blob;
+    for (int i = 0; i < size; ++i) {
+      uint32_t n = table[i].size();
+      const uint8_t* np = reinterpret_cast<const uint8_t*>(&n);
+      blob.insert(blob.end(), np, np + 4);
+      blob.insert(blob.end(), table[i].begin(), table[i].end());
+    }
+    for (int i = 1; i < size; ++i) net->peers_[i]->SendFrame(blob);
+  } else {
+    int fd = DialRetry(coord_host, coord_port);
+    if (fd < 0) {
+      *status = Status::Error("cannot reach coordinator at " + coord_addr);
+      return nullptr;
+    }
+    auto sock = std::make_unique<Socket>(fd);
+    int32_t r32 = rank;
+    sock->SendAll(&r32, 4);
+    std::string my_addr = LocalHostname() + ":" + std::to_string(my_port);
+    sock->SendFrame(std::vector<uint8_t>(my_addr.begin(), my_addr.end()));
+    std::vector<uint8_t> blob;
+    if (!sock->RecvFrame(blob).ok()) {
+      *status = Status::Error("address table recv failed");
+      return nullptr;
+    }
+    net->peers_[0] = std::move(sock);
+    // Parse table.
+    std::vector<std::string> table(size);
+    size_t off = 0;
+    for (int i = 0; i < size; ++i) {
+      uint32_t n;
+      memcpy(&n, blob.data() + off, 4);
+      off += 4;
+      table[i].assign(reinterpret_cast<const char*>(blob.data() + off), n);
+      off += n;
+    }
+    // Full mesh: connect to all lower ranks (>0), accept from higher ranks.
+    for (int peer = 1; peer < rank; ++peer) {
+      std::string host;
+      uint16_t port;
+      ParseAddr(table[peer], &host, &port);
+      int pfd = DialRetry(host, port);
+      if (pfd < 0) {
+        *status = Status::Error("cannot reach peer " + table[peer]);
+        return nullptr;
+      }
+      auto psock = std::make_unique<Socket>(pfd);
+      int32_t me = rank;
+      psock->SendAll(&me, 4);
+      net->peers_[peer] = std::move(psock);
+    }
+    for (int peer = rank + 1; peer < size; ++peer) {
+      int pfd = ::accept(listen_fd, nullptr, nullptr);
+      if (pfd < 0) {
+        *status = Status::Error("peer accept failed");
+        return nullptr;
+      }
+      SetNoDelay(pfd);
+      auto psock = std::make_unique<Socket>(pfd);
+      int32_t peer_rank;
+      psock->RecvAll(&peer_rank, 4);
+      net->peers_[peer_rank] = std::move(psock);
+    }
+  }
+  ::close(listen_fd);
+  *status = Status::OK();
+  return net;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,58 @@
+// TCP transport: full-mesh peer connections bootstrapped through the
+// coordinator (rank 0).
+//
+// Capability parity with the reference's Gloo context creation
+// (gloo/gloo_context.cc:66-160: TCP devices + rendezvous KV): rank 0 binds
+// the address the launcher exported (HVD_TPU_CONTROLLER_ADDR), workers dial
+// in, the address table is broadcast, then every pair connects directly.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class Socket {
+ public:
+  explicit Socket(int fd) : fd_(fd) {}
+  ~Socket();
+  Socket(const Socket&) = delete;
+
+  Status SendAll(const void* data, size_t n);
+  Status RecvAll(void* data, size_t n);
+  // Frame = u32 little-endian length + payload.
+  Status SendFrame(const std::vector<uint8_t>& payload);
+  Status RecvFrame(std::vector<uint8_t>& payload);
+  int fd() const { return fd_; }
+
+ private:
+  int fd_;
+};
+
+class Network {
+ public:
+  // Establish the full mesh. coord_addr: "host:port" of rank 0's listener.
+  // Returns nullptr + error status on failure.
+  static std::unique_ptr<Network> Connect(int rank, int size,
+                                          const std::string& coord_addr,
+                                          Status* status);
+  ~Network() = default;
+
+  Socket* peer(int r) { return peers_[r].get(); }
+  Socket* coordinator() { return peers_[0].get(); }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+ private:
+  Network(int rank, int size) : rank_(rank), size_(size) {
+    peers_.resize(size);
+  }
+  int rank_;
+  int size_;
+  std::vector<std::unique_ptr<Socket>> peers_;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,112 @@
+// The native runtime: background thread, tensor queue, fusion buffer,
+// execution of negotiated collectives, async handles.
+//
+// Capability parity with the reference core (operations.cc:353-587
+// BackgroundThreadLoop / RunLoopOnce, tensor_queue.h:28-66 TensorQueue with
+// duplicate-name rejection, fusion_buffer_manager.h FusionBufferManager,
+// global_state.h HorovodGlobalState): framework threads enqueue named
+// tensors; the background thread announces them to the controller each
+// cycle, packs ready fused sets into the fusion buffer, runs the TCP ring
+// data plane, and resolves handles.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "collectives.h"
+#include "common.h"
+#include "controller.h"
+#include "net.h"
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+struct HandleState {
+  std::atomic<bool> done{false};
+  Status status;
+  std::shared_ptr<TensorEntry> entry;  // keeps var_output alive
+};
+
+class Runtime {
+ public:
+  static Runtime& Get();
+
+  Status Init(int rank, int size, const std::string& coord_addr,
+              int64_t fusion_threshold, double cycle_time_ms,
+              double stall_warning_s, double stall_shutdown_s,
+              const std::string& timeline_file);
+  void Shutdown();
+  bool initialized() const { return initialized_; }
+  int rank() const { return net_ ? net_->rank() : 0; }
+  int size() const { return net_ ? net_->size() : 1; }
+
+  // Returns handle id, or -1 with *status set (e.g. duplicate name).
+  int64_t Enqueue(std::shared_ptr<TensorEntry> entry, Status* status);
+  bool Poll(int64_t handle);
+  Status Wait(int64_t handle);  // blocks; does NOT release
+  std::shared_ptr<TensorEntry> GetEntry(int64_t handle);
+  void Release(int64_t handle);
+
+  int JoinBlocking();
+  Status BarrierBlocking();
+  void StartTimeline(const std::string& filename);
+  void StopTimeline();
+
+ private:
+  Runtime() = default;
+  void BackgroundLoop();
+  void ExecuteResponse(const Response& resp);
+  void ExecuteAllreduce(const Response& resp,
+                        std::vector<std::shared_ptr<TensorEntry>>& entries);
+  void ExecuteAllgather(const Response& resp,
+                        std::shared_ptr<TensorEntry> entry);
+  void ExecuteBroadcast(const Response& resp,
+                        std::shared_ptr<TensorEntry> entry);
+  void ExecuteAlltoall(const Response& resp,
+                       std::shared_ptr<TensorEntry> entry);
+  std::shared_ptr<TensorEntry> TakeSubmitted(const std::string& name);
+  void Finish(std::shared_ptr<TensorEntry>& e, const Status& s);
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> stop_{false};
+  std::unique_ptr<Network> net_;
+  std::unique_ptr<Controller> controller_;
+  std::thread background_;
+  double cycle_time_ms_ = 1.0;
+
+  std::mutex mu_;
+  std::condition_variable enqueue_cv_;
+  // Pending = enqueued, not yet announced. Submitted = announced, awaiting
+  // response. Both keyed by name; duplicate names across the union rejected.
+  std::map<std::string, std::shared_ptr<TensorEntry>> pending_;
+  std::vector<std::string> pending_order_;
+  std::map<std::string, std::shared_ptr<TensorEntry>> submitted_;
+
+  std::mutex handle_mu_;
+  std::condition_variable handle_cv_;
+  int64_t next_handle_ = 0;
+  std::map<int64_t, std::shared_ptr<HandleState>> handles_;
+  std::map<std::string, int64_t> name_to_handle_;
+
+  // Join/barrier signaling.
+  std::mutex sync_mu_;
+  std::condition_variable sync_cv_;
+  std::atomic<bool> join_requested_{false};
+  std::atomic<bool> barrier_requested_{false};
+  int last_joined_rank_ = -2;  // -2 = no join completed yet
+  bool barrier_released_ = false;
+
+  std::vector<uint8_t> fusion_buffer_;
+  int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  Timeline timeline_;
+  Status loop_error_;
+};
+
+}  // namespace hvdtpu

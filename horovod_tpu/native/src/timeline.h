@@ -1,0 +1,51 @@
+// Chrome-tracing timeline writer.
+//
+// Capability parity with the reference Timeline (timeline.h:36-168,
+// timeline.cc:443-640): per-tensor phases (NEGOTIATE → operation →
+// activities) written as Chrome trace events on a dedicated writer thread,
+// enabled by HOROVOD_TIMELINE / HVD_TPU_TIMELINE or started at runtime.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline() { Stop(); }
+  void Start(const std::string& filename, int rank);
+  void Stop();
+  bool active() const { return active_; }
+
+  // ph: "B" begin / "E" end / "i" instant. category groups rows.
+  void Record(const std::string& name, const char* ph,
+              const std::string& category);
+  void MarkCycle();
+
+ private:
+  void WriterLoop();
+  struct Event {
+    std::string name;
+    std::string cat;
+    char ph;
+    int64_t ts_us;
+  };
+  std::atomic<bool> active_{false};
+  bool stop_requested_ = false;
+  int rank_ = 0;
+  FILE* file_ = nullptr;
+  bool first_event_ = true;
+  std::chrono::steady_clock::time_point t0_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<Event> queue_;
+  std::thread writer_;
+};
+
+}  // namespace hvdtpu
