@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from . import state as _state
-from .config import Config
+from .config import Config, get_env as _cfg_get
 from .exceptions import NotInitializedError
 from .state import global_state, _env_int
 from ..utils import logging as log
@@ -81,10 +81,7 @@ def init(mesh=None,
 
     # --- eager-path controller -------------------------------------------
     if use_controller is None:
-        import os
-        use_controller = bool(
-            os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
-            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))
+        use_controller = bool(_cfg_get("CONTROLLER_ADDR"))
     if use_controller:
         from ..native import runtime as native_runtime
         global_state.controller = native_runtime.attach()

@@ -1,0 +1,262 @@
+"""ctypes bindings to the native runtime (libhvdtpu_core.so).
+
+The analog of the reference's ``HorovodBasics`` ctypes layer
+(common/basics.py:22-75) plus the per-op enqueue wrappers the torch bridge
+generates (torch/mpi_ops_v2.cc).  All eager ops are synchronous at this
+level; async handles are layered above in ops/collective.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import config as _config
+
+_DTYPE_CODES = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,
+    np.dtype(np.float32): 5,
+    np.dtype(np.float64): 6,
+    np.dtype(np.bool_): 7,
+}
+# bfloat16 (code 8) is translated through its 2-byte view when ml_dtypes is
+# available; jax arrays are converted by the caller.
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libhvdtpu_core.so")
+
+
+def _ensure_built() -> str:
+    path = _lib_path()
+    if not os.path.exists(path):
+        src = os.path.join(os.path.dirname(path), "src")
+        subprocess.run(["make", "-C", src], check=True,
+                       capture_output=True)
+    return path
+
+
+_lib = None
+
+
+def load_library():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_ensure_built())
+    lib.hvd_native_init.restype = ctypes.c_int
+    lib.hvd_native_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_char_p]
+    lib.hvd_native_rank.restype = ctypes.c_int
+    lib.hvd_native_size.restype = ctypes.c_int
+    lib.hvd_native_initialized.restype = ctypes.c_int
+    for fn in ("hvd_native_allreduce", "hvd_native_allgather",
+               "hvd_native_broadcast", "hvd_native_alltoall"):
+        getattr(lib, fn).restype = ctypes.c_int64
+    lib.hvd_native_allreduce.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double]
+    lib.hvd_native_allgather.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.hvd_native_broadcast.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_alltoall.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.hvd_native_poll.restype = ctypes.c_int
+    lib.hvd_native_poll.argtypes = [ctypes.c_int64]
+    lib.hvd_native_wait.restype = ctypes.c_int
+    lib.hvd_native_wait.argtypes = [ctypes.c_int64]
+    lib.hvd_native_result_bytes.restype = ctypes.c_int64
+    lib.hvd_native_result_bytes.argtypes = [ctypes.c_int64]
+    lib.hvd_native_result_dims.restype = ctypes.c_int
+    lib.hvd_native_result_dims.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.hvd_native_result_copy.restype = ctypes.c_int
+    lib.hvd_native_result_copy.argtypes = [
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+    lib.hvd_native_release.argtypes = [ctypes.c_int64]
+    lib.hvd_native_join.restype = ctypes.c_int
+    lib.hvd_native_barrier.restype = ctypes.c_int
+    lib.hvd_native_last_error.restype = ctypes.c_char_p
+    lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def _dtype_code(arr: np.ndarray) -> int:
+    code = _DTYPE_CODES.get(arr.dtype)
+    if code is None:
+        raise TypeError(f"unsupported dtype {arr.dtype} for native path")
+    return code
+
+
+def _shape_arg(arr: np.ndarray):
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (1,)))
+    return arr.ndim, shape
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+class NativeController:
+    """Synchronous eager collectives through the native runtime."""
+
+    def __init__(self, rank: int, size: int, coord_addr: str):
+        self._lib = load_library()
+        cfg = _config.Config.from_env()
+        rc = self._lib.hvd_native_init(
+            rank, size, coord_addr.encode(),
+            cfg.fusion_threshold_bytes, cfg.cycle_time_ms,
+            1e9 if cfg.stall_check_disable else cfg.stall_warning_time_seconds,
+            cfg.stall_shutdown_time_seconds,
+            cfg.timeline_filename.encode())
+        if rc != 0:
+            raise NativeError(self._last_error())
+        self._counters = {}
+
+    @classmethod
+    def from_env(cls) -> "NativeController":
+        addr = _config.get_env("CONTROLLER_ADDR")
+        if not addr:
+            raise NativeError("HVD_TPU_CONTROLLER_ADDR not set")
+        rank = int(_config.get_env("CONTROLLER_RANK",
+                                   _config.get_env("RANK", "0")))
+        size = int(_config.get_env("CONTROLLER_SIZE",
+                                   _config.get_env("SIZE", "1")))
+        return cls(rank, size, addr)
+
+    def _last_error(self) -> str:
+        return (self._lib.hvd_native_last_error() or b"").decode()
+
+    def _auto_name(self, kind: str, name: Optional[str]) -> bytes:
+        if name is not None:
+            return name.encode()
+        # Deterministic auto names: call order must match across ranks, the
+        # same contract as the reference's handle-indexed auto names.
+        n = self._counters.get(kind, 0)
+        self._counters[kind] = n + 1
+        return f"{kind}.noname.{n}".encode()
+
+    def _wait(self, handle: int):
+        if handle < 0:
+            raise NativeError(self._last_error())
+        if self._lib.hvd_native_wait(handle) != 0:
+            err = self._last_error()
+            self._lib.hvd_native_release(handle)
+            raise NativeError(err)
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, arr: np.ndarray, op: int = 1,
+                  prescale: float = 1.0, postscale: float = 1.0,
+                  name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        out = np.empty_like(arr)
+        ndim, shape = _shape_arg(arr)
+        h = self._lib.hvd_native_allreduce(
+            self._auto_name("allreduce", name),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ndim, shape, _dtype_code(arr), op, prescale, postscale)
+        self._wait(h)
+        self._lib.hvd_native_release(h)
+        return out
+
+    def allgather(self, arr: np.ndarray,
+                  name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        ndim, shape = _shape_arg(arr)
+        h = self._lib.hvd_native_allgather(
+            self._auto_name("allgather", name),
+            arr.ctypes.data_as(ctypes.c_void_p), ndim, shape,
+            _dtype_code(arr))
+        self._wait(h)
+        nbytes = self._lib.hvd_native_result_bytes(h)
+        dims = (ctypes.c_int64 * self.size())()
+        self._lib.hvd_native_result_dims(h, dims, self.size())
+        total_rows = sum(dims)
+        out = np.empty((total_rows,) + arr.shape[1:], dtype=arr.dtype)
+        assert out.nbytes >= nbytes
+        self._lib.hvd_native_result_copy(
+            h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+        self._lib.hvd_native_release(h)
+        return out
+
+    def broadcast(self, arr: np.ndarray, root_rank: int = 0,
+                  name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        out = arr.copy()
+        ndim, shape = _shape_arg(arr)
+        h = self._lib.hvd_native_broadcast(
+            self._auto_name("broadcast", name),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ndim, shape, _dtype_code(arr), root_rank)
+        self._wait(h)
+        self._lib.hvd_native_release(h)
+        return out
+
+    def alltoall(self, arr: np.ndarray,
+                 splits: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        arr = np.ascontiguousarray(arr)
+        size = self.size()
+        if splits is None:
+            if arr.shape[0] % size != 0:
+                raise ValueError("alltoall dim0 not divisible by size")
+            splits = [arr.shape[0] // size] * size
+        sp = (ctypes.c_int64 * len(splits))(*splits)
+        ndim, shape = _shape_arg(arr)
+        h = self._lib.hvd_native_alltoall(
+            self._auto_name("alltoall", name),
+            arr.ctypes.data_as(ctypes.c_void_p), ndim, shape,
+            _dtype_code(arr), sp, len(splits))
+        self._wait(h)
+        dims = (ctypes.c_int64 * size)()
+        self._lib.hvd_native_result_dims(h, dims, size)
+        recv_splits = np.array(list(dims), dtype=np.int32)
+        out = np.empty((int(recv_splits.sum()),) + arr.shape[1:],
+                       dtype=arr.dtype)
+        self._lib.hvd_native_result_copy(
+            h, out.ctypes.data_as(ctypes.c_void_p), max(out.nbytes, 1))
+        self._lib.hvd_native_release(h)
+        return out, recv_splits
+
+    def join(self) -> int:
+        return self._lib.hvd_native_join()
+
+    def barrier(self):
+        if self._lib.hvd_native_barrier() != 0:
+            raise NativeError(self._last_error())
+
+    def rank(self) -> int:
+        return self._lib.hvd_native_rank()
+
+    def size(self) -> int:
+        return self._lib.hvd_native_size()
+
+    def start_timeline(self, filename: str):
+        self._lib.hvd_native_start_timeline(filename.encode())
+
+    def stop_timeline(self):
+        self._lib.hvd_native_stop_timeline()
+
+    def shutdown(self):
+        self._lib.hvd_native_shutdown()

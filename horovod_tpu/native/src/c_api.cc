@@ -1,0 +1,164 @@
+// extern "C" API consumed by the ctypes layer (native/controller.py).
+//
+// Capability parity with the reference's C API (operations.cc:703-915:
+// horovod_init/shutdown/rank/size + EnqueueTensor* reached through the
+// framework bridges) — here a single flat C surface since the only bridge
+// is Python/numpy.
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "runtime.h"
+
+using namespace hvdtpu;
+
+namespace {
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void SetError(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_last_error = msg;
+}
+
+std::shared_ptr<TensorEntry> MakeEntry(const char* name, RequestType type,
+                                       const void* input, void* output,
+                                       int ndim, const int64_t* shape,
+                                       int dtype) {
+  auto e = std::make_shared<TensorEntry>();
+  e->name = name;
+  e->type = type;
+  e->dtype = static_cast<DataType>(dtype);
+  e->shape.assign(shape, shape + ndim);
+  e->input = input;
+  e->output = output;
+  return e;
+}
+
+int64_t EnqueueChecked(std::shared_ptr<TensorEntry> e) {
+  Status st;
+  int64_t h = Runtime::Get().Enqueue(std::move(e), &st);
+  if (h < 0) SetError(st.reason);
+  return h;
+}
+}  // namespace
+
+extern "C" {
+
+int hvd_native_init(int rank, int size, const char* coord_addr,
+                    int64_t fusion_threshold, double cycle_time_ms,
+                    double stall_warning_s, double stall_shutdown_s,
+                    const char* timeline_file) {
+  Status st = Runtime::Get().Init(rank, size, coord_addr, fusion_threshold,
+                                  cycle_time_ms, stall_warning_s,
+                                  stall_shutdown_s,
+                                  timeline_file ? timeline_file : "");
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  return 0;
+}
+
+void hvd_native_shutdown() { Runtime::Get().Shutdown(); }
+
+int hvd_native_initialized() { return Runtime::Get().initialized() ? 1 : 0; }
+int hvd_native_rank() { return Runtime::Get().rank(); }
+int hvd_native_size() { return Runtime::Get().size(); }
+
+int64_t hvd_native_allreduce(const char* name, const void* input,
+                             void* output, int ndim, const int64_t* shape,
+                             int dtype, int op, double prescale,
+                             double postscale) {
+  auto e = MakeEntry(name, RequestType::ALLREDUCE, input, output, ndim,
+                     shape, dtype);
+  e->op = static_cast<ReduceOp>(op);
+  e->prescale = prescale;
+  e->postscale = postscale;
+  return EnqueueChecked(std::move(e));
+}
+
+int64_t hvd_native_allgather(const char* name, const void* input, int ndim,
+                             const int64_t* shape, int dtype) {
+  return EnqueueChecked(MakeEntry(name, RequestType::ALLGATHER, input,
+                                  nullptr, ndim, shape, dtype));
+}
+
+int64_t hvd_native_broadcast(const char* name, const void* input,
+                             void* output, int ndim, const int64_t* shape,
+                             int dtype, int root_rank) {
+  auto e = MakeEntry(name, RequestType::BROADCAST, input, output, ndim,
+                     shape, dtype);
+  e->root_rank = root_rank;
+  return EnqueueChecked(std::move(e));
+}
+
+int64_t hvd_native_alltoall(const char* name, const void* input, int ndim,
+                            const int64_t* shape, int dtype,
+                            const int64_t* splits, int nsplits) {
+  auto e = MakeEntry(name, RequestType::ALLTOALL, input, nullptr, ndim,
+                     shape, dtype);
+  e->splits.assign(splits, splits + nsplits);
+  return EnqueueChecked(std::move(e));
+}
+
+int hvd_native_poll(int64_t handle) {
+  return Runtime::Get().Poll(handle) ? 1 : 0;
+}
+
+// Blocks; returns 0 on success. Does not release the handle.
+int hvd_native_wait(int64_t handle) {
+  Status st = Runtime::Get().Wait(handle);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  return 0;
+}
+
+// Variable-size results (allgather/alltoall).
+int64_t hvd_native_result_bytes(int64_t handle) {
+  auto e = Runtime::Get().GetEntry(handle);
+  if (!e || !e->var_output) return -1;
+  return static_cast<int64_t>(e->var_output->size());
+}
+
+int hvd_native_result_dims(int64_t handle, int64_t* dims, int max_dims) {
+  auto e = Runtime::Get().GetEntry(handle);
+  if (!e) return -1;
+  int n = static_cast<int>(e->out_first_dims.size());
+  for (int i = 0; i < n && i < max_dims; ++i) dims[i] = e->out_first_dims[i];
+  return n;
+}
+
+int hvd_native_result_copy(int64_t handle, void* dst, int64_t nbytes) {
+  auto e = Runtime::Get().GetEntry(handle);
+  if (!e || !e->var_output ||
+      nbytes < static_cast<int64_t>(e->var_output->size()))
+    return -1;
+  memcpy(dst, e->var_output->data(), e->var_output->size());
+  return 0;
+}
+
+void hvd_native_release(int64_t handle) { Runtime::Get().Release(handle); }
+
+int hvd_native_join() { return Runtime::Get().JoinBlocking(); }
+
+int hvd_native_barrier() {
+  Status st = Runtime::Get().BarrierBlocking();
+  return st.ok() ? 0 : -1;
+}
+
+void hvd_native_start_timeline(const char* filename) {
+  Runtime::Get().StartTimeline(filename);
+}
+
+void hvd_native_stop_timeline() { Runtime::Get().StopTimeline(); }
+
+const char* hvd_native_last_error() {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  return g_last_error.c_str();
+}
+
+}  // extern "C"

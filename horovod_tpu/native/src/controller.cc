@@ -196,13 +196,18 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       resp.type = q.type;
       resp.names = {name};
       resp.dtype = q.dtype;
-      // sizes = first dims per rank (0 for joined ranks).
+      // sizes = first dims per rank (0 for joined ranks), then row_elems
+      // (product of trailing dims) as the final element so joined ranks can
+      // size their ring blocks.
       for (int r = 0; r < size; ++r) {
         auto itq = pt.by_rank.find(r);
         resp.sizes.push_back(
             itq == pt.by_rank.end() || itq->second.shape.empty()
                 ? 0 : itq->second.shape[0]);
       }
+      int64_t row_elems = 1;
+      for (size_t d = 1; d < q.shape.size(); ++d) row_elems *= q.shape[d];
+      resp.sizes.push_back(row_elems);
       rl.responses.push_back(resp);
       open_fusion = nullptr;
     } else if (q.type == RequestType::BROADCAST) {
@@ -219,8 +224,8 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       resp.type = q.type;
       resp.names = {name};
       resp.dtype = q.dtype;
-      // sizes = row-split matrix, row-major [src * size + dst]; joined
-      // ranks contribute zero rows.
+      // sizes = row-split matrix, row-major [src * size + dst], then
+      // row_elems appended; joined ranks contribute zero rows.
       resp.sizes.assign(static_cast<size_t>(size) * size, 0);
       for (int r = 0; r < size; ++r) {
         auto itq = pt.by_rank.find(r);
@@ -229,6 +234,9 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
           resp.sizes[static_cast<size_t>(r) * size + d] =
               itq->second.splits[d];
       }
+      int64_t a2a_row_elems = 1;
+      for (size_t d = 1; d < q.shape.size(); ++d) a2a_row_elems *= q.shape[d];
+      resp.sizes.push_back(a2a_row_elems);
       rl.responses.push_back(resp);
       open_fusion = nullptr;
     }

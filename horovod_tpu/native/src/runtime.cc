@@ -1,0 +1,424 @@
+#include "runtime.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+
+namespace hvdtpu {
+
+Runtime& Runtime::Get() {
+  static Runtime* runtime = new Runtime();
+  return *runtime;
+}
+
+Status Runtime::Init(int rank, int size, const std::string& coord_addr,
+                     int64_t fusion_threshold, double cycle_time_ms,
+                     double stall_warning_s, double stall_shutdown_s,
+                     const std::string& timeline_file) {
+  if (initialized_) return Status::OK();
+  Status st;
+  net_ = Network::Connect(rank, size, coord_addr, &st);
+  if (!net_) return st;
+  ControllerConfig ccfg;
+  ccfg.fusion_threshold_bytes = fusion_threshold;
+  ccfg.stall_warning_s = stall_warning_s;
+  ccfg.stall_shutdown_s = stall_shutdown_s;
+  controller_ = std::make_unique<Controller>(net_.get(), ccfg);
+  fusion_threshold_ = fusion_threshold;
+  cycle_time_ms_ = cycle_time_ms;
+  if (!timeline_file.empty()) timeline_.Start(timeline_file, rank);
+  stop_ = false;
+  loop_error_ = Status::OK();
+  background_ = std::thread([this] { BackgroundLoop(); });
+  initialized_ = true;
+  return Status::OK();
+}
+
+void Runtime::Shutdown() {
+  if (!initialized_) return;
+  stop_ = true;
+  enqueue_cv_.notify_all();
+  if (background_.joinable()) background_.join();
+  timeline_.Stop();
+  // Fail any remaining entries (FinalizeTensorQueue semantics,
+  // tensor_queue.cc).
+  std::vector<std::shared_ptr<TensorEntry>> leftovers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& [n, e] : pending_) leftovers.push_back(e);
+    for (auto& [n, e] : submitted_) leftovers.push_back(e);
+    pending_.clear();
+    pending_order_.clear();
+    submitted_.clear();
+  }
+  for (auto& e : leftovers)
+    Finish(e, Status::Aborted("runtime shut down with pending tensors"));
+  net_.reset();
+  controller_.reset();
+  initialized_ = false;
+}
+
+int64_t Runtime::Enqueue(std::shared_ptr<TensorEntry> entry, Status* status) {
+  if (!initialized_) {
+    *status = Status::PreconditionError("runtime not initialized");
+    return -1;
+  }
+  std::shared_ptr<HandleState> hs = std::make_shared<HandleState>();
+  hs->entry = entry;
+  int64_t id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (pending_.count(entry->name) || submitted_.count(entry->name)) {
+      // DUPLICATE_NAME_ERROR (reference common.h:169-172).
+      *status = Status::InvalidArgument(
+          "a tensor named " + entry->name +
+          " is already in flight; use distinct names for concurrent ops");
+      return -1;
+    }
+    pending_[entry->name] = entry;
+    pending_order_.push_back(entry->name);
+  }
+  {
+    std::lock_guard<std::mutex> lk(handle_mu_);
+    id = next_handle_++;
+    handles_[id] = hs;
+    name_to_handle_[entry->name] = id;
+  }
+  timeline_.Record(entry->name, "B", "NEGOTIATE");
+  enqueue_cv_.notify_one();
+  *status = Status::OK();
+  return id;
+}
+
+bool Runtime::Poll(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() || it->second->done.load();
+}
+
+Status Runtime::Wait(int64_t handle) {
+  std::unique_lock<std::mutex> lk(handle_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return Status::InvalidArgument("unknown handle");
+  auto hs = it->second;
+  handle_cv_.wait(lk, [&] { return hs->done.load(); });
+  return hs->status;
+}
+
+std::shared_ptr<TensorEntry> Runtime::GetEntry(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() ? nullptr : it->second->entry;
+}
+
+void Runtime::Release(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  auto it = handles_.find(handle);
+  if (it != handles_.end()) {
+    if (it->second->entry) name_to_handle_.erase(it->second->entry->name);
+    handles_.erase(it);
+  }
+}
+
+void Runtime::Finish(std::shared_ptr<TensorEntry>& e, const Status& s) {
+  if (!e) return;
+  timeline_.Record(e->name, "E", "OPERATION");
+  int64_t hid = -1;
+  std::shared_ptr<HandleState> hs;
+  {
+    std::lock_guard<std::mutex> lk(handle_mu_);
+    auto it = name_to_handle_.find(e->name);
+    if (it != name_to_handle_.end()) {
+      hid = it->second;
+      hs = handles_[hid];
+    }
+  }
+  if (hs) {
+    hs->status = s;
+    hs->done = true;
+    handle_cv_.notify_all();
+  }
+  if (e->callback) e->callback(s);
+}
+
+std::shared_ptr<TensorEntry> Runtime::TakeSubmitted(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = submitted_.find(name);
+  if (it == submitted_.end()) return nullptr;
+  auto e = it->second;
+  submitted_.erase(it);
+  return e;
+}
+
+void Runtime::BackgroundLoop() {
+  using clock = std::chrono::steady_clock;
+  while (!stop_) {
+    auto cycle_start = clock::now();
+    // 1. Drain pending into a RequestList.
+    RequestList rl;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      // Sleep to cycle time unless new work arrives (RunLoopOnce,
+      // operations.cc:592-598).
+      enqueue_cv_.wait_for(
+          lk, std::chrono::duration<double, std::milli>(cycle_time_ms_),
+          [this] { return stop_.load(); });
+      for (const auto& name : pending_order_) {
+        auto it = pending_.find(name);
+        if (it == pending_.end()) continue;
+        auto& e = it->second;
+        Request q;
+        q.type = e->type;
+        q.rank = net_->rank();
+        q.name = e->name;
+        q.dtype = e->dtype;
+        q.shape = e->shape;
+        q.op = e->op;
+        q.root_rank = e->root_rank;
+        q.prescale = e->prescale;
+        q.postscale = e->postscale;
+        q.splits = e->splits;
+        rl.requests.push_back(std::move(q));
+        submitted_[name] = e;
+      }
+      for (const auto& q : rl.requests) pending_.erase(q.name);
+      pending_order_.clear();
+    }
+    rl.join = join_requested_.load();
+    rl.barrier = barrier_requested_.load();
+    rl.shutdown = stop_.load();
+
+    // 2. Controller round.
+    ResponseList responses;
+    Status st = controller_->Exchange(rl, &responses);
+    if (!st.ok()) {
+      loop_error_ = st;
+      // Fail everything in flight and stop.
+      std::vector<std::shared_ptr<TensorEntry>> all;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (auto& [n, e] : submitted_) all.push_back(e);
+        submitted_.clear();
+      }
+      for (auto& e : all) Finish(e, st);
+      break;
+    }
+    timeline_.MarkCycle();
+
+    // 3. Execute responses in coordinator order (identical on all ranks).
+    for (const auto& resp : responses.responses) ExecuteResponse(resp);
+
+    // 4. Join / barrier releases.
+    if (responses.last_joined_rank >= 0) {
+      std::lock_guard<std::mutex> lk(sync_mu_);
+      last_joined_rank_ = responses.last_joined_rank;
+      join_requested_ = false;
+      sync_cv_.notify_all();
+    }
+    if (responses.barrier_release) {
+      std::lock_guard<std::mutex> lk(sync_mu_);
+      barrier_released_ = true;
+      barrier_requested_ = false;
+      sync_cv_.notify_all();
+    }
+    if (responses.shutdown) break;
+    (void)cycle_start;
+  }
+}
+
+void Runtime::ExecuteResponse(const Response& resp) {
+  if (!resp.error.empty()) {
+    for (const auto& name : resp.names) {
+      auto e = TakeSubmitted(name);
+      if (e) Finish(e, Status::Error(resp.error));
+    }
+    return;
+  }
+  switch (resp.type) {
+    case RequestType::ALLREDUCE: {
+      std::vector<std::shared_ptr<TensorEntry>> entries;
+      for (const auto& name : resp.names) entries.push_back(
+          TakeSubmitted(name));
+      ExecuteAllreduce(resp, entries);
+      break;
+    }
+    case RequestType::ALLGATHER:
+      ExecuteAllgather(resp, TakeSubmitted(resp.names[0]));
+      break;
+    case RequestType::BROADCAST:
+      ExecuteBroadcast(resp, TakeSubmitted(resp.names[0]));
+      break;
+    case RequestType::ALLTOALL:
+      ExecuteAlltoall(resp, TakeSubmitted(resp.names[0]));
+      break;
+    default:
+      break;
+  }
+}
+
+void Runtime::ExecuteAllreduce(
+    const Response& resp,
+    std::vector<std::shared_ptr<TensorEntry>>& entries) {
+  // resp.sizes[i] = element count of names[i] (authoritative — joined ranks
+  // have no local entry and synthesize zeros).
+  int64_t total_elems = 0;
+  for (auto n : resp.sizes) total_elems += n;
+  const size_t elem = DataTypeSize(resp.dtype);
+  const size_t total_bytes = total_elems * elem;
+  if (fusion_buffer_.size() < total_bytes) fusion_buffer_.resize(total_bytes);
+  uint8_t* fb = fusion_buffer_.data();
+
+  // Pack (MemcpyInFusionBuffer, collective_operations.cc).
+  timeline_.Record(resp.names[0], "B", "MEMCPY_IN_FUSION_BUFFER");
+  int64_t off = 0;
+  for (size_t i = 0; i < resp.names.size(); ++i) {
+    int64_t nbytes = resp.sizes[i] * elem;
+    if (entries[i] && entries[i]->input) {
+      memcpy(fb + off, entries[i]->input, nbytes);
+    } else {
+      memset(fb + off, 0, nbytes);  // joined-rank zero proxy
+    }
+    off += nbytes;
+  }
+  timeline_.Record(resp.names[0], "E", "MEMCPY_IN_FUSION_BUFFER");
+
+  if (resp.prescale != 1.0)
+    ScaleBuffer(fb, total_elems, resp.dtype, resp.prescale);
+
+  timeline_.Record(resp.names[0], "B", "RING_ALLREDUCE");
+  Status st;
+  if (resp.op == ReduceOp::ADASUM) {
+    st = AdasumAllreduce(*net_, fb, total_elems, resp.dtype);
+  } else {
+    st = RingAllreduce(*net_, fb, total_elems, resp.dtype, resp.op);
+  }
+  timeline_.Record(resp.names[0], "E", "RING_ALLREDUCE");
+
+  if (st.ok()) {
+    if (resp.op == ReduceOp::AVERAGE)
+      ScaleBuffer(fb, total_elems, resp.dtype, 1.0 / net_->size());
+    if (resp.postscale != 1.0)
+      ScaleBuffer(fb, total_elems, resp.dtype, resp.postscale);
+    // Unpack.
+    off = 0;
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      int64_t nbytes = resp.sizes[i] * elem;
+      if (entries[i] && entries[i]->output)
+        memcpy(entries[i]->output, fb + off, nbytes);
+      off += nbytes;
+    }
+  }
+  for (auto& e : entries)
+    if (e) Finish(e, st);
+}
+
+void Runtime::ExecuteAllgather(const Response& resp,
+                               std::shared_ptr<TensorEntry> entry) {
+  const int size = net_->size();
+  const int rank = net_->rank();
+  const size_t elem = DataTypeSize(resp.dtype);
+  // resp.sizes = [first_dim per rank ..., row_elems]; row_elems from the
+  // coordinator so joined ranks (no local entry) can still size their ring
+  // blocks and forward peers' data.
+  const int64_t row_elems = resp.sizes[size];
+  std::vector<int64_t> bytes(size), offsets(size);
+  int64_t total = 0;
+  for (int r = 0; r < size; ++r) {
+    bytes[r] = resp.sizes[r] * row_elems * elem;
+    offsets[r] = total;
+    total += bytes[r];
+  }
+  auto out = std::make_shared<std::vector<uint8_t>>(
+      std::max<int64_t>(total, 1));
+  if (entry && entry->input)
+    memcpy(out->data() + offsets[rank], entry->input, bytes[rank]);
+  if (entry) timeline_.Record(entry->name, "B", "RING_ALLGATHER");
+  Status st = RingAllgatherv(*net_, out->data(), bytes, offsets);
+  if (entry) {
+    timeline_.Record(entry->name, "E", "RING_ALLGATHER");
+    entry->var_output = out;
+    entry->out_first_dims.assign(resp.sizes.begin(),
+                                 resp.sizes.begin() + size);
+    Finish(entry, st);
+  }
+}
+
+void Runtime::ExecuteBroadcast(const Response& resp,
+                               std::shared_ptr<TensorEntry> entry) {
+  const size_t elem = DataTypeSize(resp.dtype);
+  const int64_t nbytes = resp.sizes[0] * elem;
+  std::vector<uint8_t> scratch;
+  void* buf;
+  if (entry && entry->output) {
+    if (net_->rank() == resp.root_rank && entry->input != entry->output)
+      memcpy(entry->output, entry->input, nbytes);
+    buf = entry->output;
+  } else {
+    scratch.resize(nbytes);
+    buf = scratch.data();  // joined-rank proxy participates in the chain
+  }
+  Status st = ChainBroadcast(*net_, buf, nbytes, resp.root_rank);
+  if (entry) Finish(entry, st);
+}
+
+void Runtime::ExecuteAlltoall(const Response& resp,
+                              std::shared_ptr<TensorEntry> entry) {
+  const int size = net_->size();
+  const int rank = net_->rank();
+  const size_t elem = DataTypeSize(resp.dtype);
+  // resp.sizes = row-split matrix [src * size + dst] + trailing row_elems
+  // (coordinator-supplied so joined ranks size their exchanges correctly).
+  const int64_t row_elems = resp.sizes[static_cast<size_t>(size) * size];
+  std::vector<int64_t> send_bytes(size), recv_bytes(size);
+  int64_t total_recv = 0;
+  for (int d = 0; d < size; ++d)
+    send_bytes[d] =
+        (entry ? resp.sizes[static_cast<size_t>(rank) * size + d] : 0) *
+        row_elems * elem;
+  for (int s = 0; s < size; ++s) {
+    recv_bytes[s] = resp.sizes[static_cast<size_t>(s) * size + rank] *
+                    row_elems * elem;
+    total_recv += recv_bytes[s];
+  }
+  auto out = std::make_shared<std::vector<uint8_t>>(
+      std::max<int64_t>(total_recv, 1));
+  const uint8_t* send =
+      entry ? static_cast<const uint8_t*>(entry->input) : out->data();
+  Status st = PairwiseAlltoallv(*net_, send, send_bytes, out->data(),
+                                recv_bytes);
+  if (entry) {
+    entry->var_output = out;
+    entry->out_first_dims.resize(size);
+    for (int s = 0; s < size; ++s)
+      entry->out_first_dims[s] =
+          resp.sizes[static_cast<size_t>(s) * size + rank];
+    Finish(entry, st);
+  }
+}
+
+int Runtime::JoinBlocking() {
+  join_requested_ = true;
+  enqueue_cv_.notify_one();
+  std::unique_lock<std::mutex> lk(sync_mu_);
+  sync_cv_.wait(lk, [this] { return last_joined_rank_ >= 0 || stop_; });
+  int r = last_joined_rank_;
+  last_joined_rank_ = -2;
+  return r;
+}
+
+Status Runtime::BarrierBlocking() {
+  barrier_requested_ = true;
+  enqueue_cv_.notify_one();
+  std::unique_lock<std::mutex> lk(sync_mu_);
+  sync_cv_.wait(lk, [this] { return barrier_released_ || stop_; });
+  barrier_released_ = false;
+  return Status::OK();
+}
+
+void Runtime::StartTimeline(const std::string& filename) {
+  timeline_.Start(filename, net_ ? net_->rank() : 0);
+}
+
+void Runtime::StopTimeline() { timeline_.Stop(); }
+
+}  // namespace hvdtpu

@@ -6,6 +6,7 @@
 // enabled by HOROVOD_TIMELINE / HVD_TPU_TIMELINE or started at runtime.
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>

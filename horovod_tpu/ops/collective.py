@@ -136,7 +136,8 @@ def allreduce(tensor,
                                    prescale_factor, postscale_factor)
     return _eager.allreduce(
         tensor, op_fn=_eager_op_fn(op, prescale_factor, postscale_factor),
-        name=name)
+        name=name, op_code=int(op), prescale=prescale_factor,
+        postscale=postscale_factor)
 
 
 def grouped_allreduce(tensors: Sequence,
@@ -233,8 +234,10 @@ def reducescatter(tensor, op: int = Average,
             raise ValueError("compiled reducescatter supports Sum/Average")
         return out
     from . import eager
-    fn = _eager_op_fn(Sum if op == Sum else Average, 1.0, 1.0)
-    return eager.reducescatter(tensor, op_fn=fn, name=name)
+    code = Sum if op == Sum else Average
+    fn = _eager_op_fn(code, 1.0, 1.0)
+    return eager.reducescatter(tensor, op_fn=fn, name=name,
+                               op_code=int(code))
 
 
 # ---------------------------------------------------------------------------

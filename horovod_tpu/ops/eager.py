@@ -78,11 +78,18 @@ def _run_global(fn, garr):
     return np.asarray(out.addressable_shards[0].data)
 
 
-def allreduce(tensor, op_fn, name: Optional[str] = None):
-    """op_fn: callable(stack: (P, ...) array) -> reduced array."""
+def allreduce(tensor, op_fn, name: Optional[str] = None,
+              op_code: Optional[int] = None,
+              prescale: float = 1.0, postscale: float = 1.0):
+    """op_fn: callable(stack: (P, ...) array) -> reduced array; op_code is
+    the ReduceOp code for the native controller path (which does not take
+    callables across the C boundary)."""
     ctl = _controller()
     if ctl is not None:
-        return ctl.allreduce(_np(tensor), op_fn=op_fn, name=name)
+        return ctl.allreduce(_np(tensor),
+                             op=1 if op_code is None else int(op_code),
+                             prescale=prescale, postscale=postscale,
+                             name=name)
     if global_state.process_count == 1:
         x = _np(tensor)
         return op_fn(x[None])
@@ -163,9 +170,10 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
             recv_splits.astype(np.int32))
 
 
-def reducescatter(tensor, op_fn, name: Optional[str] = None):
+def reducescatter(tensor, op_fn, name: Optional[str] = None,
+                  op_code: Optional[int] = None):
     """Reduce across processes then scatter equal dim-0 chunks."""
-    reduced = allreduce(tensor, op_fn=op_fn, name=name)
+    reduced = allreduce(tensor, op_fn=op_fn, name=name, op_code=op_code)
     p = global_state.process_count
     rows = reduced.shape[0]
     if rows % p != 0:
