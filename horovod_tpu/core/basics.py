@@ -54,9 +54,25 @@ def init(mesh=None,
     local_devices = jax.local_device_count()
     total_devices = jax.device_count()
 
+    # Elastic workers fetch their (re-)assignment from the rendezvous KV
+    # each init — the world may have changed since the last round.
+    elastic_assignment = None
+    import os as _os
+    if _os.environ.get("HVD_TPU_ELASTIC_SLOT"):
+        from ..runner.worker import fetch_assignment
+        elastic_assignment = fetch_assignment()
+        global_state.rank = elastic_assignment["rank"]
+        global_state.size = elastic_assignment["size"]
+        global_state.local_rank = elastic_assignment["local_rank"]
+        global_state.local_size = elastic_assignment["local_size"]
+        global_state.cross_rank = elastic_assignment["cross_rank"]
+        global_state.cross_size = elastic_assignment["cross_size"]
+
     env_rank = _env_int("RANK")
     env_size = _env_int("SIZE")
-    if env_rank is not None and env_size is not None:
+    if elastic_assignment is not None:
+        pass  # topology set above
+    elif env_rank is not None and env_size is not None:
         # Launcher-provided chip topology (one launched process per slot).
         global_state.rank = env_rank
         global_state.size = env_size
@@ -81,10 +97,17 @@ def init(mesh=None,
 
     # --- eager-path controller -------------------------------------------
     if use_controller is None:
-        use_controller = bool(_cfg_get("CONTROLLER_ADDR"))
+        use_controller = bool(_cfg_get("CONTROLLER_ADDR")) or \
+            elastic_assignment is not None
     if use_controller:
         from ..native import runtime as native_runtime
-        global_state.controller = native_runtime.attach()
+        if elastic_assignment is not None:
+            global_state.controller = native_runtime.attach(
+                rank=elastic_assignment["rank"],
+                size=elastic_assignment["size"],
+                coord_addr=elastic_assignment["controller_addr"])
+        else:
+            global_state.controller = native_runtime.attach()
 
     global_state.elastic_enabled = global_state.config.elastic
     global_state.initialized = True

@@ -54,6 +54,7 @@ class State:
 
     def commit(self):
         self.save()
+        notification_manager.poll()
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -202,26 +203,21 @@ def run(func: Callable) -> Callable:
 
 
 class WorkerNotificationManager:
-    """Receives host-update notifications from the elastic driver and fans
-    them out to registered State objects (reference
-    runner/elastic/worker.py)."""
+    """Surfaces host-update events from the elastic driver to registered
+    State objects (reference runner/elastic/worker.py's notification
+    service).  Pull-based: ``poll()`` — called from ``State.commit()`` —
+    checks the rendezvous KV's host-event key; the reference's push RPC
+    also only takes effect at commit, so semantics match."""
 
     def __init__(self):
         self._listeners = []
-        self._service = None
+        self._enabled = False
+        self._last_ts = 0.0
 
     def init(self):
-        if self._service is not None:
-            return
         import os
-        addr = os.environ.get("HVD_TPU_NOTIFY_ADDR") or \
-            os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
-        port = os.environ.get("HVD_TPU_NOTIFY_PORT")
-        if not addr or not port:
-            return  # not running under the elastic launcher
-        from ..runner.notification import WorkerNotificationService
-        self._service = WorkerNotificationService(self)
-        self._service.start()
+        self._enabled = bool(os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+                             and os.environ.get("HVD_TPU_ELASTIC_SLOT"))
 
     def register_listener(self, state: State):
         self._listeners.append(state)
@@ -229,6 +225,16 @@ class WorkerNotificationManager:
     def remove_listener(self, state: State):
         if state in self._listeners:
             self._listeners.remove(state)
+
+    def poll(self):
+        if not self._enabled:
+            return
+        from ..runner.worker import poll_host_event
+        event = poll_host_event(self._last_ts)
+        if event is not None:
+            self._last_ts = event["ts"]
+            self.handle_hosts_updated(event["ts"],
+                                      bool(event.get("added_only")))
 
     def handle_hosts_updated(self, timestamp, update_res):
         for listener in self._listeners:

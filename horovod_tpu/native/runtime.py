@@ -14,10 +14,14 @@ from __future__ import annotations
 from typing import Optional
 
 
-def attach() -> Optional[object]:
+def attach(rank: Optional[int] = None, size: Optional[int] = None,
+           coord_addr: Optional[str] = None) -> Optional[object]:
     """Attach the native controller if the shared library is available."""
     try:
         from . import controller
+        if coord_addr is not None:
+            return controller.NativeController(rank or 0, size or 1,
+                                               coord_addr)
         return controller.NativeController.from_env()
     except Exception:
         from ..utils import logging as log

@@ -55,6 +55,14 @@ void Runtime::Shutdown() {
     Finish(e, Status::Aborted("runtime shut down with pending tensors"));
   net_.reset();
   controller_.reset();
+  // Reset join/barrier state so an elastic re-init starts clean.
+  {
+    std::lock_guard<std::mutex> lk(sync_mu_);
+    last_joined_rank_ = -2;
+    barrier_released_ = false;
+  }
+  join_requested_ = false;
+  barrier_requested_ = false;
   initialized_ = false;
 }
 

@@ -1,0 +1,295 @@
+"""Elastic driver: dynamic world membership with host discovery, blacklist,
+re-rendezvous rounds and worker respawn.
+
+Capability parity with the reference elastic runner (runner/elastic/
+driver.py:69-313, discovery.py, registration.py): a background thread polls
+a user-provided host-discovery script; host additions/removals trigger a new
+rendezvous round; failed hosts are blacklisted; workers re-fetch their
+assignment from the rendezvous KV on every (re)init; the job fails when the
+world would drop below --min-np or the reset count exceeds --reset-limit.
+
+Differences from the reference, TPU-rationalized: worker notification is
+pull-based — workers poll the KV's host-event key at ``state.commit()``
+(the reference's push RPC also only surfaces at commit), and each round's
+assignment is published under ``elastic/round/<n>`` with a fresh controller
+port, because the native controller's world is fixed per init.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from . import exec as exec_mod
+from .hosts import HostInfo, SlotInfo, get_host_assignments, parse_hosts
+from .rendezvous import RendezvousServer
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user script; each output line is "hostname[:slots]"
+    (reference discovery.py:146-180)."""
+
+    def __init__(self, script: str, default_slots: int):
+        self._script = script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        out = subprocess.run([self._script], shell=False,
+                             capture_output=True, text=True, timeout=30)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed: {out.stderr.strip()}")
+        hosts = []
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                hosts.extend(parse_hosts(line))
+            else:
+                hosts.append(HostInfo(line, self._default_slots))
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Test discovery with a mutable host set (reference test pattern)."""
+
+    def __init__(self, hosts: List[HostInfo]):
+        self._hosts = hosts
+        self._lock = threading.Lock()
+
+    def set(self, hosts: List[HostInfo]):
+        with self._lock:
+            self._hosts = hosts
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        with self._lock:
+            return list(self._hosts)
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, command: List[str],
+                 min_np: int, max_np: Optional[int],
+                 controller_base_port: int = 27000,
+                 discovery_interval: float = 1.0,
+                 reset_limit: Optional[int] = None,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 verbose: bool = False):
+        self._discovery = discovery
+        self._command = command
+        self._min_np = min_np
+        self._max_np = max_np
+        self._base_port = controller_base_port
+        self._interval = float(os.environ.get(
+            "HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", discovery_interval))
+        self._reset_limit = reset_limit
+        self._extra_env = dict(extra_env or {})
+        self._verbose = verbose
+
+        self._rendezvous = RendezvousServer()
+        self._lock = threading.RLock()
+        self._round = -1
+        self._resets = 0
+        self._blacklist: Set[str] = set()
+        self._current_hosts: List[HostInfo] = []
+        self._workers: Dict[str, exec_mod.WorkerProcess] = {}  # slot_id →
+        self._shutdown = threading.Event()
+        self._finished: Dict[str, int] = {}
+        self._result: Optional[int] = None
+        self._result_cv = threading.Condition()
+
+    # -- public ------------------------------------------------------------
+
+    def run(self) -> int:
+        import socket
+        port = self._rendezvous.start()
+        self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = \
+            f"{socket.gethostname()}:{port}"
+        self._extra_env["HVD_TPU_ELASTIC"] = "1"
+        try:
+            hosts = self._discover_filtered()
+            if sum(h.slots for h in hosts) < self._min_np:
+                raise RuntimeError(
+                    f"not enough slots to reach --min-np {self._min_np}")
+            self._start_round(hosts)
+            watcher = threading.Thread(target=self._discovery_loop,
+                                       daemon=True)
+            watcher.start()
+            with self._result_cv:
+                self._result_cv.wait_for(lambda: self._result is not None)
+            return int(self._result)
+        finally:
+            self._shutdown.set()
+            with self._lock:
+                exec_mod.terminate_all(list(self._workers.values()))
+            self._rendezvous.stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _discover_filtered(self) -> List[HostInfo]:
+        hosts = self._discovery.find_available_hosts_and_slots()
+        hosts = [h for h in hosts if h.hostname not in self._blacklist]
+        if self._max_np is not None:
+            # Trim to max_np slots.
+            out, total = [], 0
+            for h in hosts:
+                if total >= self._max_np:
+                    break
+                take = min(h.slots, self._max_np - total)
+                out.append(HostInfo(h.hostname, take))
+                total += take
+            hosts = out
+        return hosts
+
+    def _slot_id(self, s: SlotInfo) -> str:
+        return f"{s.hostname}:{s.local_rank}"
+
+    def _start_round(self, hosts: List[HostInfo]):
+        with self._lock:
+            self._round += 1
+            self._current_hosts = hosts
+            np_ = sum(h.slots for h in hosts)
+            slots = get_host_assignments(hosts, np_)
+            port = self._base_port + (self._round % 1000)
+            controller_addr = f"{hosts[0].hostname}:{port}"
+            if hosts[0].hostname in ("localhost",):
+                controller_addr = f"127.0.0.1:{port}"
+            assignment = {
+                "round": self._round,
+                "size": np_,
+                "controller_addr": controller_addr,
+                "slots": {self._slot_id(s): {
+                    "rank": s.rank, "size": s.size,
+                    "local_rank": s.local_rank, "local_size": s.local_size,
+                    "cross_rank": s.cross_rank, "cross_size": s.cross_size,
+                } for s in slots},
+            }
+            self._rendezvous.put("elastic", f"round.{self._round}",
+                                 json.dumps(assignment).encode())
+            self._rendezvous.put("elastic", "current_round",
+                                 str(self._round).encode())
+            if self._verbose:
+                print(f"[elastic] round {self._round}: "
+                      f"{np_} procs on "
+                      f"{','.join(h.hostname for h in hosts)}")
+            # Spawn workers for slots without a live process.
+            for s in slots:
+                sid = self._slot_id(s)
+                w = self._workers.get(sid)
+                if w is not None and w.proc.poll() is None:
+                    continue  # surviving worker re-rendezvouses in place
+                self._spawn(s)
+
+    def _spawn(self, s: SlotInfo):
+        env = dict(self._extra_env)
+        env["HVD_TPU_ELASTIC_SLOT"] = self._slot_id(s)
+        env["HVD_TPU_HOSTNAME"] = s.hostname
+        env["HOROVOD_HOSTNAME"] = s.hostname
+        ws = exec_mod.launch_workers(
+            [s], self._command, controller_addr="elastic",
+            extra_env=env,
+            on_exit=lambda slot, code, sid=self._slot_id(s):
+                self._on_worker_exit(sid, slot, code))
+        self._workers[self._slot_id(s)] = ws[0]
+
+    def _on_worker_exit(self, sid: str, slot: SlotInfo, code: int):
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            self._workers.pop(sid, None)
+            self._finished[sid] = code
+            if code == 0:
+                # Success of any worker ends the job successfully once all
+                # live workers drain (reference: results registered per
+                # rank; first completed round wins).
+                if not self._workers:
+                    self._set_result(0)
+                return
+            # Failure: blacklist the host (reference registration.py) and
+            # re-rendezvous with the survivors.
+            self._blacklist.add(slot.hostname)
+            if self._verbose:
+                print(f"[elastic] worker {sid} failed (exit {code}); "
+                      f"blacklisting {slot.hostname}")
+            self._bump_reset()
+            try:
+                hosts = self._discover_filtered()
+            except RuntimeError:
+                hosts = [h for h in self._current_hosts
+                         if h.hostname not in self._blacklist]
+            live = sum(h.slots for h in hosts)
+            if live < self._min_np:
+                print(f"[elastic] only {live} slots remain "
+                      f"(< min-np {self._min_np}); aborting")
+                self._set_result(code if code else 1)
+                return
+            self._publish_host_event(added_only=False)
+            self._start_round(hosts)
+
+    def _bump_reset(self):
+        self._resets += 1
+        if self._reset_limit is not None and self._resets > self._reset_limit:
+            print(f"[elastic] reset limit {self._reset_limit} exceeded")
+            self._set_result(1)
+
+    def _set_result(self, code: int):
+        with self._result_cv:
+            if self._result is None:
+                self._result = code
+            self._result_cv.notify_all()
+
+    def _publish_host_event(self, added_only: bool):
+        event = {"ts": time.time(), "added_only": added_only}
+        self._rendezvous.put("elastic", "host_event",
+                             json.dumps(event).encode())
+
+    def _discovery_loop(self):
+        while not self._shutdown.is_set():
+            time.sleep(self._interval)
+            try:
+                hosts = self._discover_filtered()
+            except RuntimeError as e:
+                if self._verbose:
+                    print(f"[elastic] discovery error: {e}")
+                continue
+            with self._lock:
+                cur = {h.hostname: h.slots for h in self._current_hosts}
+                new = {h.hostname: h.slots for h in hosts}
+                if new == cur:
+                    continue
+                added_only = set(cur).issubset(set(new))
+                if self._max_np is not None and added_only and \
+                        sum(cur.values()) >= self._max_np:
+                    continue  # already at capacity
+                if self._verbose:
+                    print(f"[elastic] host change: {cur} -> {new}")
+                self._publish_host_event(added_only=added_only)
+                self._bump_reset()
+                if self._result is not None:
+                    return
+                self._start_round(hosts)
+
+
+def run_elastic(args) -> int:
+    """Entry from hvdrun (launch.py) for elastic mode."""
+    from .launch import knob_env
+    if not args.host_discovery_script:
+        raise SystemExit("--host-discovery-script is required for elastic "
+                         "mode (with --min-np/--max-np)")
+    slots = args.slots or 1
+    discovery = HostDiscoveryScript(args.host_discovery_script, slots)
+    min_np = args.min_np or args.num_proc or 1
+    driver = ElasticDriver(
+        discovery, args.command, min_np=min_np, max_np=args.max_np,
+        reset_limit=args.reset_limit, extra_env=knob_env(args),
+        verbose=args.verbose)
+    return driver.run()

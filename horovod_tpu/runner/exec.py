@@ -1,0 +1,128 @@
+"""Worker process execution: local subprocess or ssh fan-out, with env
+injection, rank-prefixed output forwarding and fail-fast semantics.
+
+Capability parity with the reference's threaded exec
+(runner/gloo_run.py:105-268 + common/util/safe_shell_exec.py): each slot
+runs the user command with the slot env; the first non-zero exit terminates
+the job; output lines are prefixed "[rank]<stream>".
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .hosts import SlotInfo, slot_env
+
+
+def _is_local(hostname: str) -> bool:
+    import socket
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def build_command(slot: SlotInfo, command: List[str], env: Dict[str, str],
+                  ssh_port: Optional[int] = None) -> List[str]:
+    if _is_local(slot.hostname):
+        return command
+    # Remote: ssh with env assignments inline (reference gloo_run.py builds
+    # the same "env k=v ... cmd" remote line).
+    assignments = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items())
+    remote = f"cd {shlex.quote(os.getcwd())} && env {assignments} " + \
+        " ".join(shlex.quote(c) for c in command)
+    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh_cmd += ["-p", str(ssh_port)]
+    return ssh_cmd + [slot.hostname, remote]
+
+
+class WorkerProcess:
+    def __init__(self, slot: SlotInfo, proc: subprocess.Popen):
+        self.slot = slot
+        self.proc = proc
+        self.exit_code: Optional[int] = None
+
+
+def launch_workers(slots: List[SlotInfo], command: List[str],
+                   controller_addr: str,
+                   extra_env: Optional[Dict[str, str]] = None,
+                   on_exit: Optional[Callable[[SlotInfo, int], None]] = None,
+                   prefix_output: bool = True) -> List[WorkerProcess]:
+    """Start one process per slot; returns immediately with handles."""
+    workers = []
+    for slot in slots:
+        env = dict(os.environ)
+        env.update(slot_env(slot, controller_addr))
+        if extra_env:
+            env.update(extra_env)
+        cmd = build_command(slot, command,
+                            {**slot_env(slot, controller_addr),
+                             **(extra_env or {})})
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1, start_new_session=True)
+        w = WorkerProcess(slot, proc)
+        workers.append(w)
+        if prefix_output:
+            threading.Thread(target=_forward_output, args=(w,),
+                             daemon=True).start()
+        if on_exit is not None:
+            threading.Thread(target=_watch_exit, args=(w, on_exit),
+                             daemon=True).start()
+    return workers
+
+
+def _forward_output(w: WorkerProcess):
+    assert w.proc.stdout is not None
+    for line in w.proc.stdout:
+        sys.stdout.write(f"[{w.slot.rank}]<stdout> {line}")
+        sys.stdout.flush()
+
+
+def _watch_exit(w: WorkerProcess, on_exit: Callable[[SlotInfo, int], None]):
+    code = w.proc.wait()
+    w.exit_code = code
+    on_exit(w.slot, code)
+
+
+def wait_all(workers: List[WorkerProcess],
+             timeout: Optional[float] = None) -> int:
+    """Wait for all workers; on first failure, terminate the rest
+    (fail-fast) and return its exit code."""
+    result = 0
+    pending = list(workers)
+    try:
+        while pending:
+            w = pending[0]
+            code = w.proc.wait(timeout=timeout)
+            w.exit_code = code
+            pending.pop(0)
+            if code != 0 and result == 0:
+                result = code
+                terminate_all(pending)
+    except subprocess.TimeoutExpired:
+        terminate_all(pending)
+        return 124
+    return result
+
+
+def terminate_all(workers: List[WorkerProcess], sig=signal.SIGTERM):
+    for w in workers:
+        if w.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(w.proc.pid), sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+    for w in workers:
+        try:
+            w.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(w.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

@@ -1,0 +1,185 @@
+"""``hvdrun`` — the launcher CLI.
+
+Capability parity with the reference's ``horovodrun``
+(runner/launch.py:300-520 arg surface, gloo_run.py launch flow): parse
+-np/-H/--hostfile (or discover the TPU slice), compute slot assignments,
+start the rendezvous KV server, export the env contract per worker, exec
+workers locally or over ssh with fail-fast, and (with --min-np/--max-np +
+--host-discovery-script) run the elastic driver instead.
+
+Config file (--config-file, JSON or YAML) keys mirror CLI flags
+(reference runner/common/util/config_parser.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+from typing import Dict, List, Optional
+
+from . import exec as exec_mod
+from . import tpu_discovery
+from .hosts import HostInfo, get_host_assignments, parse_hostfile, parse_hosts
+from .rendezvous import RendezvousServer
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a data-parallel job across hosts / a TPU slice.")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host list "h1:slots,h2:slots"')
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile path (mpirun-style slots=N supported)")
+    p.add_argument("--controller-port", type=int, default=26000)
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--config-file", default=None)
+    # Elastic.
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--slots", type=int, default=None,
+                   help="slots per discovered host (elastic)")
+    p.add_argument("--reset-limit", type=int, default=None)
+    # Tunables → env knobs (reference config_parser mapping).
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--log-level", default=None)
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command (e.g. python train.py)")
+    args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(args, p, args.config_file)
+    if not args.command:
+        p.error("no worker command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _apply_config_file(args, parser, path: str):
+    with open(path) as f:
+        text = f.read()
+    try:
+        cfg = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+            cfg = yaml.safe_load(text)
+        except ImportError as e:
+            raise SystemExit(f"config file {path} is not JSON and PyYAML "
+                             f"is unavailable: {e}")
+    for key, value in (cfg or {}).items():
+        attr = key.replace("-", "_")
+        if hasattr(args, attr) and getattr(args, attr) in (None, False):
+            setattr(args, attr, value)
+
+
+def knob_env(args: argparse.Namespace) -> Dict[str, str]:
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HVD_TPU_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HVD_TPU_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HVD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HVD_TPU_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HVD_TPU_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        env["HVD_TPU_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HVD_TPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.no_stall_check:
+        env["HVD_TPU_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        env["HVD_TPU_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        env["HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_check_shutdown_time_seconds)
+    if args.log_level:
+        env["HVD_TPU_LOG_LEVEL"] = args.log_level
+    return env
+
+
+def resolve_hosts(args: argparse.Namespace) -> List[HostInfo]:
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    tpu = tpu_discovery.discover_tpu_slice()
+    if tpu is not None:
+        hosts, _ = tpu
+        if args.verbose:
+            print(f"discovered TPU slice: "
+                  f"{','.join(h.hostname for h in hosts)}")
+        return hosts
+    np_ = args.num_proc or 1
+    return [HostInfo("localhost", np_)]
+
+
+def _controller_addr(hosts: List[HostInfo], port: int) -> str:
+    first = hosts[0].hostname
+    if first in ("localhost", "127.0.0.1"):
+        first = "127.0.0.1"
+    return f"{first}:{port}"
+
+
+def run_static(args: argparse.Namespace) -> int:
+    hosts = resolve_hosts(args)
+    np_ = args.num_proc or sum(h.slots for h in hosts)
+    slots = get_host_assignments(hosts, np_)
+    controller_addr = _controller_addr(hosts, args.controller_port)
+
+    rendezvous = RendezvousServer()
+    rdv_port = rendezvous.start()
+    extra_env = knob_env(args)
+    extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = \
+        f"{socket.gethostname()}:{rdv_port}"
+    rendezvous.put("global", "controller", controller_addr.encode())
+
+    if args.verbose:
+        for s in slots:
+            print(f"rank {s.rank} -> {s.hostname} (local {s.local_rank}/"
+                  f"{s.local_size}, cross {s.cross_rank}/{s.cross_size})")
+    workers = exec_mod.launch_workers(slots, args.command, controller_addr,
+                                      extra_env=extra_env)
+    try:
+        return exec_mod.wait_all(workers)
+    finally:
+        rendezvous.stop()
+
+
+def run_elastic(args: argparse.Namespace) -> int:
+    from .elastic_driver import run_elastic
+    return run_elastic(args)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.host_discovery_script or args.min_np or args.max_np:
+        return run_elastic(args)
+    return run_static(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

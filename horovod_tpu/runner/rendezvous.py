@@ -1,0 +1,129 @@
+"""HTTP key-value rendezvous server.
+
+Capability parity with the reference RendezvousServer
+(runner/http/http_server.py:39-198): a threaded HTTP server exposing
+PUT/GET/DELETE of scoped keys ("/scope/key"), used by elastic workers to
+discover the current controller address and by auxiliary tooling.  GET on a
+missing key returns 404 (clients poll); the elastic handler additionally
+serves slot assignments per rendezvous round.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    server_version = "hvd_tpu_rendezvous"
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        if len(parts) == 1:
+            return "", parts[0]
+        return parts[0], parts[1]
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        self.server.store_put(scope, key, value)  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._split()
+        value = self.server.store_get(scope, key)  # type: ignore[attr-defined]
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        self.server.store_delete(scope, key)  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.end_headers()
+
+
+class _KVServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _KVHandler)
+        self._store: Dict[Tuple[str, str], bytes] = {}
+        self._lock = threading.Lock()
+
+    def store_put(self, scope: str, key: str, value: bytes):
+        with self._lock:
+            self._store[(scope, key)] = value
+
+    def store_get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get((scope, key))
+
+    def store_delete(self, scope: str, key: str):
+        with self._lock:
+            self._store.pop((scope, key), None)
+
+
+class RendezvousServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._server = _KVServer((host, port))
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def put(self, scope: str, key: str, value: bytes):
+        self._server.store_put(scope, key, value)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        return self._server.store_get(scope, key)
+
+    def stop(self):
+        self._server.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def http_get(addr: str, scope: str, key: str,
+             timeout: float = 5.0) -> Optional[bytes]:
+    """Tiny client (reference http/http_client.py)."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}/{scope}/{key}", timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError:
+        return None
+    except OSError:
+        return None
+
+
+def http_put(addr: str, scope: str, key: str, value: bytes,
+             timeout: float = 5.0) -> bool:
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://{addr}/{scope}/{key}", data=value, method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            return True
+    except OSError:
+        return False

@@ -1,0 +1,84 @@
+"""TPU-VM slice discovery for the launcher.
+
+The reference's launcher takes SSH host lists; on TPU pod slices the worker
+inventory comes from the TPU runtime environment instead (SURVEY.md §5.8:
+"TPU-VM slice discovery (GCE metadata / gcloud inventory) in place of ssh
+host lists").  Resolution order:
+
+1. ``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID`` env (set on TPU VMs by the
+   runtime; also the test seam).
+2. GCE metadata server ``instance/attributes/tpu-env`` (worker hostnames,
+   accelerator type, topology).
+
+Rank order follows worker id order — the TPU runtime numbers workers so
+that consecutive workers are ICI-adjacent, which keeps ring/neighbor
+collectives on-ICI (the launcher's host-major rank assignment preserves
+this).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+from .hosts import HostInfo
+
+_METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                 "instance/attributes/{}")
+
+# Chips per host by generation (v4: 4 chips/host; v5e/v5p/v2/v3: 8/4/8
+# cores — chips-per-host for the common configs).
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 8, "v5e": 8,
+                   "v5p": 4, "v6e": 8}
+
+
+def _metadata_get(attr: str, timeout: float = 2.0) -> Optional[str]:
+    import urllib.request
+    req = urllib.request.Request(_METADATA_URL.format(attr),
+                                 headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode()
+    except OSError:
+        return None
+
+
+def _parse_tpu_env(blob: str) -> dict:
+    """tpu-env metadata is "KEY: 'value'" lines."""
+    out = {}
+    for line in blob.splitlines():
+        m = re.match(r"^(\w+):\s*'?([^']*)'?\s*$", line.strip())
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+def chips_per_host(accelerator_type: str) -> int:
+    """"v5litepod-256" → 8; unknown types default to 4."""
+    gen = accelerator_type.split("-")[0].lower()
+    return _CHIPS_PER_HOST.get(gen, 4)
+
+
+def discover_tpu_slice() -> Optional[Tuple[List[HostInfo], int]]:
+    """Returns (hosts, chips_per_host) for the current slice, or None when
+    not running on a TPU VM."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if not hostnames:
+        blob = _metadata_get("tpu-env")
+        if blob:
+            env = _parse_tpu_env(blob)
+            hostnames = env.get("WORKER_HOSTNAMES") or env.get(
+                "TPU_WORKER_HOSTNAMES")
+            accel = accel or env.get("ACCELERATOR_TYPE", "")
+    if not hostnames:
+        return None
+    cph = chips_per_host(accel) if accel else 8
+    hosts = [HostInfo(h.strip(), cph) for h in hostnames.split(",")
+             if h.strip()]
+    return hosts, cph
+
+
+def my_worker_id() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", "0"))

@@ -1,0 +1,74 @@
+"""Worker-side elastic rendezvous client.
+
+On each (re)init an elastic worker fetches its assignment for the current
+round from the launcher's rendezvous KV (reference: gloo workers re-run the
+HTTPStore rendezvous on reset, gloo_context.cc:71-108).  Workers are
+identified by their spawn slot id ("hostname:local_slot"); a worker whose
+slot is absent from the current round polls until a round includes it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .rendezvous import http_get
+
+
+def rendezvous_addr() -> Optional[str]:
+    return os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+
+
+def my_slot_id() -> Optional[str]:
+    return os.environ.get("HVD_TPU_ELASTIC_SLOT")
+
+
+def fetch_assignment(timeout: float = 120.0,
+                     poll_interval: float = 0.1) -> Dict[str, Any]:
+    """Block until the current rendezvous round includes this worker's slot;
+    returns {round, size, controller_addr, rank, local_rank, ...}."""
+    addr = rendezvous_addr()
+    slot = my_slot_id()
+    if not addr or not slot:
+        raise RuntimeError("elastic worker without rendezvous env "
+                           "(HVD_TPU_RENDEZVOUS_ADDR / HVD_TPU_ELASTIC_SLOT)")
+    deadline = time.time() + timeout
+    last_round = -1
+    while time.time() < deadline:
+        cur = http_get(addr, "elastic", "current_round", timeout=5)
+        if cur is not None:
+            rnd = int(cur.decode())
+            if rnd != last_round:
+                last_round = rnd
+                blob = http_get(addr, "elastic", f"round.{rnd}", timeout=5)
+                if blob is not None:
+                    assignment = json.loads(blob.decode())
+                    mine = assignment["slots"].get(slot)
+                    if mine is not None:
+                        return {
+                            "round": assignment["round"],
+                            "size": assignment["size"],
+                            "controller_addr":
+                                assignment["controller_addr"],
+                            **mine,
+                        }
+        time.sleep(poll_interval)
+    raise TimeoutError(f"no rendezvous round included slot {slot} within "
+                       f"{timeout}s")
+
+
+def poll_host_event(last_ts: float) -> Optional[Dict[str, Any]]:
+    """Returns the latest host event if newer than last_ts (pull-based
+    worker notification; see elastic_driver._publish_host_event)."""
+    addr = rendezvous_addr()
+    if not addr:
+        return None
+    blob = http_get(addr, "elastic", "host_event", timeout=5)
+    if blob is None:
+        return None
+    event = json.loads(blob.decode())
+    if event.get("ts", 0) > last_ts:
+        return event
+    return None

@@ -1,0 +1,160 @@
+"""Launcher tests: host parsing/assignment (reference test/single/test_run.py
+pattern), rendezvous KV, static end-to-end launches on localhost, elastic
+driver with scripted discovery + worker failure (reference
+test/integration/elastic_common.py strategy)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner.rendezvous import (RendezvousServer, http_get,
+                                           http_put)
+
+
+# --- unit: hosts ----------------------------------------------------------
+
+def test_parse_hosts():
+    hs = hosts_mod.parse_hosts("h1:2,h2:4,h3")
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("# comment\nh1 slots=2\nh2:3\n\nh4\n")
+    hs = hosts_mod.parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("h1", 2), ("h2", 3), ("h4", 1)]
+
+
+def test_host_assignments():
+    hs = hosts_mod.parse_hosts("a:2,b:2")
+    slots = hosts_mod.get_host_assignments(hs, 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "a", 0, 0), (1, "a", 1, 0), (2, "b", 0, 1), (3, "b", 1, 1)]
+    assert all(s.size == 4 and s.cross_size == 2 and s.local_size == 2
+               for s in slots)
+
+
+def test_host_assignments_insufficient():
+    hs = hosts_mod.parse_hosts("a:2")
+    with pytest.raises(ValueError):
+        hosts_mod.get_host_assignments(hs, 4)
+
+
+def test_slot_env_contract():
+    hs = hosts_mod.parse_hosts("a:2")
+    slots = hosts_mod.get_host_assignments(hs, 2)
+    env = hosts_mod.slot_env(slots[1], "10.0.0.1:26000")
+    assert env["HVD_TPU_RANK"] == "1"
+    assert env["HOROVOD_RANK"] == "1"
+    assert env["HVD_TPU_CONTROLLER_ADDR"] == "10.0.0.1:26000"
+
+
+def test_tpu_discovery_env(monkeypatch):
+    from horovod_tpu.runner import tpu_discovery
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1,t2,t3")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-32")
+    hosts, cph = tpu_discovery.discover_tpu_slice()
+    assert cph == 8
+    assert [h.hostname for h in hosts] == ["t0", "t1", "t2", "t3"]
+    assert all(h.slots == 8 for h in hosts)
+
+
+# --- rendezvous KV --------------------------------------------------------
+
+def test_rendezvous_kv_roundtrip():
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        assert http_get(addr, "scope", "missing") is None
+        assert http_put(addr, "scope", "k", b"value")
+        assert http_get(addr, "scope", "k") == b"value"
+        server.put("s2", "k2", b"direct")
+        assert http_get(addr, "s2", "k2") == b"direct"
+    finally:
+        server.stop()
+
+
+# --- integration: static launch ------------------------------------------
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    assert hvd.is_initialized()
+    rank, size = hvd.rank(), hvd.size()
+    x = np.full((8,), float(rank + 1), dtype=np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    expected = sum(range(1, size + 1))
+    np.testing.assert_allclose(np.asarray(out), expected)
+    g = hvd.allgather(np.full((rank + 1, 2), float(rank), dtype=np.float32))
+    assert g.shape[0] == sum(r + 1 for r in range(size))
+    b = hvd.broadcast(np.full((3,), float(rank), dtype=np.float32),
+                      root_rank=0)
+    np.testing.assert_allclose(np.asarray(b), 0.0)
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"rank": rank, "size": size}}, f)
+    hvd.shutdown()
+""")
+
+
+def test_static_launch_2proc(tmp_path):
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "result")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT.format(repo=REPO, outfile=outfile))
+    rc = main(["-np", "2", "--controller-port", "28131", "-v",
+               sys.executable, str(script)])
+    assert rc == 0
+    for r in range(2):
+        data = json.load(open(f"{outfile}.{r}"))
+        assert data == {"rank": r, "size": 2}
+
+
+def test_static_launch_failfast(tmp_path):
+    from horovod_tpu.runner.launch import main
+    script = tmp_path / "worker.py"
+    script.write_text("import os, sys, time\n"
+                      "if os.environ['HVD_TPU_RANK'] == '1':\n"
+                      "    sys.exit(3)\n"
+                      "time.sleep(60)\n")
+    rc = main(["-np", "2", "--controller-port", "28133",
+               sys.executable, str(script)])
+    assert rc == 3
+
+
+def test_knob_env_mapping():
+    from horovod_tpu.runner.launch import knob_env, parse_args
+    args = parse_args(["-np", "1", "--fusion-threshold-mb", "32",
+                       "--cycle-time-ms", "2.5", "--timeline-filename",
+                       "/tmp/tl.json", "--autotune", "--no-stall-check",
+                       "python", "x.py"])
+    env = knob_env(args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_TPU_CYCLE_TIME"] == "2.5"
+    assert env["HVD_TPU_TIMELINE"] == "/tmp/tl.json"
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+    assert env["HVD_TPU_STALL_CHECK_DISABLE"] == "1"
+
+
+def test_config_file(tmp_path):
+    from horovod_tpu.runner.launch import parse_args
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"fusion-threshold-mb": 16,
+                               "cycle-time-ms": 5.0}))
+    args = parse_args(["-np", "1", "--config-file", str(cfg),
+                       "python", "x.py"])
+    assert args.fusion_threshold_mb == 16
+    assert args.cycle_time_ms == 5.0
