@@ -60,7 +60,9 @@ def init(mesh=None,
     import os as _os
     if _os.environ.get("HVD_TPU_ELASTIC_SLOT"):
         from ..runner.worker import fetch_assignment
-        elastic_assignment = fetch_assignment()
+        elastic_assignment = fetch_assignment(
+            min_round=global_state.elastic_round + 1)
+        global_state.elastic_round = elastic_assignment["round"]
         global_state.rank = elastic_assignment["rank"]
         global_state.size = elastic_assignment["size"]
         global_state.local_rank = elastic_assignment["local_rank"]

@@ -44,8 +44,10 @@ class GlobalState:
     # Native eager-path runtime (attached lazily; None in pure-compiled mode).
     controller: Optional[Any] = None
 
-    # Elastic bookkeeping.
+    # Elastic bookkeeping. elastic_round survives reset() so a re-init can
+    # demand a *newer* rendezvous round than the one that just failed.
     elastic_enabled: bool = False
+    elastic_round: int = -1
 
     def reset(self) -> None:
         self.initialized = False

@@ -233,6 +233,11 @@ class WorkerNotificationManager:
         event = poll_host_event(self._last_ts)
         if event is not None:
             self._last_ts = event["ts"]
+            # Stale events (for a round this worker already joined via the
+            # failure path) must not trigger another interrupt — it would
+            # block waiting for a round the driver never publishes.
+            if event.get("round", 1 << 30) <= global_state.elastic_round:
+                return
             self.handle_hosts_updated(event["ts"],
                                       bool(event.get("added_only")))
 

@@ -163,6 +163,31 @@ class NativeController:
 
     # -- collectives -------------------------------------------------------
 
+    def allreduce_async_(self, arr: np.ndarray, out: np.ndarray,
+                         op: int = 1, prescale: float = 1.0,
+                         postscale: float = 1.0,
+                         name: Optional[str] = None) -> int:
+        """In-place-capable async allreduce: arr/out may alias. Returns a
+        native handle; pass to wait()/release(). Caller must keep arr/out
+        alive until wait() returns (the reference's async handle contract,
+        torch/mpi_ops.py:843-882)."""
+        ndim, shape = _shape_arg(arr)
+        h = self._lib.hvd_native_allreduce(
+            self._auto_name("allreduce", name),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ndim, shape, _dtype_code(arr), op, prescale, postscale)
+        if h < 0:
+            raise NativeError(self._last_error())
+        return h
+
+    def wait(self, handle: int):
+        self._wait(handle)
+        self._lib.hvd_native_release(handle)
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.hvd_native_poll(handle))
+
     def allreduce(self, arr: np.ndarray, op: int = 1,
                   prescale: float = 1.0, postscale: float = 1.0,
                   name: Optional[str] = None) -> np.ndarray:

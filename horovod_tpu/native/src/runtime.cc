@@ -28,6 +28,7 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
   cycle_time_ms_ = cycle_time_ms;
   if (!timeline_file.empty()) timeline_.Start(timeline_file, rank);
   stop_ = false;
+  loop_dead_ = false;
   loop_error_ = Status::OK();
   background_ = std::thread([this] { BackgroundLoop(); });
   initialized_ = true;
@@ -69,6 +70,12 @@ void Runtime::Shutdown() {
 int64_t Runtime::Enqueue(std::shared_ptr<TensorEntry> entry, Status* status) {
   if (!initialized_) {
     *status = Status::PreconditionError("runtime not initialized");
+    return -1;
+  }
+  if (loop_dead_) {
+    *status = Status::Error("collective runtime failed (" +
+                            loop_error_.reason +
+                            "); re-initialize to continue");
     return -1;
   }
   std::shared_ptr<HandleState> hs = std::make_shared<HandleState>();
@@ -201,14 +208,27 @@ void Runtime::BackgroundLoop() {
     Status st = controller_->Exchange(rl, &responses);
     if (!st.ok()) {
       loop_error_ = st;
-      // Fail everything in flight and stop.
+      loop_dead_ = true;
+      // Fail everything in flight — submitted AND still-pending — so no
+      // caller blocks on a handle that will never resolve; new enqueues
+      // fail fast until re-init (elastic reset path).
       std::vector<std::shared_ptr<TensorEntry>> all;
       {
         std::lock_guard<std::mutex> lk(mu_);
         for (auto& [n, e] : submitted_) all.push_back(e);
+        for (auto& [n, e] : pending_) all.push_back(e);
         submitted_.clear();
+        pending_.clear();
+        pending_order_.clear();
       }
       for (auto& e : all) Finish(e, st);
+      // Unblock join()/barrier() waiters too.
+      {
+        std::lock_guard<std::mutex> lk(sync_mu_);
+        last_joined_rank_ = -1;
+        barrier_released_ = true;
+      }
+      sync_cv_.notify_all();
       break;
     }
     timeline_.MarkCycle();

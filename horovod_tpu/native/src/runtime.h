@@ -76,6 +76,7 @@ class Runtime {
 
   std::atomic<bool> initialized_{false};
   std::atomic<bool> stop_{false};
+  std::atomic<bool> loop_dead_{false};
   std::unique_ptr<Network> net_;
   std::unique_ptr<Controller> controller_;
   std::thread background_;

@@ -35,6 +35,19 @@ def _controller():
     return global_state.controller
 
 
+def _ctl(fn, *args, **kwargs):
+    """Run a native-controller call, mapping transport/collective failures
+    to HorovodInternalError so the elastic retry loop can restore state
+    (the reference maps failed-op statuses the same way,
+    torch/mpi_ops.py synchronize / tensorflow/elastic.py:53-66)."""
+    from ..native.controller import NativeError
+    from ..core.exceptions import HorovodInternalError
+    try:
+        return fn(*args, **kwargs)
+    except NativeError as e:
+        raise HorovodInternalError(str(e)) from e
+
+
 def _process_mesh():
     """A 1-D mesh with exactly one device per process, for process-level
     eager collectives (regime 2)."""
@@ -86,10 +99,9 @@ def allreduce(tensor, op_fn, name: Optional[str] = None,
     callables across the C boundary)."""
     ctl = _controller()
     if ctl is not None:
-        return ctl.allreduce(_np(tensor),
-                             op=1 if op_code is None else int(op_code),
-                             prescale=prescale, postscale=postscale,
-                             name=name)
+        return _ctl(ctl.allreduce, _np(tensor),
+                    op=1 if op_code is None else int(op_code),
+                    prescale=prescale, postscale=postscale, name=name)
     if global_state.process_count == 1:
         x = _np(tensor)
         return op_fn(x[None])
@@ -101,7 +113,7 @@ def allgather(tensor, name: Optional[str] = None):
     """Concatenate along dim 0 across processes (unequal dim-0 allowed)."""
     ctl = _controller()
     if ctl is not None:
-        return ctl.allgather(_np(tensor), name=name)
+        return _ctl(ctl.allgather, _np(tensor), name=name)
     if global_state.process_count == 1:
         return _np(tensor)
     # Unequal first dims need a size exchange first; gather sizes, then pad,
@@ -128,7 +140,8 @@ def _one_hot_sizes(rows: int) -> np.ndarray:
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     ctl = _controller()
     if ctl is not None:
-        return ctl.broadcast(_np(tensor), root_rank=root_rank, name=name)
+        return _ctl(ctl.broadcast, _np(tensor), root_rank=root_rank,
+                    name=name)
     if global_state.process_count == 1:
         return _np(tensor)
     garr = _global_over_processes(_np(tensor))
@@ -142,7 +155,7 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
     (operations.cc:1136-1198)."""
     ctl = _controller()
     if ctl is not None:
-        return ctl.alltoall(_np(tensor), splits=splits, name=name)
+        return _ctl(ctl.alltoall, _np(tensor), splits=splits, name=name)
     x = _np(tensor)
     p = global_state.process_count
     if splits is None:
@@ -186,7 +199,7 @@ def reducescatter(tensor, op_fn, name: Optional[str] = None,
 def barrier() -> None:
     ctl = _controller()
     if ctl is not None:
-        ctl.barrier()
+        _ctl(ctl.barrier)
         return
     if global_state.process_count == 1:
         return
@@ -203,6 +216,6 @@ def join() -> int:
     """
     ctl = _controller()
     if ctl is not None:
-        return ctl.join()
+        return _ctl(ctl.join)
     barrier()
     return global_state.process_count - 1

@@ -248,7 +248,11 @@ class ElasticDriver:
             self._result_cv.notify_all()
 
     def _publish_host_event(self, added_only: bool):
-        event = {"ts": time.time(), "added_only": added_only}
+        # "round" = the round this change leads to; workers already at (or
+        # past) it treat the event as stale (they reached the new world
+        # through the failure path instead of the interrupt path).
+        event = {"ts": time.time(), "added_only": added_only,
+                 "round": self._round + 1}
         self._rendezvous.put("elastic", "host_event",
                              json.dumps(event).encode())
 

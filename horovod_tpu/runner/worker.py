@@ -25,10 +25,12 @@ def my_slot_id() -> Optional[str]:
     return os.environ.get("HVD_TPU_ELASTIC_SLOT")
 
 
-def fetch_assignment(timeout: float = 120.0,
+def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
                      poll_interval: float = 0.1) -> Dict[str, Any]:
-    """Block until the current rendezvous round includes this worker's slot;
-    returns {round, size, controller_addr, rank, local_rank, ...}."""
+    """Block until a rendezvous round >= min_round includes this worker's
+    slot; returns {round, size, controller_addr, rank, local_rank, ...}.
+    ``min_round`` prevents a worker that just left a failed round from
+    re-joining it before the driver publishes the replacement round."""
     addr = rendezvous_addr()
     slot = my_slot_id()
     if not addr or not slot:
@@ -40,7 +42,7 @@ def fetch_assignment(timeout: float = 120.0,
         cur = http_get(addr, "elastic", "current_round", timeout=5)
         if cur is not None:
             rnd = int(cur.decode())
-            if rnd != last_round:
+            if rnd != last_round and rnd >= min_round:
                 last_round = rnd
                 blob = http_get(addr, "elastic", f"round.{rnd}", timeout=5)
                 if blob is not None:

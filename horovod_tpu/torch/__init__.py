@@ -1,0 +1,283 @@
+"""PyTorch front-end (CPU training path).
+
+Capability parity with the reference's horovod/torch front-end
+(torch/optimizer.py:128-247 _DistributedOptimizer with per-parameter
+grad-accumulator hooks, torch/mpi_ops.py tensor collectives,
+torch/functions.py broadcast_parameters/broadcast_optimizer_state,
+sparse allreduce via allgather torch/mpi_ops.py:512).
+
+TPU note: the TPU compute path is JAX; this front-end exists so torch users
+of the reference can run their CPU training scripts unchanged under
+``hvdrun``.  Tensors bridge to the native runtime through zero-copy numpy
+views; allreduces fire asynchronously from backward hooks and are fused by
+the background runtime, then synchronized in ``step()`` — the same overlap
+structure as the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import torch as _torch
+
+from ..core.basics import (init, shutdown, is_initialized, rank, size,
+                           local_rank, local_size, cross_rank, cross_size)
+from ..core.state import global_state
+from ..ops.collective import (Average, Sum, Adasum, Min, Max, Product)
+from ..ops import collective as _C
+from ..optimizers import broadcast_object, allgather_object
+
+
+class Compression:
+    """Torch-side wire compression (reference torch/compression.py)."""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype in (_torch.float32, _torch.float64):
+                return t.half(), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t if ctx is None else t.to(ctx)
+
+
+def _to_numpy(tensor: _torch.Tensor) -> np.ndarray:
+    return tensor.detach().contiguous().cpu().numpy()
+
+
+def allreduce(tensor: _torch.Tensor, op: int = Average,
+              name: Optional[str] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> _torch.Tensor:
+    out = _C.allreduce(_to_numpy(tensor), op=op, name=name,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return _torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+
+
+def allreduce_(tensor: _torch.Tensor, op: int = Average,
+               name: Optional[str] = None) -> _torch.Tensor:
+    tensor.copy_(allreduce(tensor, op=op, name=name))
+    return tensor
+
+
+def allgather(tensor: _torch.Tensor,
+              name: Optional[str] = None) -> _torch.Tensor:
+    out = _C.allgather(_to_numpy(tensor), name=name)
+    return _torch.from_numpy(np.ascontiguousarray(out))
+
+
+def broadcast(tensor: _torch.Tensor, root_rank: int = 0,
+              name: Optional[str] = None) -> _torch.Tensor:
+    out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+
+
+def broadcast_(tensor: _torch.Tensor, root_rank: int = 0,
+               name: Optional[str] = None) -> _torch.Tensor:
+    tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name))
+    return tensor
+
+
+def alltoall(tensor: _torch.Tensor, splits=None, name: Optional[str] = None):
+    out, recv_splits = _C.alltoall(_to_numpy(tensor), splits=splits,
+                                   name=name)
+    return (_torch.from_numpy(np.ascontiguousarray(out)),
+            _torch.from_numpy(np.asarray(recv_splits)))
+
+
+def sparse_allreduce(tensor: _torch.Tensor, name: Optional[str] = None,
+                     op: int = Average) -> _torch.Tensor:
+    """Allreduce a torch sparse COO tensor by allgathering indices/values
+    (the reference's sparse path, torch/mpi_ops.py:512): gathered slices are
+    summed by scatter-add, averaged for op=Average."""
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce expects a sparse tensor")
+    t = tensor.coalesce()
+    nm = name or "sparse"
+    indices = allgather(t.indices().t().contiguous(), name=nm + ".idx")
+    values = allgather(t.values(), name=nm + ".vals")
+    out = _torch.sparse_coo_tensor(indices.t(), values,
+                                   size=t.shape).coalesce()
+    if op == Average:
+        out = out / size()
+    return out
+
+
+def join() -> int:
+    return _C.join()
+
+
+def barrier():
+    _C.barrier()
+
+
+def poll(handle) -> bool:
+    return _C.poll(handle)
+
+
+def synchronize(handle):
+    return _C.synchronize(handle)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference torch/functions.py broadcast_parameters)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    for name, p in items:
+        if _torch.is_tensor(p) and p.dtype.is_floating_point or \
+                _torch.is_tensor(p):
+            broadcast_(p.data if p.requires_grad or hasattr(p, "data") else p,
+                       root_rank=root_rank, name="bcast.param." + name)
+
+
+def broadcast_optimizer_state(optimizer: _torch.optim.Optimizer,
+                              root_rank: int = 0):
+    """Broadcast optimizer hyperparameters + state tensors from root
+    (reference torch/functions.py broadcast_optimizer_state via pickle for
+    non-tensor state)."""
+    state = optimizer.state_dict()
+    synced = broadcast_object(
+        {k: v for k, v in state.items() if k != "state"},
+        root_rank=root_rank, name="opt.meta")
+    state.update(synced)
+    for pid, pstate in sorted(state.get("state", {}).items()):
+        for key, val in sorted(pstate.items()):
+            if _torch.is_tensor(val):
+                broadcast_(val, root_rank=root_rank,
+                           name=f"opt.state.{pid}.{key}")
+            else:
+                pstate[key] = broadcast_object(
+                    val, root_rank=root_rank, name=f"opt.state.{pid}.{key}")
+    optimizer.load_state_dict(state)
+
+
+class _DistributedOptimizer(_torch.optim.Optimizer):
+    """Wraps a torch optimizer: backward hooks fire async allreduces per
+    gradient; step() synchronizes then delegates (reference
+    torch/optimizer.py:128-325)."""
+
+    def __init__(self, optimizer, named_parameters=None, op=Average,
+                 compression=None, backward_passes_per_step=1,
+                 prescale_factor=1.0, postscale_factor=1.0):
+        self._opt = optimizer
+        self.op = op
+        self._compression = compression or Compression.none
+        self._bpps = backward_passes_per_step
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, group in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(group["params"])]
+        dups = {n for n in [n for n, _ in named]
+                if [x for x, _ in named].count(n) > 1}
+        if dups:
+            raise ValueError(f"duplicate parameter names: {dups}")
+        self._names = {p: n for n, p in named}
+        self._handles: Dict[_torch.nn.Parameter, Tuple[Any, np.ndarray]] = {}
+        self._grad_accs = []
+        self._pass_counts: Dict[_torch.nn.Parameter, int] = {}
+        self._register_hooks()
+
+    # Delegate the torch optimizer surface.
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def _register_hooks(self):
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._pass_counts[p] = 0
+                tmp = p.expand_as(p)
+                grad_acc = tmp.grad_fn.next_functions[0][0]
+                grad_acc.register_hook(self._make_hook(p))
+                self._grad_accs.append(grad_acc)
+
+    def _make_hook(self, p):
+        def hook(*ignore):
+            if p in self._handles:
+                # Over-fired hook without step() (reference
+                # optimizer.py:221-227 guard).
+                raise AssertionError(
+                    "gradient reduced twice before step(); likely a "
+                    "double backward without backward_passes_per_step")
+            self._pass_counts[p] += 1
+            if self._pass_counts[p] == self._bpps:
+                self._pass_counts[p] = 0
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        ctl = global_state.controller
+        name = "grad." + self._names[p]
+        grad_np = p.grad.detach().numpy()  # shared memory with the tensor
+        if ctl is None:
+            if self.op == Average and global_state.process_count == 1:
+                return (None, grad_np)
+            out = _C.allreduce(grad_np, op=self.op, name=name)
+            grad_np[...] = np.asarray(out)
+            return (None, grad_np)
+        scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
+        h = ctl.allreduce_async_(grad_np, grad_np, op=int(self.op),
+                                 prescale=self._prescale * scale,
+                                 postscale=self._postscale, name=name)
+        return (h, grad_np)
+
+    def synchronize(self):
+        ctl = global_state.controller
+        for p, (h, _buf) in list(self._handles.items()):
+            if h is not None and ctl is not None:
+                from ..ops.eager import _ctl
+                _ctl(ctl.wait, h)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        # Any params whose hooks did not fire (e.g. frozen this pass) are
+        # skipped; synchronize all fired handles first.
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad() called with allreduce handles in flight; call "
+                "step() or synchronize() first (reference "
+                "torch/optimizer.py:327-332)")
+        return self._opt.zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
+                         compression=None, backward_passes_per_step=1,
+                         prescale_factor=1.0, postscale_factor=1.0):
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters, op=op,
+        compression=compression,
+        backward_passes_per_step=backward_passes_per_step,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)

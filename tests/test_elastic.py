@@ -1,0 +1,118 @@
+"""Elastic training end-to-end: scripted discovery + worker failure →
+blacklist → re-rendezvous → survivors continue from committed state
+(reference test/integration/test_elastic_torch.py strategy: discovery
+fixture + exit schedule + JSON-line epoch logs)."""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.runner.elastic_driver import ElasticDriver, FixedHosts
+from horovod_tpu.runner.hosts import HostInfo
+
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    LOG = {log!r}
+    FAIL_SLOT = {fail_slot!r}
+    FAIL_EPOCH = {fail_epoch}
+
+    hvd.init()
+
+    state = elastic.ObjectState(epoch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < {epochs}:
+            if (FAIL_SLOT and
+                    os.environ.get("HVD_TPU_ELASTIC_SLOT") == FAIL_SLOT
+                    and state.epoch == FAIL_EPOCH):
+                os._exit(1)  # simulated hard failure
+            x = np.full((4,), float(hvd.rank() + 1), dtype=np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum,
+                                name=f"ep.{{state.epoch}}")
+            state.total += float(np.asarray(out)[0])
+            with open(LOG + f".{{os.environ['HVD_TPU_ELASTIC_SLOT']}}",
+                      "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size(),
+                    "sum": float(np.asarray(out)[0])}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+    train(state)
+    hvd.shutdown()
+""")
+
+
+def _read_logs(prefix, slots):
+    events = []
+    for slot in slots:
+        path = f"{prefix}.{slot}"
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                ev = json.loads(line)
+                ev["slot"] = slot
+                events.append(ev)
+    return events
+
+
+@pytest.mark.timeout(300)
+def test_elastic_worker_failure_recovers(tmp_path):
+    """3 single-slot 'hosts'; rank 1's worker dies at epoch 1; the job must
+    re-rendezvous with 2 survivors and finish all epochs."""
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(ELASTIC_WORKER.format(
+        repo=REPO, log=log, fail_slot="127.0.0.1:0", fail_epoch=1, epochs=4))
+    # Three alias-hosts that all execute locally.
+    hosts = [HostInfo("localhost", 1), HostInfo("127.0.0.1", 1),
+             HostInfo(__import__("socket").gethostname(), 1)]
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    driver = ElasticDriver(
+        FixedHosts(hosts), [sys.executable, str(script)],
+        min_np=2, max_np=3, controller_base_port=28200, verbose=True)
+    rc = driver.run()
+    assert rc == 0
+    slots = [f"{h.hostname}:0" for h in hosts]
+    events = _read_logs(log, slots)
+    # Some epoch ran with size 3 before the failure…
+    assert any(e["size"] == 3 and e["epoch"] == 0 for e in events)
+    # …and the final epoch completed with 2 survivors.
+    finals = [e for e in events if e["epoch"] == 3]
+    assert finals and all(e["size"] == 2 for e in finals)
+    # Allreduce in the 2-rank rounds sums the two live ranks' (rank+1).
+    for e in finals:
+        assert e["sum"] == pytest.approx(3.0)  # ranks 0,1 → 1+2
+
+
+@pytest.mark.timeout(300)
+def test_elastic_completes_without_failures(tmp_path):
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(ELASTIC_WORKER.format(
+        repo=REPO, log=log, fail_slot="", fail_epoch=-1, epochs=3))
+    hosts = [HostInfo("localhost", 2)]
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.5"
+    driver = ElasticDriver(
+        FixedHosts(hosts), [sys.executable, str(script)],
+        min_np=2, max_np=2, controller_base_port=28300)
+    rc = driver.run()
+    assert rc == 0
+    events = _read_logs(log, ["localhost:0", "localhost:1"])
+    assert len([e for e in events if e["epoch"] == 2]) == 2
+    assert all(e["size"] == 2 and e["sum"] == 3.0 for e in events)
