@@ -1,0 +1,105 @@
+"""Torch front-end: single-process semantics + 2-process launcher run with
+DistributedOptimizer averaging gradients (reference test/parallel/
+test_torch.py optimizer tests)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_single_process_collectives():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd.allreduce(t, op=hvd.Sum)
+    assert torch.allclose(out, t)
+    g = hvd.allgather(t)
+    assert torch.allclose(g, t)
+    b = hvd.broadcast(t, root_rank=0)
+    assert torch.allclose(b, t)
+
+
+def test_single_process_optimizer_steps():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    model = torch.nn.Linear(4, 2)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    x = torch.randn(8, 4)
+    y = model(x).sum()
+    y.backward()
+    opt.step()
+    opt.zero_grad()
+
+
+def test_sparse_allreduce_single():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    i = torch.tensor([[0, 2], [1, 0]])
+    v = torch.tensor([3.0, 4.0])
+    sp = torch.sparse_coo_tensor(i, v, (3, 2))
+    out = hvd.sparse_allreduce(sp, name="sp1", op=hvd.Sum)
+    assert torch.allclose(out.to_dense(), sp.to_dense())
+
+
+def test_broadcast_parameters_dict():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    model = torch.nn.Linear(3, 3)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+TORCH_WORKER = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(42)  # same init on all ranks
+    model = torch.nn.Linear(4, 1, bias=False)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    w0 = model.weight.detach().clone()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters())
+
+    # Per-rank input: grad of sum(w @ x) wrt w = x; rank r uses x = r+1.
+    rank, size = hvd.rank(), hvd.size()
+    x = torch.full((1, 4), float(rank + 1))
+    loss = model(x).sum()
+    loss.backward()
+    opt.step()
+    # Averaged grad = mean(r+1) = (1+2)/2 = 1.5 → w = w0 - 1.5.
+    expected = w0 - (sum(range(1, size + 1)) / size)
+    assert torch.allclose(model.weight.detach(), expected, atol=1e-5), \\
+        (model.weight, expected)
+    # All ranks hold identical weights.
+    gathered = hvd.allgather(model.weight.detach().reshape(1, -1))
+    assert torch.allclose(gathered[0], gathered[1], atol=1e-7)
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"ok": True}}, f)
+    hvd.shutdown()
+""")
+
+
+def test_torch_2proc_launcher(tmp_path):
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "res")
+    script = tmp_path / "worker.py"
+    script.write_text(TORCH_WORKER.format(repo=REPO, outfile=outfile))
+    rc = main(["-np", "2", "--controller-port", "28611",
+               sys.executable, str(script)])
+    assert rc == 0
+    for r in range(2):
+        assert json.load(open(f"{outfile}.{r}")) == {"ok": True}
