@@ -1,0 +1,20 @@
+"""Keras front-end: DistributedOptimizer re-export + callbacks.
+
+Capability parity with the reference's horovod/keras + horovod/_keras
+(callbacks.py:23-131): BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateWarmupCallback,
+LearningRateScheduleCallback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as _tf
+
+from ..tensorflow import (init, shutdown, rank, size, local_rank,
+                          local_size, allreduce, allgather, broadcast,
+                          broadcast_variables, DistributedOptimizer,
+                          Average, Sum, Adasum, Compression)
+from . import callbacks  # noqa: F401  (re-export module)

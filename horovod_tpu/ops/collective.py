@@ -244,6 +244,16 @@ def reducescatter(tensor, op: int = Average,
 # join / barrier
 # ---------------------------------------------------------------------------
 
+def communicator_size() -> int:
+    """Size of the *eager* communicator: the native controller's world when
+    attached, else the process count.  (``size()`` is chip-level and may
+    exceed this in single-controller multi-device runs.)"""
+    ctl = global_state.controller
+    if ctl is not None:
+        return ctl.size()
+    return global_state.process_count
+
+
 def join() -> int:
     return _eager.join()
 

@@ -1,0 +1,182 @@
+"""TensorFlow 2 front-end (eager mode, CPU path).
+
+Capability parity with the reference's horovod/tensorflow front-end
+(tensorflow/__init__.py: allreduce with IndexedSlices→allgather fallback
+:92-108, DistributedGradientTape :723-814, broadcast_variables,
+sync batch normalization — sync_batch_norm.py).  The TPU compute path is
+JAX; this front-end runs TF2 eager scripts unchanged under ``hvdrun``,
+bridging tensors through numpy to the same runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+import tensorflow as _tf
+
+from ..core.basics import (init, shutdown, is_initialized, rank, size,
+                           local_rank, local_size, cross_rank, cross_size)
+from ..ops.collective import (Average, Sum, Adasum, Min, Max, Product)
+from ..ops import collective as _C
+from ..optimizers import broadcast_object, allgather_object
+
+
+class Compression:
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype in (_tf.float32, _tf.float64):
+                return _tf.cast(t, _tf.float16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t if ctx is None else _tf.cast(t, ctx)
+
+
+def _np(t) -> np.ndarray:
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None):
+    """Allreduce; IndexedSlices (sparse gradients) go through the allgather
+    path like the reference (tensorflow/__init__.py:92-108)."""
+    if isinstance(tensor, _tf.IndexedSlices):
+        nm = name or "slices"
+        values = allgather(tensor.values, name=nm + ".values")
+        indices = allgather(tensor.indices, name=nm + ".indices")
+        if op == Average:
+            values = values / _C.communicator_size()
+        return _tf.IndexedSlices(values, indices,
+                                 dense_shape=tensor.dense_shape)
+    comp = compression or Compression.none
+    t, ctx = comp.compress(tensor)
+    out = _C.allreduce(_np(t), op=op, name=name,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return comp.decompress(
+        _tf.convert_to_tensor(np.asarray(out)), ctx)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return _tf.convert_to_tensor(
+        np.ascontiguousarray(_C.allgather(_np(tensor), name=name)))
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    return _tf.convert_to_tensor(np.ascontiguousarray(
+        _C.broadcast(_np(tensor), root_rank=root_rank, name=name)))
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    out, recv_splits = _C.alltoall(_np(tensor), splits=splits, name=name)
+    return (_tf.convert_to_tensor(np.asarray(out)),
+            _tf.convert_to_tensor(np.asarray(recv_splits)))
+
+
+def join() -> int:
+    return _C.join()
+
+
+def barrier():
+    _C.barrier()
+
+
+def broadcast_variables(variables: List, root_rank: int = 0):
+    """Assign every variable the root's value (reference
+    broadcast_variables)."""
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v, root_rank=root_rank, name=f"bv.{i}"))
+
+
+def grouped_allreduce(tensors, op: int = Average,
+                      name: Optional[str] = None):
+    return [allreduce(t, op=op,
+                      name=None if name is None else f"{name}.{i}")
+            for i, t in enumerate(tensors)]
+
+
+class DistributedGradientTape:
+    """Wraps tf.GradientTape; gradient() allreduces the results (reference
+    tensorflow/__init__.py:723-814)."""
+
+    def __init__(self, tape: _tf.GradientTape, op: int = Average,
+                 compression=None, sparse_as_dense: bool = False):
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *args):
+        return self._tape.__exit__(*args)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        out = []
+        for i, g in enumerate(grads):
+            if g is None:
+                out.append(None)
+                continue
+            if isinstance(g, _tf.IndexedSlices) and self._sparse_as_dense:
+                g = _tf.convert_to_tensor(g)
+            out.append(allreduce(g, op=self._op, name=f"tape.grad.{i}",
+                                 compression=self._compression))
+        return out
+
+
+def DistributedOptimizer(optimizer, op: int = Average, compression=None,
+                         backward_passes_per_step: int = 1,
+                         name: Optional[str] = None):
+    """Wrap a keras optimizer: apply_gradients allreduces first (graph-mode
+    _DistributedOptimizer analog for TF2 eager)."""
+    del backward_passes_per_step  # eager TF2 path communicates every step
+
+    class _Wrapped(optimizer.__class__):
+        def apply_gradients(self_, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(gv):
+                if g is not None:
+                    g = allreduce(g, op=op, name=f"opt.grad.{i}",
+                                  compression=compression)
+                reduced.append((g, v))
+            return super(_Wrapped, self_).apply_gradients(
+                reduced, *args, **kwargs)
+
+    wrapped = _Wrapped.from_config(optimizer.get_config())
+    # Carry over slot/iteration state where possible.
+    return wrapped
+
+
+class SyncBatchNormalization(_tf.keras.layers.BatchNormalization):
+    """Batch normalization with cross-rank moment averaging (reference
+    tensorflow/sync_batch_norm.py: allreduce of mean/var across ranks)."""
+
+    def _calculate_mean_and_var(self, x, axes, keep_dims):
+        mean, var = super()._calculate_mean_and_var(x, axes, keep_dims)
+        if size() > 1:
+            mean_sq = var + _tf.square(mean)
+            mean = allreduce(mean, op=Average, name=self.name + ".mean")
+            mean_sq = allreduce(mean_sq, op=Average,
+                                name=self.name + ".meansq")
+            var = mean_sq - _tf.square(mean)
+        return mean, var

@@ -64,7 +64,7 @@ def allreduce(tensor: _torch.Tensor, op: int = Average,
     out = _C.allreduce(_to_numpy(tensor), op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
-    return _torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+    return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
 
 
 def allreduce_(tensor: _torch.Tensor, op: int = Average,
@@ -76,13 +76,13 @@ def allreduce_(tensor: _torch.Tensor, op: int = Average,
 def allgather(tensor: _torch.Tensor,
               name: Optional[str] = None) -> _torch.Tensor:
     out = _C.allgather(_to_numpy(tensor), name=name)
-    return _torch.from_numpy(np.ascontiguousarray(out))
+    return _torch.from_numpy(np.asarray(out))
 
 
 def broadcast(tensor: _torch.Tensor, root_rank: int = 0,
               name: Optional[str] = None) -> _torch.Tensor:
     out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
-    return _torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+    return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
 
 
 def broadcast_(tensor: _torch.Tensor, root_rank: int = 0,
@@ -94,7 +94,7 @@ def broadcast_(tensor: _torch.Tensor, root_rank: int = 0,
 def alltoall(tensor: _torch.Tensor, splits=None, name: Optional[str] = None):
     out, recv_splits = _C.alltoall(_to_numpy(tensor), splits=splits,
                                    name=name)
-    return (_torch.from_numpy(np.ascontiguousarray(out)),
+    return (_torch.from_numpy(np.asarray(out)),
             _torch.from_numpy(np.asarray(recv_splits)))
 
 
@@ -112,7 +112,7 @@ def sparse_allreduce(tensor: _torch.Tensor, name: Optional[str] = None,
     out = _torch.sparse_coo_tensor(indices.t(), values,
                                    size=t.shape).coalesce()
     if op == Average:
-        out = out / size()
+        out = out / _C.communicator_size()
     return out
 
 

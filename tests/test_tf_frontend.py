@@ -1,0 +1,89 @@
+"""TF2/Keras front-end tests (single process semantics + tape/optimizer
+wrappers; reference test/parallel/test_tensorflow.py patterns)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_tf_collectives_single():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    t = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    out = hvd.allreduce(t, op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+    g = hvd.allgather(t)
+    np.testing.assert_allclose(g.numpy(), t.numpy())
+    b = hvd.broadcast(t, root_rank=0)
+    np.testing.assert_allclose(b.numpy(), t.numpy())
+
+
+def test_indexed_slices_allreduce():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    slices = tf.IndexedSlices(values=tf.constant([[1.0, 2.0]]),
+                              indices=tf.constant([1]),
+                              dense_shape=tf.constant([3, 2]))
+    out = hvd.allreduce(slices, op=hvd.Average, name="sl")
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), [[1.0, 2.0]])
+
+
+def test_distributed_gradient_tape():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    w = tf.Variable([[2.0]])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = w * w
+    grads = tape.gradient(loss, [w])
+    np.testing.assert_allclose(grads[0].numpy(), [[4.0]])
+
+
+def test_distributed_keras_optimizer():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.5))
+    w = tf.Variable(4.0)
+    opt.apply_gradients([(tf.constant(2.0), w)])
+    np.testing.assert_allclose(float(w), 3.0)
+
+
+def test_broadcast_variables():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    v = tf.Variable([1.0, 2.0])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+
+
+def test_keras_callbacks_smoke():
+    import horovod_tpu.keras as hvd_keras
+    hvd_keras.init()
+    from horovod_tpu.keras.callbacks import (
+        BroadcastGlobalVariablesCallback, MetricAverageCallback,
+        LearningRateWarmupCallback, LearningRateScheduleCallback)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(2, input_shape=(3,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    x = np.random.randn(8, 3).astype(np.float32)
+    y = np.random.randn(8, 2).astype(np.float32)
+    model.fit(x, y, epochs=2, batch_size=4, verbose=0, callbacks=[
+        BroadcastGlobalVariablesCallback(0),
+        MetricAverageCallback(),
+        LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=2),
+        LearningRateScheduleCallback(initial_lr=0.1, multiplier=0.5,
+                                     start_epoch=1),
+    ])
+
+
+def test_sync_batch_norm_single():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    layer = hvd.SyncBatchNormalization()
+    x = tf.random.normal((4, 3))
+    out = layer(x, training=True)
+    assert out.shape == (4, 3)
