@@ -1,0 +1,41 @@
+"""Elastic data-parallel training — analog of the reference's
+examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py:
+
+    hvdrun --min-np 2 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/elastic_jax_train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+
+state = elastic.ObjectState(epoch=0, weights=np.zeros(10, dtype=np.float32))
+
+
+@elastic.run
+def train(state):
+    while state.epoch < 10:
+        # One "training step": average a synthetic gradient over the
+        # current world; the world may change between commits.
+        grad = np.full((10,), float(hvd.rank() + 1), dtype=np.float32)
+        avg = np.asarray(hvd.allreduce(grad, op=hvd.Average,
+                                       name=f"g.{state.epoch}"))
+        state.weights -= 0.01 * avg
+        state.epoch += 1
+        state.commit()
+        if hvd.rank() == 0:
+            print(f"epoch {state.epoch}: world={hvd.size()} "
+                  f"w0={state.weights[0]:.4f}")
+
+
+train(state)
+hvd.shutdown()

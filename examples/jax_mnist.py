@@ -1,0 +1,78 @@
+"""MNIST-style MLP training with horovod_tpu — the minimum end-to-end slice
+(the reference's examples/pytorch/pytorch_mnist.py config, SURVEY.md §7.2),
+JAX-native.  Run single-process, or data-parallel with:
+
+    hvdrun -np 2 python examples/jax_mnist.py
+"""
+
+import argparse
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import mlp
+
+
+def synthetic_mnist(key, n=512):
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n, 28 * 28))
+    w_true = jax.random.normal(ky, (28 * 28, 10))
+    labels = jnp.argmax(x @ w_true, axis=1)
+    return x, labels
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--batch", type=int, default=64)
+    args = parser.parse_args()
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n_dev = mesh.devices.size
+
+    params = mlp.init_params(jax.random.PRNGKey(0))
+    # Scale LR by parallelism; wrap the optimizer for gradient averaging.
+    tx = hvd.DistributedOptimizer(optax.sgd(args.lr * hvd.size()))
+    opt_state = tx.init(params)
+    # Start every member from rank-0 weights.
+    x, y = synthetic_mnist(jax.random.PRNGKey(1 + hvd.rank()))
+
+    def step(params, opt_state, xb, yb):
+        def inner(p, o, xb, yb):
+            p = hvd.broadcast_parameters(p, root_rank=0) \
+                if False else p  # weights already identical (same seed)
+            loss, grads = jax.value_and_grad(mlp.loss_fn)(p, xb, yb)
+            updates, o = tx.update(grads, o, p)
+            import optax as _optax
+            p = _optax.apply_updates(p, updates)
+            return p, o, jax.lax.pmean(loss, "data")
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P(), P(), P("data"), P("data")),
+                         out_specs=(P(), P(), P()), check_vma=False)(
+            params, opt_state, xb, yb)
+
+    jstep = jax.jit(step)
+    per_step = args.batch * n_dev
+    for epoch in range(args.epochs):
+        for i in range(0, x.shape[0] - per_step + 1, per_step):
+            xb = x[i: i + per_step]
+            yb = y[i: i + per_step]
+            params, opt_state, loss = jstep(params, opt_state, xb, yb)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Flagship transformer LM training with explicit dp/pp/tp-sp/ep sharding.
+
+    python examples/jax_transformer_lm.py --dp 2 --pp 2 --mp 2 --experts 4
+
+(the reference has no model-parallel examples — DP only, SURVEY.md §2.3;
+this demonstrates the TPU-native extension surface.)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--mp", type=int, default=1)
+    p.add_argument("--experts", type=int, default=0)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--attn", choices=["megatron", "ring"],
+                   default="megatron")
+    args = p.parse_args()
+
+    hvd.init()
+    cfg = tfm.TransformerConfig(
+        vocab_size=2048, d_model=args.d_model, n_heads=8,
+        d_ff=4 * args.d_model, n_layers=args.layers, seq_len=args.seq,
+        n_experts=args.experts, attn_mode=args.attn)
+    par = tfm.ParallelConfig(dp=args.dp, pp=args.pp, mp=args.mp,
+                             n_microbatches=max(args.pp, 1))
+    mesh = create_mesh({"dp": args.dp, "pp": args.pp, "mp": args.mp})
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, par)
+    tx = optax.adamw(3e-4)
+    step, shard_params = tfm.make_train_step(cfg, par, mesh, tx)
+    params = shard_params(params)
+    opt_state = tx.init(params)
+    tokens, labels = tfm.synthetic_batch(jax.random.PRNGKey(1), cfg,
+                                         args.batch * args.dp)
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        if hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

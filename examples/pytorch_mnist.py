@@ -1,0 +1,68 @@
+"""PyTorch (CPU) data-parallel training via the torch front-end —
+drop-in analog of the reference's examples/pytorch/pytorch_mnist.py:
+
+    hvdrun -np 2 python examples/pytorch_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x.flatten(1))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234)
+    model = Net()
+    # Scale LR by world size; wrap the optimizer; broadcast initial state.
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    # Synthetic data sharded by rank.
+    g = torch.Generator().manual_seed(hvd.rank())
+    x = torch.randn(1024, 1, 28, 28, generator=g)
+    y = torch.randint(0, 10, (1024,), generator=g)
+
+    for epoch in range(args.epochs):
+        for i in range(0, len(x), args.batch):
+            optimizer.zero_grad()
+            out = model(x[i:i + args.batch])
+            loss = F.cross_entropy(out, y[i:i + args.batch])
+            loss.backward()
+            optimizer.step()
+        # Average the epoch metric across ranks.
+        avg = hvd.allreduce(loss.detach(), op=hvd.Average,
+                            name=f"loss.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""TF2/Keras data-parallel training — drop-in analog of the reference's
+examples/tensorflow2/tensorflow2_keras_mnist.py:
+
+    hvdrun -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras.callbacks import (BroadcastGlobalVariablesCallback,
+                                         MetricAverageCallback,
+                                         LearningRateWarmupCallback)
+
+
+def main():
+    hvd.init()
+    np.random.seed(hvd.rank())
+    x = np.random.randn(1024, 784).astype(np.float32)
+    y = np.random.randint(0, 10, (1024,))
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu", input_shape=(784,)),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt, loss=tf.keras.losses.
+                  SparseCategoricalCrossentropy(from_logits=True),
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, epochs=2,
+              verbose=1 if hvd.rank() == 0 else 0,
+              callbacks=[BroadcastGlobalVariablesCallback(0),
+                         MetricAverageCallback(),
+                         LearningRateWarmupCallback(
+                             initial_lr=0.01 * hvd.size(),
+                             warmup_epochs=1)])
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

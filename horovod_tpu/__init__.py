@@ -18,7 +18,7 @@ from .version import __version__
 from .core.basics import (
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, process_rank, process_count, mesh,
-    is_homogeneous, mpi_threads_supported,
+    is_homogeneous, mpi_threads_supported, start_timeline, stop_timeline,
 )
 from .core.exceptions import (
     HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
@@ -44,7 +44,8 @@ __all__ = [
     "__version__",
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "process_rank",
-    "process_count", "mesh", "is_homogeneous", "mpi_threads_supported",
+    "process_count", "mesh", "is_homogeneous", "mpi_threads_supported", "start_timeline",
+    "stop_timeline",
     "HorovodTpuError", "HorovodInternalError", "HostsUpdatedInterrupt",
     "NotInitializedError", "DuplicateNameError",
     "Average", "Sum", "Adasum", "Min", "Max", "Product",

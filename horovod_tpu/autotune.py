@@ -1,0 +1,192 @@
+"""Autotuning of runtime parameters — the ParameterManager.
+
+Capability parity with the reference's autotune subsystem
+(parameter_manager.h:42-246 + optim/bayesian_optimization.cc +
+optim/gaussian_process.cc): joint Bayesian optimization of {fusion
+threshold bytes, cycle time ms} scored by data-plane throughput
+(bytes/sec) over sample windows, with an optional CSV log
+(HOROVOD_AUTOTUNE_LOG).  Rebuilt in numpy: RBF-kernel Gaussian-process
+regression with expected-improvement acquisition maximized over a random
+candidate set (the reference uses Eigen + LBFGS for the same acquisition).
+
+The tuner runs on rank 0 (the coordinator owns fusion decisions); tuned
+parameters are applied through the native runtime's SetParams hook.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel and observation noise."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-4,
+                 signal_var: float = 1.0):
+        self.length_scale = length_scale
+        self.noise = noise
+        self.signal_var = signal_var
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._k_inv: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_var * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._k_inv = np.linalg.inv(k)
+        self._x, self._y = x, yn
+
+    def predict(self, x_star: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x_star = np.atleast_2d(np.asarray(x_star, dtype=np.float64))
+        if self._x is None:
+            mu = np.zeros(len(x_star))
+            sigma = np.full(len(x_star), math.sqrt(self.signal_var))
+            return mu * self._y_std + self._y_mean, sigma * self._y_std
+        ks = self._kernel(x_star, self._x)
+        mu = ks @ self._k_inv @ self._y
+        kss = self.signal_var * np.ones(len(x_star))
+        var = kss - np.einsum("ij,jk,ik->i", ks, self._k_inv, ks)
+        sigma = np.sqrt(np.maximum(var, 1e-12))
+        return mu * self._y_std + self._y_mean, sigma * self._y_std
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (reference bayesian_optimization.cc)."""
+    from math import erf, sqrt
+    z = (mu - best - xi) / sigma
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+    pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesianOptimizer:
+    """Maximize an unknown function over a box via GP + EI."""
+
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 seed: int = 0, n_candidates: int = 512):
+        self.bounds = np.asarray(bounds, dtype=np.float64)
+        self.rng = np.random.RandomState(seed)
+        self.n_candidates = n_candidates
+        self.gp = GaussianProcess(length_scale=0.3)
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+
+    def _normalize(self, x):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (np.asarray(x) - lo) / (hi - lo)
+
+    def _denormalize(self, u):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + np.asarray(u) * (hi - lo)
+
+    def observe(self, x, y: float):
+        self.xs.append(self._normalize(x))
+        self.ys.append(float(y))
+        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+
+    def suggest(self) -> np.ndarray:
+        if len(self.xs) < 3:  # bootstrap with random exploration
+            return self._denormalize(self.rng.rand(len(self.bounds)))
+        cand = self.rng.rand(self.n_candidates, len(self.bounds))
+        mu, sigma = self.gp.predict(cand)
+        ei = expected_improvement(mu, sigma, max(self.ys))
+        return self._denormalize(cand[int(np.argmax(ei))])
+
+    def best(self) -> Tuple[np.ndarray, float]:
+        i = int(np.argmax(self.ys))
+        return self._denormalize(self.xs[i]), self.ys[i]
+
+
+class ParameterManager:
+    """Tunes {log2(fusion bytes), cycle ms} against observed throughput.
+
+    Reference semantics (parameter_manager.h:234-236): scores are throughput
+    bytes/sec over sample windows; after ``max_samples`` windows the best
+    parameters are frozen.
+    """
+
+    # log2(bytes): 1 MB .. 256 MB; cycle: 0.5 .. 25 ms.
+    BOUNDS = [(20.0, 28.0), (0.5, 25.0)]
+
+    def __init__(self, apply_fn, max_samples: int = 20,
+                 window_seconds: float = 2.0,
+                 log_file: Optional[str] = None, seed: int = 0):
+        """apply_fn(fusion_bytes: int, cycle_ms: float) applies parameters
+        to the runtime (native SetParams)."""
+        self._apply = apply_fn
+        self._opt = BayesianOptimizer(self.BOUNDS, seed=seed)
+        self._max_samples = max_samples
+        self._window = window_seconds
+        self._log_file = log_file
+        self._samples = 0
+        self._frozen = False
+        self._current = None
+        self._window_start = time.perf_counter()
+        self._bytes = 0
+        self._propose()
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    @property
+    def current(self):
+        return self._current
+
+    def _propose(self):
+        x = self._opt.suggest()
+        self._current = (int(2 ** x[0]), float(x[1]))
+        self._apply(*self._current)
+
+    def record_bytes(self, nbytes: int):
+        """Feed data-plane traffic; closes a window when enough time passed."""
+        if self._frozen:
+            return
+        self._bytes += int(nbytes)
+        now = time.perf_counter()
+        elapsed = now - self._window_start
+        if elapsed < self._window:
+            return
+        score = self._bytes / elapsed
+        self._observe(score)
+        self._bytes = 0
+        self._window_start = now
+
+    def _observe(self, score: float):
+        x = np.array([math.log2(self._current[0]), self._current[1]])
+        self._opt.observe(x, score)
+        self._log(score)
+        self._samples += 1
+        if self._samples >= self._max_samples:
+            best_x, best_y = self._opt.best()
+            self._current = (int(2 ** best_x[0]), float(best_x[1]))
+            self._apply(*self._current)
+            self._frozen = True
+            self._log(best_y, tag="final")
+        else:
+            self._propose()
+
+    def _log(self, score: float, tag: str = "sample"):
+        if not self._log_file:
+            return
+        try:
+            with open(self._log_file, "a") as f:
+                f.write(f"{tag},{self._current[0]},{self._current[1]:.3f},"
+                        f"{score:.1f}\n")
+        except OSError:
+            pass

@@ -220,3 +220,22 @@ def is_homogeneous() -> bool:
 def mpi_threads_supported() -> bool:
     """API-compat shim; there is no MPI in the TPU runtime."""
     return False
+
+
+def start_timeline(filename: str, mark_cycles: bool = False) -> None:
+    """Start Chrome-trace timeline recording at runtime (reference
+    horovod_start_timeline, operations.cc:740-769).  Requires the native
+    controller (launcher-run jobs); a warning is logged otherwise."""
+    del mark_cycles  # cycle markers controlled by env knob at init
+    _check_init()
+    if global_state.controller is None:
+        log.warning("start_timeline: no native runtime attached; timeline "
+                    "is recorded only for launcher-run jobs")
+        return
+    global_state.controller.start_timeline(filename)
+
+
+def stop_timeline() -> None:
+    _check_init()
+    if global_state.controller is not None:
+        global_state.controller.stop_timeline()

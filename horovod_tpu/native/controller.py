@@ -56,7 +56,8 @@ def load_library():
     lib.hvd_native_init.restype = ctypes.c_int
     lib.hvd_native_init.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
-        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_char_p]
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_char_p,
+        ctypes.c_int64]
     lib.hvd_native_rank.restype = ctypes.c_int
     lib.hvd_native_size.restype = ctypes.c_int
     lib.hvd_native_initialized.restype = ctypes.c_int
@@ -94,6 +95,9 @@ def load_library():
     lib.hvd_native_barrier.restype = ctypes.c_int
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
+    lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
+    lib.hvd_native_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
     _lib = lib
     return lib
 
@@ -125,10 +129,20 @@ class NativeController:
             cfg.fusion_threshold_bytes, cfg.cycle_time_ms,
             1e9 if cfg.stall_check_disable else cfg.stall_warning_time_seconds,
             cfg.stall_shutdown_time_seconds,
-            cfg.timeline_filename.encode())
+            cfg.timeline_filename.encode(), cfg.cache_capacity)
         if rc != 0:
             raise NativeError(self._last_error())
         self._counters = {}
+        # Autotune (reference ParameterManager): rank 0 owns fusion
+        # decisions, so the tuner runs there and applies via SetParams.
+        self._autotune = None
+        if cfg.autotune and rank == 0:
+            from ..autotune import ParameterManager
+            self._autotune = ParameterManager(
+                apply_fn=lambda fusion, cycle:
+                    self._lib.hvd_native_set_params(int(fusion),
+                                                    float(cycle)),
+                log_file=cfg.autotune_log or None)
 
     @classmethod
     def from_env(cls) -> "NativeController":
@@ -160,6 +174,16 @@ class NativeController:
             err = self._last_error()
             self._lib.hvd_native_release(handle)
             raise NativeError(err)
+        self._autotune_tick()
+
+    def _autotune_tick(self):
+        if self._autotune is None:
+            return
+        nbytes = ctypes.c_int64()
+        secs = ctypes.c_double()
+        self._lib.hvd_native_counters(ctypes.byref(nbytes),
+                                      ctypes.byref(secs))
+        self._autotune.record_bytes(nbytes.value)
 
     # -- collectives -------------------------------------------------------
 

@@ -49,11 +49,12 @@ extern "C" {
 int hvd_native_init(int rank, int size, const char* coord_addr,
                     int64_t fusion_threshold, double cycle_time_ms,
                     double stall_warning_s, double stall_shutdown_s,
-                    const char* timeline_file) {
+                    const char* timeline_file, int64_t cache_capacity) {
   Status st = Runtime::Get().Init(rank, size, coord_addr, fusion_threshold,
                                   cycle_time_ms, stall_warning_s,
                                   stall_shutdown_s,
-                                  timeline_file ? timeline_file : "");
+                                  timeline_file ? timeline_file : "",
+                                  cache_capacity < 0 ? 0 : cache_capacity);
   if (!st.ok()) {
     SetError(st.reason);
     return -1;
@@ -148,6 +149,14 @@ int hvd_native_join() { return Runtime::Get().JoinBlocking(); }
 int hvd_native_barrier() {
   Status st = Runtime::Get().BarrierBlocking();
   return st.ok() ? 0 : -1;
+}
+
+void hvd_native_set_params(int64_t fusion_threshold, double cycle_time_ms) {
+  Runtime::Get().SetParams(fusion_threshold, cycle_time_ms);
+}
+
+void hvd_native_counters(int64_t* bytes, double* seconds) {
+  Runtime::Get().ReadCounters(bytes, seconds);
 }
 
 void hvd_native_start_timeline(const char* filename) {

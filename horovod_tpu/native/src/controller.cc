@@ -113,6 +113,52 @@ Status Controller::Exchange(const RequestList& mine, ResponseList* out) {
   return Status::OK();
 }
 
+void Controller::AbsorbCacheHits(const std::vector<RequestList>& lists,
+                                 ResponseList& rl) {
+  // Translate each rank's cache-hit bits into table_ entries using the
+  // coordinator's cached per-rank metadata (reference fast path,
+  // controller.cc:181-237).  Bits hit by *every* non-joined rank are
+  // reported back as valid_cache_bits for the deterministic LRU touch.
+  const int size = net_->size();
+  std::map<uint32_t, int> hit_counts;
+  for (int r = 0; r < size; ++r) {
+    const auto& bits = lists[r].cache_hits;
+    for (size_t word = 0; word < bits.size(); ++word) {
+      uint64_t w = bits[word];
+      while (w) {
+        uint32_t bit = word * 64 + __builtin_ctzll(w);
+        w &= w - 1;
+        if (!cache_.has_bit(bit)) {
+          rl.resend_bits.push_back(bit);  // tell the rank to renegotiate
+          continue;
+        }
+        const CachedTensor& ct = cache_.Get(bit);
+        const std::string& name = ct.meta.name;
+        auto it = table_.find(name);
+        if (it == table_.end()) {
+          PendingTensor pt;
+          pt.first = ct.meta;
+          pt.first_report = std::chrono::steady_clock::now();
+          table_.emplace(name, std::move(pt));
+          arrival_order_.push_back(name);
+          it = table_.find(name);
+        }
+        auto rm = ct.by_rank.find(r);
+        it->second.by_rank[r] = rm != ct.by_rank.end() ? rm->second
+                                                       : ct.meta;
+        hit_counts[bit]++;
+      }
+    }
+  }
+  int needed = 0;
+  for (int r = 0; r < size; ++r)
+    if (!joined_.count(r)) needed++;
+  for (const auto& [bit, count] : hit_counts)
+    if (count >= needed && needed > 0)
+      rl.valid_cache_bits.push_back(bit);
+  cache_.Touch(rl.valid_cache_bits);
+}
+
 ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
   const int size = net_->size();
   ResponseList rl;
@@ -134,8 +180,13 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       } else {
         it->second.by_rank[r] = q;
       }
+      // Note: a full request for a cached name does NOT invalidate the
+      // coordinator entry — other ranks may still be announcing via its
+      // bit this very round; the entry is refreshed when the tensor's
+      // response is rebuilt below.
     }
   }
+  if (cache_.enabled()) AbsorbCacheHits(lists, rl);
 
   // Find ready tensors (reported by every non-joined rank), preserving
   // arrival order for deterministic fusion across iterations.
@@ -160,12 +211,22 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
     PendingTensor& pt = table_[name];
     std::string err = Validate(pt.by_rank);
     const Request& q = pt.first;
+    // Cache slot for this tensor: reuse its bit or assign a fresh one;
+    // refresh the per-rank metadata (reference ResponseCache put path).
+    uint32_t cache_bit = UINT32_MAX;
+    if (err.empty() && cache_.enabled()) {
+      int32_t b = cache_.BitForName(name);
+      cache_bit = b >= 0 ? static_cast<uint32_t>(b) : cache_.Assign(name);
+      cache_.InsertAt(cache_bit, name, q);
+      cache_.GetMutable(cache_bit).by_rank = pt.by_rank;
+    }
     if (!err.empty()) {
       Response resp;
       resp.type = q.type;
       resp.names = {name};
       resp.error = err;
       rl.responses.push_back(resp);
+      cache_.Invalidate(name);
       open_fusion = nullptr;
     } else if (q.type == RequestType::ALLREDUCE) {
       int64_t bytes = NumElements(q.shape) * DataTypeSize(q.dtype);
@@ -173,10 +234,11 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
           open_fusion != nullptr && open_fusion->dtype == q.dtype &&
           open_fusion->op == q.op && open_fusion->prescale == q.prescale &&
           open_fusion->postscale == q.postscale &&
-          open_bytes + bytes <= cfg_.fusion_threshold_bytes;
+          open_bytes + bytes <= effective_fusion_threshold();
       if (fusible) {
         open_fusion->names.push_back(name);
         open_fusion->sizes.push_back(NumElements(q.shape));
+        open_fusion->cache_bits.push_back(cache_bit);
         open_bytes += bytes;
       } else {
         Response resp;
@@ -187,6 +249,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
         resp.prescale = q.prescale;
         resp.postscale = q.postscale;
         resp.sizes = {NumElements(q.shape)};
+        resp.cache_bits = {cache_bit};
         rl.responses.push_back(resp);
         open_fusion = &rl.responses.back();
         open_bytes = bytes;
@@ -208,6 +271,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       int64_t row_elems = 1;
       for (size_t d = 1; d < q.shape.size(); ++d) row_elems *= q.shape[d];
       resp.sizes.push_back(row_elems);
+      resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;
     } else if (q.type == RequestType::BROADCAST) {
@@ -217,6 +281,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       resp.dtype = q.dtype;
       resp.root_rank = q.root_rank;
       resp.sizes = {NumElements(q.shape)};
+      resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;
     } else if (q.type == RequestType::ALLTOALL) {
@@ -237,6 +302,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       int64_t a2a_row_elems = 1;
       for (size_t d = 1; d < q.shape.size(); ++d) a2a_row_elems *= q.shape[d];
       resp.sizes.push_back(a2a_row_elems);
+      resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;
     }

@@ -12,6 +12,7 @@
 // some-but-not-all ranks past a warning window.
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <map>
 #include <memory>
@@ -19,6 +20,7 @@
 #include <string>
 #include <vector>
 
+#include "cache.h"
 #include "net.h"
 #include "wire.h"
 
@@ -28,19 +30,32 @@ struct ControllerConfig {
   int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
   double stall_warning_s = 60.0;
   double stall_shutdown_s = 0.0;  // 0 = never
+  size_t cache_capacity = 1024;   // response cache entries (0 = disabled)
 };
 
 class Controller {
  public:
   Controller(Network* net, const ControllerConfig& cfg)
-      : net_(net), cfg_(cfg) {}
+      : net_(net), cfg_(cfg), cache_(cfg.cache_capacity) {}
 
   // Synchronous round: every rank calls this every cycle. Returns the
   // coordinator's ResponseList.
   Status Exchange(const RequestList& mine, ResponseList* out);
 
+  // Autotune hook (ParameterManager, reference parameter_manager.h:42-246):
+  // adjust the coordinator's fusion threshold at runtime.
+  void SetFusionThreshold(int64_t bytes) {
+    fusion_threshold_.store(bytes);
+  }
+  int64_t effective_fusion_threshold() const {
+    int64_t dyn = fusion_threshold_.load();
+    return dyn > 0 ? dyn : cfg_.fusion_threshold_bytes;
+  }
+
  private:
   ResponseList Coordinate(std::vector<RequestList>& lists);
+  void AbsorbCacheHits(const std::vector<RequestList>& lists,
+                       ResponseList& rl);
   void CheckStalls(ResponseList& rl);
 
   struct PendingTensor {
@@ -52,7 +67,9 @@ class Controller {
 
   Network* net_;
   ControllerConfig cfg_;
+  std::atomic<int64_t> fusion_threshold_{0};  // 0 -> use cfg_ value
   // Coordinator-only state (persists across rounds).
+  ResponseCache cache_;
   std::map<std::string, PendingTensor> table_;
   std::vector<std::string> arrival_order_;
   std::set<int32_t> joined_;

@@ -14,22 +14,28 @@ Runtime& Runtime::Get() {
 Status Runtime::Init(int rank, int size, const std::string& coord_addr,
                      int64_t fusion_threshold, double cycle_time_ms,
                      double stall_warning_s, double stall_shutdown_s,
-                     const std::string& timeline_file) {
+                     const std::string& timeline_file,
+                     size_t cache_capacity) {
   if (initialized_) return Status::OK();
   Status st;
   net_ = Network::Connect(rank, size, coord_addr, &st);
   if (!net_) return st;
+  worker_cache_ = ResponseCache(cache_capacity);
   ControllerConfig ccfg;
   ccfg.fusion_threshold_bytes = fusion_threshold;
   ccfg.stall_warning_s = stall_warning_s;
   ccfg.stall_shutdown_s = stall_shutdown_s;
+  ccfg.cache_capacity = cache_capacity;
   controller_ = std::make_unique<Controller>(net_.get(), ccfg);
   fusion_threshold_ = fusion_threshold;
   cycle_time_ms_ = cycle_time_ms;
-  if (!timeline_file.empty()) timeline_.Start(timeline_file, rank);
+  if (!timeline_file.empty() && rank == 0)
+    timeline_.Start(timeline_file, rank);
   stop_ = false;
   loop_dead_ = false;
   loop_error_ = Status::OK();
+  counter_start_ = std::chrono::steady_clock::now();
+  bytes_processed_ = 0;
   background_ = std::thread([this] { BackgroundLoop(); });
   initialized_ = true;
   return Status::OK();
@@ -176,7 +182,8 @@ void Runtime::BackgroundLoop() {
       // Sleep to cycle time unless new work arrives (RunLoopOnce,
       // operations.cc:592-598).
       enqueue_cv_.wait_for(
-          lk, std::chrono::duration<double, std::milli>(cycle_time_ms_),
+          lk, std::chrono::duration<double, std::milli>(
+              cycle_time_ms_.load()),
           [this] { return stop_.load(); });
       for (const auto& name : pending_order_) {
         auto it = pending_.find(name);
@@ -193,10 +200,19 @@ void Runtime::BackgroundLoop() {
         q.prescale = e->prescale;
         q.postscale = e->postscale;
         q.splits = e->splits;
-        rl.requests.push_back(std::move(q));
+        // Response-cache fast path: announce a previously-negotiated
+        // tensor as one bit instead of the full request (reference
+        // controller.cc:181-237).
+        int32_t bit = worker_cache_.enabled() ? worker_cache_.Lookup(q)
+                                              : -1;
+        if (bit >= 0) {
+          SetBit(rl.cache_hits, static_cast<uint32_t>(bit));
+        } else {
+          rl.requests.push_back(std::move(q));
+        }
         submitted_[name] = e;
       }
-      for (const auto& q : rl.requests) pending_.erase(q.name);
+      for (const auto& [name, e] : submitted_) pending_.erase(name);
       pending_order_.clear();
     }
     rl.join = join_requested_.load();
@@ -233,8 +249,23 @@ void Runtime::BackgroundLoop() {
     }
     timeline_.MarkCycle();
 
-    // 3. Execute responses in coordinator order (identical on all ranks).
+    // 3. Self-heal any cache divergence: renegotiate bits the
+    // coordinator no longer holds.
+    for (uint32_t bit : responses.resend_bits) {
+      std::string name = worker_cache_.NameForBit(bit);
+      if (name.empty()) continue;
+      worker_cache_.Invalidate(name);
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = submitted_.find(name);
+      if (it != submitted_.end()) {
+        pending_[name] = it->second;
+        pending_order_.push_back(name);
+        submitted_.erase(it);
+      }
+    }
+    // 4. Execute responses in coordinator order (identical on all ranks).
     for (const auto& resp : responses.responses) ExecuteResponse(resp);
+    worker_cache_.Touch(responses.valid_cache_bits);
 
     // 4. Join / barrier releases.
     if (responses.last_joined_rank >= 0) {
@@ -257,10 +288,38 @@ void Runtime::BackgroundLoop() {
 void Runtime::ExecuteResponse(const Response& resp) {
   if (!resp.error.empty()) {
     for (const auto& name : resp.names) {
+      worker_cache_.Invalidate(name);
       auto e = TakeSubmitted(name);
       if (e) Finish(e, Status::Error(resp.error));
     }
     return;
+  }
+  // Mirror the coordinator's cache-slot assignments using this rank's own
+  // metadata for the lookup key.
+  if (worker_cache_.enabled()) {
+    for (size_t i = 0; i < resp.names.size() && i < resp.cache_bits.size();
+         ++i) {
+      if (resp.cache_bits[i] == UINT32_MAX) continue;
+      std::shared_ptr<TensorEntry> e;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = submitted_.find(resp.names[i]);
+        if (it != submitted_.end()) e = it->second;
+      }
+      if (!e) continue;  // joined rank: no local meta to cache
+      Request q;
+      q.type = e->type;
+      q.rank = net_->rank();
+      q.name = e->name;
+      q.dtype = e->dtype;
+      q.shape = e->shape;
+      q.op = e->op;
+      q.root_rank = e->root_rank;
+      q.prescale = e->prescale;
+      q.postscale = e->postscale;
+      q.splits = e->splits;
+      worker_cache_.InsertAt(resp.cache_bits[i], resp.names[i], q);
+    }
   }
   switch (resp.type) {
     case RequestType::ALLREDUCE: {
@@ -322,6 +381,7 @@ void Runtime::ExecuteAllreduce(
   }
   timeline_.Record(resp.names[0], "E", "RING_ALLREDUCE");
 
+  if (st.ok()) bytes_processed_ += total_bytes;
   if (st.ok()) {
     if (resp.op == ReduceOp::AVERAGE)
       ScaleBuffer(fb, total_elems, resp.dtype, 1.0 / net_->size());
@@ -441,6 +501,19 @@ Status Runtime::BarrierBlocking() {
   sync_cv_.wait(lk, [this] { return barrier_released_ || stop_; });
   barrier_released_ = false;
   return Status::OK();
+}
+
+void Runtime::SetParams(int64_t fusion_threshold, double cycle_time_ms) {
+  if (fusion_threshold > 0 && controller_)
+    controller_->SetFusionThreshold(fusion_threshold);
+  if (cycle_time_ms > 0) cycle_time_ms_ = cycle_time_ms;
+}
+
+void Runtime::ReadCounters(int64_t* bytes, double* seconds) {
+  auto now = std::chrono::steady_clock::now();
+  *bytes = bytes_processed_.exchange(0);
+  *seconds = std::chrono::duration<double>(now - counter_start_).count();
+  counter_start_ = now;
 }
 
 void Runtime::StartTimeline(const std::string& filename) {

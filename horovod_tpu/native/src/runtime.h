@@ -19,6 +19,7 @@
 #include <thread>
 #include <vector>
 
+#include "cache.h"
 #include "collectives.h"
 #include "common.h"
 #include "controller.h"
@@ -41,7 +42,8 @@ class Runtime {
   Status Init(int rank, int size, const std::string& coord_addr,
               int64_t fusion_threshold, double cycle_time_ms,
               double stall_warning_s, double stall_shutdown_s,
-              const std::string& timeline_file);
+              const std::string& timeline_file,
+              size_t cache_capacity = 1024);
   void Shutdown();
   bool initialized() const { return initialized_; }
   int rank() const { return net_ ? net_->rank() : 0; }
@@ -56,6 +58,9 @@ class Runtime {
 
   int JoinBlocking();
   Status BarrierBlocking();
+  // Autotune hooks: runtime-adjustable knobs + data-plane byte counters.
+  void SetParams(int64_t fusion_threshold, double cycle_time_ms);
+  void ReadCounters(int64_t* bytes, double* seconds);
   void StartTimeline(const std::string& filename);
   void StopTimeline();
 
@@ -80,7 +85,7 @@ class Runtime {
   std::unique_ptr<Network> net_;
   std::unique_ptr<Controller> controller_;
   std::thread background_;
-  double cycle_time_ms_ = 1.0;
+  std::atomic<double> cycle_time_ms_{1.0};
 
   std::mutex mu_;
   std::condition_variable enqueue_cv_;
@@ -105,7 +110,12 @@ class Runtime {
   bool barrier_released_ = false;
 
   std::vector<uint8_t> fusion_buffer_;
+  // Worker-side response cache mirror (bit table mirrors the coordinator's
+  // assignments received in responses).
+  ResponseCache worker_cache_{1024};
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  std::atomic<int64_t> bytes_processed_{0};
+  std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;
   Status loop_error_;
 };

@@ -62,7 +62,7 @@ static void SerializeResponse(const Response& s, Writer& w) {
   w.f64(s.prescale);
   w.f64(s.postscale);
   w.vec_i64(s.sizes);
-  w.u32(s.cache_bit);
+  w.vec_u32(s.cache_bits);
 }
 
 static Response DeserializeResponse(Reader& r) {
@@ -78,7 +78,7 @@ static Response DeserializeResponse(Reader& r) {
   s.prescale = r.f64();
   s.postscale = r.f64();
   s.sizes = r.vec_i64();
-  s.cache_bit = r.u32();
+  s.cache_bits = r.vec_u32();
   return s;
 }
 
@@ -86,6 +86,7 @@ void SerializeResponseList(const ResponseList& rl, Writer& w) {
   w.u32(static_cast<uint32_t>(rl.responses.size()));
   for (const auto& s : rl.responses) SerializeResponse(s, w);
   w.vec_u32(rl.valid_cache_bits);
+  w.vec_u32(rl.resend_bits);
   w.u8(rl.shutdown ? 1 : 0);
   w.u8(rl.barrier_release ? 1 : 0);
   w.i32(rl.last_joined_rank);
@@ -98,6 +99,7 @@ ResponseList DeserializeResponseList(Reader& r) {
   for (uint32_t i = 0; i < n; ++i)
     rl.responses.push_back(DeserializeResponse(r));
   rl.valid_cache_bits = r.vec_u32();
+  rl.resend_bits = r.vec_u32();
   rl.shutdown = r.u8() != 0;
   rl.barrier_release = r.u8() != 0;
   rl.last_joined_rank = r.i32();

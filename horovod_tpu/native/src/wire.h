@@ -51,12 +51,17 @@ struct Response {
   // allgather: first dims per rank, flattened [name0_rank0.. name0_rankN,
   // name1_rank0 ...]; alltoall: recv splits matrix row-major [src][dst].
   std::vector<int64_t> sizes;
-  uint32_t cache_bit = UINT32_MAX;       // assigned cache slot (if cached)
+  // Cache slot per name (aligned with ``names``; UINT32_MAX = uncached).
+  std::vector<uint32_t> cache_bits;
 };
 
 struct ResponseList {
   std::vector<Response> responses;
   std::vector<uint32_t> valid_cache_bits;  // intersection across ranks
+  // Bits a rank announced that the coordinator no longer holds: the rank
+  // must invalidate its entry and resend a full request (self-healing on
+  // any cache divergence).
+  std::vector<uint32_t> resend_bits;
   bool shutdown = false;                   // all ranks done → stop loop
   bool barrier_release = false;
   int32_t last_joined_rank = -1;           // all ranks joined → returned

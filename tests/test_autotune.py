@@ -1,0 +1,71 @@
+"""Autotune: GP regression sanity, Bayesian optimization convergence on a
+synthetic objective, ParameterManager window mechanics (reference
+parameter_manager/bayesian_optimization behavior)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune import (BayesianOptimizer, GaussianProcess,
+                                  ParameterManager, expected_improvement)
+
+
+def test_gp_fits_function():
+    gp = GaussianProcess(length_scale=0.5)
+    x = np.linspace(0, 1, 12)[:, None]
+    y = np.sin(2 * math.pi * x[:, 0])
+    gp.fit(x, y)
+    mu, sigma = gp.predict(x)
+    np.testing.assert_allclose(mu, y, atol=0.05)
+    # Uncertainty grows away from data.
+    _, sigma_far = gp.predict(np.array([[3.0]]))
+    assert sigma_far[0] > sigma.mean()
+
+
+def test_expected_improvement_prefers_uncertain_high_mean():
+    mu = np.array([0.5, 1.0, 1.0])
+    sigma = np.array([0.01, 0.01, 0.5])
+    ei = expected_improvement(mu, sigma, best=0.9)
+    assert ei[2] > ei[1] > ei[0]
+
+
+def test_bayesian_optimizer_converges():
+    # Objective peaked at (0.7, 0.3) in a unit box.
+    def f(x):
+        return -((x[0] - 0.7) ** 2 + (x[1] - 0.3) ** 2)
+
+    opt = BayesianOptimizer([(0.0, 1.0), (0.0, 1.0)], seed=1)
+    for _ in range(25):
+        x = opt.suggest()
+        opt.observe(x, f(x))
+    best_x, best_y = opt.best()
+    assert f(best_x) > -0.05, (best_x, best_y)
+
+
+def test_parameter_manager_applies_and_freezes():
+    applied = []
+
+    pm = ParameterManager(
+        apply_fn=lambda fusion, cycle: applied.append((fusion, cycle)),
+        max_samples=4, window_seconds=0.0)
+    assert len(applied) == 1  # initial proposal applied
+    for _ in range(4):
+        pm.record_bytes(1000)
+    assert pm.frozen
+    fusion, cycle = pm.current
+    assert 2 ** 20 <= fusion <= 2 ** 28
+    assert 0.5 <= cycle <= 25.0
+    # Final best re-applied.
+    assert applied[-1] == pm.current
+
+
+def test_parameter_manager_logs(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(apply_fn=lambda f, c: None, max_samples=2,
+                          window_seconds=0.0, log_file=str(log))
+    pm.record_bytes(100)
+    pm.record_bytes(100)
+    lines = log.read_text().strip().splitlines()
+    assert len(lines) == 3  # 2 samples + final
+    assert lines[-1].startswith("final,")

@@ -1,0 +1,53 @@
+"""Cluster integrations: the pure-Python placement/rank logic (testable
+without ray/spark clusters — reference test/single/test_ray.py pattern) and
+the dependency gates."""
+
+import pytest
+
+from horovod_tpu.ray import assign_ranks, plan_placement
+
+
+def test_plan_placement_spread():
+    plan = plan_placement(4, cpus_per_worker=2.0)
+    assert plan.strategy == "SPREAD"
+    assert plan.bundles == [{"CPU": 2.0}] * 4
+
+
+def test_plan_placement_pack():
+    plan = plan_placement(8, cpus_per_worker=1.0, workers_per_host=4)
+    assert plan.strategy == "PACK"
+    assert plan.bundles == [{"CPU": 4.0}, {"CPU": 4.0}]
+
+
+def test_plan_placement_strict_pack_single_host():
+    plan = plan_placement(4, workers_per_host=8)
+    assert plan.strategy == "STRICT_PACK"
+    assert plan.bundles == [{"CPU": 4.0}]
+
+
+def test_plan_placement_gpu():
+    plan = plan_placement(2, use_gpu=True, gpus_per_worker=1.0)
+    assert all(b["GPU"] == 1.0 for b in plan.bundles)
+
+
+def test_assign_ranks_host_major():
+    slots = assign_ranks(["a", "b", "a", "b"])
+    # Host-major: both 'a' slots get adjacent ranks.
+    by_host = {}
+    for s in slots:
+        by_host.setdefault(s.hostname, []).append(s.rank)
+    assert sorted(by_host["a"]) == [by_host["a"][0], by_host["a"][0] + 1]
+    assert all(s.size == 4 for s in slots)
+    assert {s.cross_size for s in slots} == {2}
+
+
+def test_ray_executor_gated():
+    from horovod_tpu.ray import RayExecutor
+    with pytest.raises(ImportError, match="ray"):
+        RayExecutor(num_workers=2)
+
+
+def test_spark_run_gated():
+    from horovod_tpu import spark
+    with pytest.raises(ImportError, match="pyspark"):
+        spark.run(lambda: None)

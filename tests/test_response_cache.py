@@ -1,0 +1,70 @@
+"""Response cache: repeat-iteration tensors negotiate via cache bits and
+stay numerically correct; disabling the cache also works (reference
+response_cache.h semantics driven through the multi-process harness)."""
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker(rank, size, port, capacity, out_queue):
+    sys.path.insert(0, REPO)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ["HVD_TPU_CACHE_CAPACITY"] = str(capacity)
+    from horovod_tpu.native.controller import NativeController
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    try:
+        # Same tensor names over many "iterations": after iteration 0 all
+        # announcements ride the cache bits.
+        for it in range(6):
+            for t in range(4):
+                x = np.full((32,), float(rank + 1 + it), dtype=np.float32)
+                out = ctl.allreduce(x, op=1, name=f"grad.{t}")
+                expected = sum(r + 1 + it for r in range(size))
+                np.testing.assert_allclose(out, expected)
+            # allgather with per-rank first dims is cacheable per rank.
+            g = ctl.allgather(np.full((rank + 1, 2), float(rank),
+                              dtype=np.float32), name="gath")
+            assert g.shape[0] == sum(r + 1 for r in range(size))
+        # Shape change on a cached name: miss → renegotiate → correct.
+        x = np.full((8,), 1.0, dtype=np.float32)
+        out = ctl.allreduce(x, op=1, name="grad.0")
+        np.testing.assert_allclose(out, size)
+        out_queue.put((rank, "ok", True))
+    except Exception as e:  # noqa: BLE001
+        out_queue.put((rank, "error", repr(e)))
+    finally:
+        ctl.shutdown()
+
+
+@pytest.mark.parametrize("capacity", [1024, 2, 0])
+def test_cache_iterations(capacity):
+    size = 3
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker,
+                         args=(r, size, port, capacity, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=120)
+        assert status == "ok", f"rank {rank}: {payload}"
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0

@@ -1,0 +1,41 @@
+"""Timeline: the launcher-run job with --timeline-filename must produce a
+valid Chrome-trace JSON with negotiate/operation phases (reference
+test/parallel/test_timeline.py asserts the emitted trace structure)."""
+
+import json
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    for i in range(3):
+        hvd.allreduce(np.ones((16,), dtype=np.float32), op=hvd.Sum,
+                      name=f"tl.{{i}}")
+    hvd.shutdown()
+""")
+
+
+def test_timeline_chrome_trace(tmp_path):
+    from horovod_tpu.runner.launch import main
+    tl = str(tmp_path / "timeline.json")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    rc = main(["-np", "2", "--controller-port", "28711",
+               "--timeline-filename", tl, sys.executable, str(script)])
+    assert rc == 0
+    events = json.load(open(tl))
+    assert isinstance(events, list) and events
+    names = {e["name"] for e in events}
+    assert any(n.startswith("tl.") for n in names)
+    cats = {e.get("cat") for e in events}
+    assert "NEGOTIATE" in cats
+    assert "RING_ALLREDUCE" in cats
+    phases = {e["ph"] for e in events}
+    assert {"B", "E"} <= phases
