@@ -27,8 +27,11 @@ _DTYPE_CODES = {
     np.dtype(np.float64): 6,
     np.dtype(np.bool_): 7,
 }
-# bfloat16 (code 8) is translated through its 2-byte view when ml_dtypes is
-# available; jax arrays are converted by the caller.
+try:  # bfloat16 — the TPU-native wire format (C++ kernels: code 8)
+    import ml_dtypes as _ml_dtypes
+    _DTYPE_CODES[np.dtype(_ml_dtypes.bfloat16)] = 8
+except ImportError:
+    pass
 
 
 def _lib_path() -> str:
@@ -96,6 +99,7 @@ def load_library():
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
+    lib.hvd_native_set_topology.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.hvd_native_counters.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
     _lib = lib
@@ -132,6 +136,11 @@ class NativeController:
             cfg.timeline_filename.encode(), cfg.cache_capacity)
         if rc != 0:
             raise NativeError(self._last_error())
+        # Node topology for hierarchical collectives (from the launcher's
+        # env contract; reference HOROVOD_HIERARCHICAL_ALLREDUCE knob).
+        local_size = int(_config.get_env("LOCAL_SIZE", "1") or 1)
+        self._lib.hvd_native_set_topology(
+            local_size, 1 if cfg.hierarchical_allreduce else 0)
         self._counters = {}
         # Autotune (reference ParameterManager): rank 0 owns fusion
         # decisions, so the tuner runs there and applies via SetParams.
@@ -226,6 +235,26 @@ class NativeController:
         self._wait(h)
         self._lib.hvd_native_release(h)
         return out
+
+    def grouped_allreduce(self, arrs, op: int = 1, prescale: float = 1.0,
+                          postscale: float = 1.0,
+                          name: Optional[str] = None):
+        """Enqueue a group atomically and wait on all (reference GroupTable
+        semantics, group_table.h:30-59): all members are in flight together
+        so the background runtime fuses them into shared ring launches."""
+        base = (name or
+                self._auto_name("grouped", None).decode())
+        outs, handles = [], []
+        for i, arr in enumerate(arrs):
+            arr = np.ascontiguousarray(arr)
+            out = np.empty_like(arr)
+            outs.append(out)
+            handles.append(self.allreduce_async_(
+                arr, out, op=op, prescale=prescale, postscale=postscale,
+                name=f"{base}.{i}"))
+        for h in handles:
+            self.wait(h)
+        return outs
 
     def allgather(self, arr: np.ndarray,
                   name: Optional[str] = None) -> np.ndarray:

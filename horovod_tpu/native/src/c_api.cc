@@ -151,6 +151,10 @@ int hvd_native_barrier() {
   return st.ok() ? 0 : -1;
 }
 
+void hvd_native_set_topology(int local_size, int hierarchical_allreduce) {
+  Runtime::Get().SetTopology(local_size, hierarchical_allreduce != 0);
+}
+
 void hvd_native_set_params(int64_t fusion_threshold, double cycle_time_ms) {
   Runtime::Get().SetParams(fusion_threshold, cycle_time_ms);
 }

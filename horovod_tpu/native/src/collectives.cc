@@ -233,30 +233,34 @@ void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
   }
 }
 
-Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
-                     ReduceOp op) {
-  const int size = net.size();
-  const int rank = net.rank();
-  if (size == 1 || count == 0) return Status::OK();
+Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
+                          DataType dtype, ReduceOp op,
+                          const std::vector<int>& members) {
+  const int m = static_cast<int>(members.size());
+  if (m <= 1 || count == 0) return Status::OK();
+  int idx = -1;
+  for (int i = 0; i < m; ++i)
+    if (members[i] == net.rank()) idx = i;
+  if (idx < 0)
+    return Status::InvalidArgument("rank not in allreduce group");
   uint8_t* buf = static_cast<uint8_t*>(vbuf);
   const size_t elem = DataTypeSize(dtype);
 
   // Segment boundaries (last segment may be short).
-  const int64_t seg = (count + size - 1) / size;
+  const int64_t seg = (count + m - 1) / m;
   auto seg_start = [&](int s) { return std::min<int64_t>(seg * s, count); };
   auto seg_count = [&](int s) {
     return std::min<int64_t>(seg, count - seg_start(s));
   };
 
-  Socket* right = net.peer((rank + 1) % size);
-  Socket* left = net.peer((rank - 1 + size) % size);
+  Socket* right = net.peer(members[(idx + 1) % m]);
+  Socket* left = net.peer(members[(idx - 1 + m) % m]);
   std::vector<uint8_t> scratch(seg * elem);
 
-  // Reduce-scatter: after step t each rank holds the full reduction of
-  // segment (rank+1) mod size at the end.
-  for (int t = 0; t < size - 1; ++t) {
-    int send_s = ((rank - t) % size + size) % size;
-    int recv_s = ((rank - t - 1) % size + size) % size;
+  // Reduce-scatter then allgather (bandwidth-optimal ring).
+  for (int t = 0; t < m - 1; ++t) {
+    int send_s = ((idx - t) % m + m) % m;
+    int recv_s = ((idx - t - 1) % m + m) % m;
     Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
                            seg_count(send_s) * elem, left, scratch.data(),
                            seg_count(recv_s) * elem);
@@ -264,15 +268,65 @@ Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
     ReduceBuf(buf + seg_start(recv_s) * elem, scratch.data(),
               seg_count(recv_s), dtype, op);
   }
-  // Allgather: circulate the reduced segments.
-  for (int t = 0; t < size - 1; ++t) {
-    int send_s = ((rank + 1 - t) % size + size) % size;
-    int recv_s = ((rank - t) % size + size) % size;
+  for (int t = 0; t < m - 1; ++t) {
+    int send_s = ((idx + 1 - t) % m + m) % m;
+    int recv_s = ((idx - t) % m + m) % m;
     Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
                            seg_count(send_s) * elem, left,
                            buf + seg_start(recv_s) * elem,
                            seg_count(recv_s) * elem);
     if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
+                     ReduceOp op) {
+  std::vector<int> all(net.size());
+  for (int i = 0; i < net.size(); ++i) all[i] = i;
+  return RingAllreduceGroup(net, vbuf, count, dtype, op, all);
+}
+
+Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
+                             DataType dtype, ReduceOp op, int local_size) {
+  const int size = net.size();
+  const int rank = net.rank();
+  if (local_size <= 1 || size % local_size != 0 || size == local_size)
+    return RingAllreduce(net, vbuf, count, dtype, op);
+  const int node = rank / local_size;
+  const int leader = node * local_size;
+
+  // Phase 1: intra-node allreduce (short hops — ICI analog).
+  std::vector<int> local_members(local_size);
+  for (int i = 0; i < local_size; ++i) local_members[i] = leader + i;
+  Status st = RingAllreduceGroup(net, vbuf, count, dtype, op,
+                                 local_members);
+  if (!st.ok()) return st;
+
+  // Phase 2: node leaders reduce across nodes (long hops — DCN analog).
+  // Phase-1 result is the node total for SUM/MIN/MAX/PRODUCT, so the
+  // leader ring produces the global reduction directly.
+  const int n_nodes = size / local_size;
+  if (rank == leader) {
+    std::vector<int> leaders(n_nodes);
+    for (int i = 0; i < n_nodes; ++i) leaders[i] = i * local_size;
+    st = RingAllreduceGroup(net, vbuf, count, dtype, op, leaders);
+    if (!st.ok()) return st;
+  }
+
+  // Phase 3: leaders broadcast the global result within their node.
+  const size_t nbytes = count * DataTypeSize(dtype);
+  if (local_size > 1) {
+    // Chain within the node: leader → leader+1 → ... → leader+L-1.
+    int pos = rank - leader;
+    if (pos > 0) {
+      st = net.peer(rank - 1)->RecvAll(vbuf, nbytes);
+      if (!st.ok()) return st;
+    }
+    if (pos < local_size - 1) {
+      st = net.peer(rank + 1)->SendAll(vbuf, nbytes);
+      if (!st.ok()) return st;
+    }
   }
   return Status::OK();
 }

@@ -20,6 +20,22 @@ namespace hvdtpu {
 Status RingAllreduce(Network& net, void* buf, int64_t count, DataType dtype,
                      ReduceOp op);
 
+// Ring allreduce restricted to `members` (sorted rank list containing the
+// caller) — building block for hierarchical schedules.
+Status RingAllreduceGroup(Network& net, void* buf, int64_t count,
+                          DataType dtype, ReduceOp op,
+                          const std::vector<int>& members);
+
+// Hierarchical allreduce (reference NCCLHierarchicalAllreduce,
+// nccl_operations.cc:186-260 / MPIHierarchicalAllgather shape): ranks are
+// grouped into nodes of `local_size` consecutive ranks; phase 1 reduces
+// within the node, phase 2 ring-reduces across node leaders, phase 3
+// broadcasts within the node.  On TPU pods the analogous grouping is
+// intra-slice (ICI) vs inter-slice (DCN).  Falls back to the flat ring when
+// the topology doesn't divide evenly.
+Status HierarchicalAllreduce(Network& net, void* buf, int64_t count,
+                             DataType dtype, ReduceOp op, int local_size);
+
 // buf holds this rank's my_bytes at offset offsets[rank]; fills the rest.
 // offsets/bytes per rank; buf has total size sum(bytes).
 Status RingAllgatherv(Network& net, uint8_t* buf,

@@ -376,6 +376,9 @@ void Runtime::ExecuteAllreduce(
   Status st;
   if (resp.op == ReduceOp::ADASUM) {
     st = AdasumAllreduce(*net_, fb, total_elems, resp.dtype);
+  } else if (hierarchical_allreduce_ && local_size_ > 1) {
+    st = HierarchicalAllreduce(*net_, fb, total_elems, resp.dtype, resp.op,
+                               local_size_);
   } else {
     st = RingAllreduce(*net_, fb, total_elems, resp.dtype, resp.op);
   }
@@ -501,6 +504,11 @@ Status Runtime::BarrierBlocking() {
   sync_cv_.wait(lk, [this] { return barrier_released_ || stop_; });
   barrier_released_ = false;
   return Status::OK();
+}
+
+void Runtime::SetTopology(int local_size, bool hierarchical_allreduce) {
+  local_size_ = local_size;
+  hierarchical_allreduce_ = hierarchical_allreduce;
 }
 
 void Runtime::SetParams(int64_t fusion_threshold, double cycle_time_ms) {

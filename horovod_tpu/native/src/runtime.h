@@ -61,6 +61,9 @@ class Runtime {
   // Autotune hooks: runtime-adjustable knobs + data-plane byte counters.
   void SetParams(int64_t fusion_threshold, double cycle_time_ms);
   void ReadCounters(int64_t* bytes, double* seconds);
+  // Node topology for hierarchical collectives (ranks grouped into nodes
+  // of local_size consecutive ranks; ICI-intra / DCN-inter analog).
+  void SetTopology(int local_size, bool hierarchical_allreduce);
   void StartTimeline(const std::string& filename);
   void StopTimeline();
 
@@ -115,6 +118,8 @@ class Runtime {
   ResponseCache worker_cache_{1024};
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
   std::atomic<int64_t> bytes_processed_{0};
+  int local_size_ = 1;
+  bool hierarchical_allreduce_ = false;
   std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;
   Status loop_error_;

@@ -148,7 +148,19 @@ def grouped_allreduce(tensors: Sequence,
                       name: Optional[str] = None) -> List:
     """Allreduce a group atomically (reference: EnqueueTensorAllreduces with a
     shared group id, operations.cc:1041-1048; GroupTable group_table.h:30-59).
-    On the compiled path XLA fuses the group into combined collectives."""
+    On the compiled path XLA fuses the group into combined collectives; on
+    the native eager path all members enqueue together so the runtime's
+    fusion buffer batches them into shared ring launches."""
+    tensors = list(tensors)
+    first = tensors[0] if tensors else None
+    ctl = global_state.controller
+    if first is not None and not _is_tracer(first) and ctl is not None:
+        import numpy as _np
+        from .eager import _ctl as _ctl_call
+        return _ctl_call(ctl.grouped_allreduce,
+                         [_np.asarray(t) for t in tensors], op=int(op),
+                         prescale=prescale_factor,
+                         postscale=postscale_factor, name=name)
     return [
         allreduce(t, op=op, axis_name=axis_name,
                   prescale_factor=prescale_factor,

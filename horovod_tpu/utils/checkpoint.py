@@ -1,0 +1,84 @@
+"""Checkpoint save/restore helpers.
+
+The reference has no core checkpoint engine — elastic state objects snapshot
+to host memory and Spark estimators write to a Store (SURVEY.md §5.4).  The
+TPU-native equivalent adds durable disk checkpoints via Orbax (the JAX
+ecosystem's checkpointer, multi-host aware) with the same rank-0-writes
+convention, plus plain-numpy fallbacks for environments without Orbax.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except ImportError:
+        return None
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
+                    rank: Optional[int] = None) -> None:
+    """Write a pytree checkpoint; only rank 0 writes (pass rank, or the
+    runtime's rank is used)."""
+    if rank is None:
+        from ..core.state import global_state
+        rank = global_state.rank if global_state.initialized else 0
+    if rank != 0:
+        return
+    path = os.path.abspath(path if step is None else f"{path}-{step}")
+    ocp = _orbax()
+    if ocp is not None:
+        import jax
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, jax.tree_util.tree_map(np.asarray, state),
+                   force=True)
+        ckptr.wait_until_finished()
+        ckptr.close()
+        return
+    # Fallback: pickle of host numpy arrays.
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    import jax
+    host = jax.tree_util.tree_map(np.asarray, state)
+    with open(path + ".pkl", "wb") as f:
+        pickle.dump(host, f)
+
+
+def restore_checkpoint(path: str, target: Any = None,
+                       step: Optional[int] = None) -> Any:
+    """Load a checkpoint written by ``save_checkpoint``; ``target`` (a pytree
+    of like-shaped arrays) guides structure when given."""
+    path = os.path.abspath(path if step is None else f"{path}-{step}")
+    ocp = _orbax()
+    if ocp is not None and os.path.isdir(path):
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            if target is not None:
+                import jax
+                abstract = jax.tree_util.tree_map(np.asarray, target)
+                return ckptr.restore(path, target=abstract)
+            return ckptr.restore(path)
+        finally:
+            ckptr.close()
+    with open(path + ".pkl", "rb") as f:
+        return pickle.load(f)
+
+
+def latest_step(directory: str, prefix: str) -> Optional[int]:
+    """Find the newest ``{prefix}-{step}`` checkpoint in a directory."""
+    steps = []
+    if not os.path.isdir(directory):
+        return None
+    for name in os.listdir(directory):
+        if name.startswith(prefix + "-"):
+            tail = name[len(prefix) + 1:].replace(".pkl", "")
+            if tail.isdigit():
+                steps.append(int(tail))
+    return max(steps) if steps else None

@@ -1,0 +1,64 @@
+"""Hierarchical allreduce: 4 processes as 2 'nodes' x 2 'local' ranks must
+match the flat ring numerically (reference HOROVOD_HIERARCHICAL_ALLREDUCE,
+operations.cc:474-493)."""
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker(rank, size, port, q):
+    sys.path.insert(0, REPO)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ["HVD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"  # 2 ranks per 'node'
+    from horovod_tpu.native.controller import NativeController
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    try:
+        for it in range(3):
+            x = np.arange(37, dtype=np.float32) * (rank + 1) + it
+            out = ctl.allreduce(x, op=1, name=f"h.{it}")
+            expected = sum(np.arange(37, dtype=np.float32) * (r + 1) + it
+                           for r in range(size))
+            np.testing.assert_allclose(out, expected, rtol=1e-6)
+            avg = ctl.allreduce(x, op=0, name=f"ha.{it}")
+            np.testing.assert_allclose(avg, expected / size, rtol=1e-6)
+        mx = ctl.allreduce(np.full((5,), float(rank), dtype=np.float64),
+                           op=4, name="hmax")
+        np.testing.assert_allclose(mx, size - 1)
+        q.put((rank, "ok", True))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "error", repr(e)))
+    finally:
+        ctl.shutdown()
+
+
+def test_hierarchical_allreduce_4proc():
+    size = 4
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=120)
+        assert status == "ok", f"rank {rank}: {payload}"
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
