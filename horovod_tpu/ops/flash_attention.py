@@ -1,0 +1,521 @@
+"""Fused flash attention as Pallas TPU kernels.
+
+The hot op of the flagship transformer (models/transformer.py). The
+reference framework is model-agnostic middleware and carries no attention
+code (SURVEY.md §5.7); on TPU the attention inner loop is ours to own, and
+a fused kernel is how it belongs on the hardware: Q/K/V tiles stream
+HBM→VMEM, the (bq, bk) score block lives only in VMEM, softmax is the
+online (running max / running sum) recurrence so the O(S²) score matrix is
+never materialized in HBM, and both matmuls hit the MXU in fp32
+accumulation.
+
+Three kernels:
+
+* ``_fwd_kernel``      — out + logsumexp, online softmax over K/V tiles.
+* ``_bwd_dq_kernel``   — dQ, streaming over K/V tiles.
+* ``_bwd_dkv_kernel``  — dK/dV, streaming over Q tiles.
+
+Public API:
+
+* ``flash_attention(q, k, v, causal=…)`` — differentiable (custom VJP).
+* ``flash_attention_with_lse`` — also returns logsumexp rows, which is the
+  composition hook ring attention (parallel/ring_attention.py) uses to
+  merge per-ring-step partials into an exact global softmax.
+
+Layout is (batch, seq, heads, head_dim) throughout, matching the rest of
+the framework. ``q_offset``/``kv_offset`` globalize the causal mask when
+q/k are shards of a longer sequence (they are traced values under
+shard_map — ring attention passes ``kv_offset = ring_rank * block``).
+
+Falls back to a pure-XLA implementation when not on TPU (tests run the
+kernels in Pallas interpret mode to validate numerics on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+_BLOCK_CANDIDATES = (512, 256, 128)
+
+
+def _pick_block(size: int) -> Optional[int]:
+    """Largest 128-aligned divisor block, else the whole dim (Mosaic's
+    equal-to-array-dim exemption) when small enough to fit VMEM tiles."""
+    for c in _BLOCK_CANDIDATES:
+        if size % c == 0 and c <= size:
+            return c
+    return size if size <= 512 else None
+
+
+def _use_interpret() -> bool:
+    if os.environ.get("HVD_TPU_FLASH_INTERPRET", "") == "1":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params(n_parallel: int):
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
+    except TypeError:  # older/newer field sets
+        return pltpu.CompilerParams()
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
+                block_q: int, block_k: int):
+    i = pl.program_id(2)          # q tile
+    j = pl.program_id(3)          # k tile (innermost: scratch carries over j)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_off = off_ref[0, 0]
+    kv_off = off_ref[0, 1]
+    q_start = q_off + i * block_q
+    k_start = kv_off + j * block_k
+
+    # Causal: the tile is live unless every (q, k) pair has q_pos < k_pos.
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # (bq, bk)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                                  # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0,
+                          jnp.exp(m_prev - m_new))
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l <= 0.0, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                         lse_ref.shape[2:])
+
+
+def _fwd_call(q_bhsd, k_bhsd, v_bhsd, offsets, *, causal, scale,
+              block_q, block_k, interpret):
+    b, h, sq, d = q_bhsd.shape
+    sk = k_bhsd.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    grid = (b, h, nq, nk)
+    kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            # lse rows replicated over 8 sublanes so the (…, 8, block_q)
+            # tile meets Mosaic's (8, 128)-alignment; squeezed by callers.
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q_bhsd.dtype),
+            jax.ShapeDtypeStruct((b, h, 8, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(offsets, q_bhsd, k_bhsd, v_bhsd)
+    return out, lse[:, :, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, causal: bool, scale: float,
+                   block_q: int, block_k: int):
+    i = pl.program_id(2)          # q tile
+    j = pl.program_id(3)          # k tile (innermost)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_off = off_ref[0, 0]
+    kv_off = off_ref[0, 1]
+    q_start = q_off + i * block_q
+    k_start = kv_off + j * block_k
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)                  # (bq, D)
+        lse = jnp.transpose(lse_ref[0, 0][:1, :])              # (bq, 1)
+        delta = jnp.transpose(delta_ref[0, 0][:1, :])          # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where(jnp.logical_or(s <= _NEG_INF / 2,
+                                     lse <= _NEG_INF / 2),
+                      0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                    scale: float, block_q: int, block_k: int):
+    i = pl.program_id(2)          # k tile
+    j = pl.program_id(3)          # q tile (innermost)
+    nq = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_off = off_ref[0, 0]
+    kv_off = off_ref[0, 1]
+    q_start = q_off + j * block_q
+    k_start = kv_off + i * block_k
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                    # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)                    # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = jnp.transpose(lse_ref[0, 0][:1, :])              # (bq, 1)
+        delta = jnp.transpose(delta_ref[0, 0][:1, :])
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # (bq, bk)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where(jnp.logical_or(s <= _NEG_INF / 2,
+                                     lse <= _NEG_INF / 2),
+                      0.0, jnp.exp(s - lse))                   # (bq, bk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bk, D)
+
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q_bhsd, k_bhsd, v_bhsd, do_bhsd, lse, delta, offsets, *,
+              causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q_bhsd.shape
+    sk = k_bhsd.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+
+    # Row statistics in the sublane-replicated (B, H, 8, S) kernel layout.
+    lse = jnp.broadcast_to(lse[:, :, None, :], (b, h, 8, sq))
+    delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))
+
+    off_spec = pl.BlockSpec((1, 2), lambda b, h, i, j: (0, 0),
+                            memory_space=pltpu.SMEM)
+
+    def q_spec(ix):
+        return pl.BlockSpec((1, 1, block_q, d), ix)
+
+    def k_spec(ix):
+        return pl.BlockSpec((1, 1, block_k, d), ix)
+
+    def row_spec(ix):
+        return pl.BlockSpec((1, 1, 8, block_q), ix)
+
+    # dQ: grid over (q tiles, k tiles), k innermost.
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            off_spec,
+            q_spec(lambda b, h, i, j: (b, h, i, 0)),
+            k_spec(lambda b, h, i, j: (b, h, j, 0)),
+            k_spec(lambda b, h, i, j: (b, h, j, 0)),
+            q_spec(lambda b, h, i, j: (b, h, i, 0)),
+            row_spec(lambda b, h, i, j: (b, h, 0, i)),
+            row_spec(lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_specs=q_spec(lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q_bhsd.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(offsets, q_bhsd, k_bhsd, v_bhsd, do_bhsd, lse, delta)
+
+    # dK/dV: grid over (k tiles, q tiles), q innermost.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            off_spec,
+            q_spec(lambda b, h, i, j: (b, h, j, 0)),
+            k_spec(lambda b, h, i, j: (b, h, i, 0)),
+            k_spec(lambda b, h, i, j: (b, h, i, 0)),
+            q_spec(lambda b, h, i, j: (b, h, j, 0)),
+            row_spec(lambda b, h, i, j: (b, h, 0, j)),
+            row_spec(lambda b, h, i, j: (b, h, 0, j)),
+        ],
+        out_specs=[
+            k_spec(lambda b, h, i, j: (b, h, i, 0)),
+            k_spec(lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k_bhsd.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v_bhsd.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(offsets, q_bhsd, k_bhsd, v_bhsd, do_bhsd, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Differentiable entry points (custom VJP on (B, S, H, D) layout)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, offsets, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_impl(q, k, v, offsets, causal, scale, block_q, block_k,
+                         interpret)
+    return out
+
+
+def _flash_impl(q, k, v, offsets, causal, scale, block_q, block_k,
+                interpret):
+    qt = q.transpose(0, 2, 1, 3)      # (B, H, S, D)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = _fwd_call(qt, kt, vt, offsets, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _flash_fwd(q, k, v, offsets, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_impl(q, k, v, offsets, causal, scale, block_q,
+                           block_k, interpret)
+    return out, (q, k, v, offsets, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, offsets, out, lse = res
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)
+    outt = out.transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot.astype(jnp.float32) * outt.astype(jnp.float32),
+                    axis=-1)                                   # (B, H, Sq)
+    dq, dk, dv = _bwd_call(qt, kt, vt, dot, lse, delta, offsets,
+                           causal=causal, scale=scale, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+    d_off = np.zeros(offsets.shape, dtype=jax.dtypes.float0)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3), d_off)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _supported(q, k) -> Optional[Tuple[int, int]]:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if d % 8 != 0 or d > 512:
+        return None
+    bq = _pick_block(sq)
+    bk = _pick_block(sk)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, scale: Optional[float] = None,
+                    q_offset=0, kv_offset=0,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Differentiable fused attention; (B, S, H, D) in and out."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    blocks = _supported(q, k)
+    if blocks is None:
+        out, _ = _xla_attention_with_lse(q, k, v, causal, scale,
+                                         q_offset, kv_offset)
+        return out
+    bq, bk = blocks
+    if block_q:
+        if q.shape[1] % block_q != 0:
+            raise ValueError(
+                f"block_q={block_q} must divide seq_q={q.shape[1]}")
+        bq = block_q
+    if block_k:
+        if k.shape[1] % block_k != 0:
+            raise ValueError(
+                f"block_k={block_k} must divide seq_k={k.shape[1]}")
+        bk = block_k
+    if interpret is None:
+        interpret = _use_interpret()
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32),
+         jnp.asarray(kv_offset, jnp.int32)]).reshape(1, 2)
+    return _flash(q, k, v, offsets, causal, float(scale), bq, bk,
+                  bool(interpret))
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None,
+                             q_offset=0, kv_offset=0,
+                             interpret: Optional[bool] = None):
+    """Non-differentiable primitive returning (out, lse).
+
+    ``lse`` is (B, H, Sq) fp32 — the softmax log-normalizer per query row,
+    ``_NEG_INF`` where the row saw no unmasked key. Ring attention merges
+    per-step (out, lse) pairs with :func:`combine_blocks`.
+    """
+    blocks = _supported(q, k)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if blocks is None:
+        return _xla_attention_with_lse(q, k, v, causal, scale,
+                                       q_offset, kv_offset)
+    if interpret is None:
+        interpret = _use_interpret()
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32),
+         jnp.asarray(kv_offset, jnp.int32)]).reshape(1, 2)
+    return _flash_impl(q, k, v, offsets, causal, float(scale), blocks[0],
+                       blocks[1], bool(interpret))
+
+
+def _xla_attention_with_lse(q, k, v, causal, scale, q_offset, kv_offset):
+    """XLA fallback with identical (out, lse) semantics."""
+    sq, sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = kv_offset + jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - m_safe[..., None]))
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / l_safe[..., None],
+                     v.astype(jnp.float32))
+    lse = jnp.where(l <= 0.0, _NEG_INF, m_safe + jnp.log(l_safe))
+    return out.astype(q.dtype), lse
+
+
+def combine_blocks(o1, lse1, o2, lse2):
+    """Merge two normalized blockwise-attention partials exactly.
+
+    o*: (B, S, H, D); lse*: (B, H, S). Returns (o, lse) of the union of the
+    two key sets, as if softmax had been computed over both at once.
+    """
+    lse_new = jnp.where(
+        jnp.logical_and(lse1 <= _NEG_INF / 2, lse2 <= _NEG_INF / 2),
+        _NEG_INF, jnp.logaddexp(lse1, lse2))
+    w1 = jnp.where(lse1 <= _NEG_INF / 2, 0.0, jnp.exp(lse1 - lse_new))
+    w2 = jnp.where(lse2 <= _NEG_INF / 2, 0.0, jnp.exp(lse2 - lse_new))
+    w1 = w1.transpose(0, 2, 1)[..., None]        # (B, S, H, 1)
+    w2 = w2.transpose(0, 2, 1)[..., None]
+    o = o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2
+    return o.astype(o1.dtype), lse_new

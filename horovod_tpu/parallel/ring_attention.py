@@ -10,6 +10,17 @@ blockwise-softmax partial (flash-attention online normalization, fp32
 accumulators).  Communication per step is the K/V block — overlap with the
 block matmul is XLA's latency-hiding scheduler's job.
 
+Two compute paths per ring step:
+
+* **Pallas flash kernel** (default on TPU): each step runs the fused
+  ``ops/flash_attention.py`` kernel over the resident Q and the visiting
+  K/V shard, returning (out, logsumexp); partials merge exactly via
+  ``combine_blocks``.  The custom VJP re-walks the ring, accumulating dK/dV
+  *onto the rotating shards* so each gradient lands back on its owner after
+  a full revolution.
+* **XLA fallback** (CPU tests, unsupported shapes): the original blockwise
+  einsum recurrence, differentiated by JAX AD.
+
 Layout: q, k, v are (batch, seq_local, heads, head_dim) shards of the global
 (batch, seq_local * ring_size, heads, head_dim) arrays, sequence-major across
 the axis: rank i holds positions [i*seq_local, (i+1)*seq_local).
@@ -18,6 +29,7 @@ the axis: rank i holds positions [i*seq_local, (i+1)*seq_local).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -25,6 +37,25 @@ import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -1e30
+
+
+def _flash_enabled(seq_k: Optional[int] = None) -> bool:
+    """Dispatch policy for the fused kernel. ``HVD_TPU_FLASH=1/0`` forces;
+    in auto mode, use it on TPU once the key sequence is long enough that
+    the kernel's O(S) memory + tiling beat XLA's fused attention (measured
+    crossover ~1k on v5e; tune with ``HVD_TPU_FLASH_MIN_SEQ``)."""
+    v = os.environ.get("HVD_TPU_FLASH", "auto")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        min_seq = int(os.environ.get("HVD_TPU_FLASH_MIN_SEQ", "1024"))
+    except ValueError:
+        min_seq = 1024
+    return seq_k is None or seq_k >= min_seq
 
 
 def _block_attn(q, k, v, q_offset, kv_offset, causal, scale, m, l, o):
@@ -56,18 +87,14 @@ def _block_attn(q, k, v, q_offset, kv_offset, causal, scale, m, l, o):
     return m_new, l_new, o_new
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
-    """Exact attention over a sequence-sharded axis via K/V ring rotation.
+def _ring_perm(sp):
+    return [(i, (i - 1) % sp) for i in range(sp)]
 
-    Call inside ``shard_map``; returns the local (B, Sq, H, D) output shard.
-    """
+
+def _ring_attention_xla(q, k, v, axis_name, causal, scale):
     sp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    if scale is None:
-        scale = 1.0 / (d ** 0.5)
 
     m = jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((b, h, sq), dtype=jnp.float32)
@@ -76,7 +103,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     # Send K/V to the left neighbor each step; after t steps we hold the
     # shard originating from rank (idx + t) % sp.
-    perm = [(i, (i - 1) % sp) for i in range(sp)]
+    perm = _ring_perm(sp)
 
     def body(t, carry):
         k_t, v_t, m_t, l_t, o_t = carry
@@ -104,10 +131,121 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def full_attention(q, k, v, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
-    """Unsharded reference attention (same layout), used by tests and by the
-    flagship model when sequence parallelism is off."""
+# ---------------------------------------------------------------------------
+# Flash-kernel ring path (custom VJP; dK/dV ride the ring home)
+# ---------------------------------------------------------------------------
+
+def _ring_flash_forward(q, k, v, axis_name, causal, scale):
+    from ..ops import flash_attention as fa
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    sq = q.shape[1]
+    perm = _ring_perm(sp)
+    q_offset = idx * sq
+
+    o = lse = None
+    k_t, v_t = k, v
+    for t in range(sp):
+        kv_rank = lax.rem(idx + t, sp)
+        o_t, lse_t = fa.flash_attention_with_lse(
+            q, k_t, v_t, causal=causal, scale=scale,
+            q_offset=q_offset, kv_offset=kv_rank * sq)
+        o_t = o_t.astype(jnp.float32)
+        if o is None:
+            o, lse = o_t, lse_t
+        else:
+            o, lse = fa.combine_blocks(o, lse, o_t, lse_t)
+        if t < sp - 1:
+            k_t = lax.ppermute(k_t, axis_name, perm)
+            v_t = lax.ppermute(v_t, axis_name, perm)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_flash_forward(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_forward(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, g):
+    from ..ops import flash_attention as fa
+    q, k, v, out, lse = res
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    sq = q.shape[1]
+    perm = _ring_perm(sp)
+
+    interpret = fa._use_interpret()
+    blocks = fa._supported(q, k)
+    bq, bk = blocks
+
+    # (B, S, H, D) → (B, H, S, D) once for the whole walk.
+    qt = q.transpose(0, 2, 1, 3)
+    dot = g.astype(q.dtype).transpose(0, 2, 1, 3)
+    outt = out.transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot.astype(jnp.float32) * outt.astype(jnp.float32),
+                    axis=-1)                                   # (B, H, Sq)
+
+    dq = jnp.zeros(qt.shape, jnp.float32)
+    k_t, v_t = k, v
+    dk_t = jnp.zeros(k.shape, jnp.float32)
+    dv_t = jnp.zeros(v.shape, jnp.float32)
+    for t in range(sp):
+        kv_rank = lax.rem(idx + t, sp)
+        offsets = jnp.stack([
+            (idx * sq).astype(jnp.int32),
+            (kv_rank * sq).astype(jnp.int32)]).reshape(1, 2)
+        dq_b, dk_b, dv_b = fa._bwd_call(
+            qt, k_t.transpose(0, 2, 1, 3), v_t.transpose(0, 2, 1, 3),
+            dot, lse, delta, offsets, causal=causal, scale=scale,
+            block_q=bq, block_k=bk, interpret=interpret)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_t = dk_t + dk_b.transpose(0, 2, 1, 3).astype(jnp.float32)
+        dv_t = dv_t + dv_b.transpose(0, 2, 1, 3).astype(jnp.float32)
+        # Rotate after every step (sp total): each K/V shard — and the
+        # gradient accumulating on it — completes a full revolution and
+        # lands back on its owner.
+        if sp > 1:
+            k_t = lax.ppermute(k_t, axis_name, perm)
+            v_t = lax.ppermute(v_t, axis_name, perm)
+            dk_t = lax.ppermute(dk_t, axis_name, perm)
+            dv_t = lax.ppermute(dv_t, axis_name, perm)
+    return (dq.transpose(0, 2, 1, 3).astype(q.dtype),
+            dk_t.astype(k.dtype), dv_t.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None) -> jax.Array:
+    """Exact attention over a sequence-sharded axis via K/V ring rotation.
+
+    Call inside ``shard_map``; returns the local (B, Sq, H, D) output shard.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    from ..ops import flash_attention as fa
+    if use_flash is None:
+        use_flash = _flash_enabled(k.shape[1])
+    # Even when requested, the kernel path needs tileable shapes — the
+    # backward walk has no per-step XLA fallback.
+    use_flash = use_flash and fa._supported(q, k) is not None
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, float(scale))
+    return _ring_attention_xla(q, k, v, axis_name, causal, scale)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Pure-XLA unsharded attention — the numerics oracle for tests."""
     b, sq, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -121,3 +259,18 @@ def full_attention(q, k, v, causal: bool = True,
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = True,
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None) -> jax.Array:
+    """Unsharded attention (same layout as ring_attention). Dispatches to
+    the fused Pallas kernel on TPU, XLA einsums elsewhere."""
+    if use_flash is None:
+        from ..ops import flash_attention as fa
+        use_flash = (_flash_enabled(k.shape[1]) and
+                     fa._supported(q, k) is not None)
+    if use_flash:
+        from ..ops import flash_attention as fa
+        return fa.flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)
