@@ -22,7 +22,70 @@ import time
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
 
 
+def _host_sync(x):
+    """Device→host transfer as the timing barrier: on some TPU transports
+    (axon tunnel) jax.block_until_ready can return before compute
+    finishes; a host readback cannot."""
+    import numpy as np
+    return np.asarray(x)
+
+
+def bench_bert():
+    """BERT-Base MLM pretraining throughput (sequences/sec/chip) — the
+    reference's second headline benchmark workload (BASELINE.md north
+    star). Select with BENCH_MODEL=bert."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import horovod_tpu as hvd
+    from horovod_tpu.models import bert
+
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "16"))
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    hvd.init()
+    mesh_1d = hvd.mesh()
+    n_dev = mesh_1d.devices.size
+    from horovod_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh({"dp": n_dev, "mp": 1})
+    batch = per_chip_batch * n_dev
+
+    cfg = bert.BertConfig(seq_len=seq_len, dtype=jnp.bfloat16, remat=True)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-4)
+    step, shard_params = bert.make_train_step(cfg, mesh, opt)
+    params = shard_params(params)
+    opt_state = opt.init(params)
+    inputs, labels = bert.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, inputs, labels)
+    _host_sync(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, inputs, labels)
+    _host_sync(loss)
+    dt = time.perf_counter() - t0
+
+    seq_per_sec = batch * iters / dt / n_dev
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_throughput",
+        "value": round(seq_per_sec, 2),
+        "unit": "sequences/sec/chip",
+        # The reference publishes no BERT throughput (BASELINE.md:
+        # BASELINE.json.published is empty); 0.0 = no baseline ratio.
+        "vs_baseline": 0.0,
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
+        return bench_bert()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -83,13 +146,13 @@ def main():
     for _ in range(warmup):
         params, stats, opt_state, loss = jstep(params, stats, opt_state,
                                                images, labels)
-    jax.block_until_ready(loss)
+    _host_sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         params, stats, opt_state, loss = jstep(params, stats, opt_state,
                                                images, labels)
-    jax.block_until_ready(loss)
+    _host_sync(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * iters / dt

@@ -1,0 +1,231 @@
+"""BERT encoder family — masked-LM pretraining, dp + tensor parallel.
+
+The reference's second headline benchmark workload is BERT (BASELINE.md
+north star: images|sequences/sec/chip for ResNet-50 and BERT; the reference
+itself is model-agnostic middleware and ships BERT only as an external
+benchmark recipe).  This is a TPU-first encoder: bfloat16 compute, fp32
+normalization/softmax/loss, `lax.scan` over the layer stack (single XLA
+compilation per stage), Megatron column/row tensor parallelism over the
+``mp`` mesh axis, batch sharding over ``dp`` with gradient reductions
+inserted by AD, and the fused flash-attention kernel (non-causal) for long
+sequences.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import ring_attention as ra
+from ..parallel import tensor_parallel as tp
+
+IGNORE_INDEX = -100
+
+
+class BertConfig(NamedTuple):
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    n_layers: int = 12
+    seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(key, cfg: BertConfig) -> Dict[str, Any]:
+    d, ff, v, s = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.seq_len
+    h, hd = cfg.n_heads, cfg.head_dim
+    n = cfg.n_layers
+    ks = iter(jax.random.split(key, 12))
+    std = 0.02
+
+    def rand(kk, *shape, scale=std):
+        return (jax.random.normal(kk, shape) * scale).astype(jnp.float32)
+
+    return {
+        "embed": rand(next(ks), v, d),
+        "pos": rand(next(ks), s, d),
+        "emb_norm": jnp.ones((d,), jnp.float32),
+        "layers": {
+            "ln1": jnp.ones((n, d), jnp.float32),
+            "ln2": jnp.ones((n, d), jnp.float32),
+            "wqkv": rand(next(ks), n, d, 3 * h * hd),
+            "wo": rand(next(ks), n, h * hd, d,
+                       scale=std / math.sqrt(2 * n)),
+            "w1": rand(next(ks), n, d, ff),
+            "w2": rand(next(ks), n, ff, d, scale=std / math.sqrt(2 * n)),
+        },
+        # MLM head: transform + norm; logits tie the embedding matrix.
+        "mlm_dense": rand(next(ks), d, d),
+        "mlm_norm": jnp.ones((d,), jnp.float32),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+    }
+
+
+def param_specs(cfg: BertConfig) -> Dict[str, Any]:
+    """PartitionSpecs over mesh axes (dp, mp): attention + MLP Megatron
+    column/row parallel over mp; embeddings/norms replicated."""
+    return {
+        "embed": P(),
+        "pos": P(),
+        "emb_norm": P(),
+        "layers": {
+            "ln1": P(),
+            "ln2": P(),
+            "wqkv": P(None, None, "mp"),
+            "wo": P(None, "mp", None),
+            "w1": P(None, None, "mp"),
+            "w2": P(None, "mp", None),
+        },
+        "mlm_dense": P(),
+        "mlm_norm": P(),
+        "mlm_bias": P(),
+    }
+
+
+def _layernorm(x, scale):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _encoder_layer(cfg: BertConfig, lp, x, *, sharded: bool):
+    """Post-LN BERT block. x: (B, S, d). With ``sharded``, wqkv/wo/w1/w2
+    are mp-shards and activations cross tp.column/row_parallel."""
+    hd = cfg.head_dim
+    h = _layernorm(x, lp["ln1"])
+    if sharded:
+        qkv = tp.column_parallel(h, lp["wqkv"].astype(x.dtype))
+    else:
+        qkv = jnp.einsum("bsd,de->bse", h, lp["wqkv"].astype(x.dtype))
+    b, s = qkv.shape[:2]
+    local_heads = qkv.shape[-1] // (3 * hd)
+    qkv = qkv.reshape(b, s, local_heads, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    o = ra.full_attention(q, k, v, causal=False)
+    o = o.reshape(b, s, local_heads * hd)
+    if sharded:
+        attn = tp.row_parallel(o, lp["wo"].astype(x.dtype), "mp",
+                               scatter_sequence=False)
+    else:
+        attn = jnp.einsum("bse,ed->bsd", o, lp["wo"].astype(x.dtype))
+    x = x + attn
+
+    h = _layernorm(x, lp["ln2"])
+    if sharded:
+        u = jax.nn.gelu(tp.column_parallel(h, lp["w1"].astype(x.dtype)))
+        mlp = tp.row_parallel(u, lp["w2"].astype(x.dtype), "mp",
+                              scatter_sequence=False)
+    else:
+        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                   lp["w1"].astype(x.dtype)))
+        mlp = jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
+    return x + mlp
+
+
+def _encode(cfg: BertConfig, params, tokens, *, sharded: bool):
+    emb = params["embed"][tokens] + params["pos"][None]
+    x = _layernorm(emb.astype(cfg.dtype), params["emb_norm"])
+
+    def body(act, lp):
+        return _encoder_layer(cfg, lp, act, sharded=sharded), None
+
+    fn = jax.checkpoint(body) if cfg.remat else body
+    x, _ = lax.scan(fn, x, params["layers"])
+    return x
+
+
+def _mlm_loss(cfg: BertConfig, params, hidden, labels):
+    """Cross entropy at positions where labels != IGNORE_INDEX; returns
+    (sum_loss, n_predictions) so callers can average globally."""
+    h = jnp.einsum("bsd,de->bse", hidden.astype(jnp.float32),
+                   params["mlm_dense"])
+    h = _layernorm(jax.nn.gelu(h), params["mlm_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", h.astype(jnp.float32),
+                        params["embed"]) + params["mlm_bias"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    safe_labels = jnp.maximum(labels, 0)
+    ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    mask = (labels != IGNORE_INDEX).astype(jnp.float32)
+    return -(ll * mask).sum(), mask.sum()
+
+
+def forward_loss(cfg: BertConfig, params, tokens, labels) -> jax.Array:
+    """Per-device MLM loss body; call inside shard_map over (dp, mp).
+
+    tokens/labels: (B_local, S) int32 (batch over dp; labels IGNORE_INDEX
+    at unmasked positions). Returns the replicated global mean loss.
+    """
+    hidden = _encode(cfg, params, tokens, sharded=True)
+    loss_sum, n = _mlm_loss(cfg, params, hidden, labels)
+    loss_sum = lax.psum(loss_sum, "dp")
+    n = lax.psum(n, "dp")
+    return loss_sum / jnp.maximum(n, 1.0)
+
+
+def serial_forward_loss(cfg: BertConfig, params, tokens, labels):
+    """Unsharded oracle computing the same math — test reference."""
+    hidden = _encode(cfg, params, tokens, sharded=False)
+    loss_sum, n = _mlm_loss(cfg, params, hidden, labels)
+    return loss_sum / jnp.maximum(n, 1.0)
+
+
+def make_loss_fn(cfg: BertConfig, mesh):
+    from jax import shard_map
+    specs = param_specs(cfg)
+
+    def loss_of(params, tokens, labels):
+        fn = shard_map(
+            lambda p, t, l: forward_loss(cfg, p, t, l),
+            mesh=mesh, in_specs=(specs, P("dp"), P("dp")),
+            out_specs=P(), check_vma=False)
+        return fn(params, tokens, labels)
+
+    return loss_of
+
+
+def make_train_step(cfg: BertConfig, mesh, optimizer):
+    """(params, opt_state, tokens, labels) -> (params, opt_state, loss),
+    jitted over the (dp, mp) mesh; gradient reductions come from AD."""
+    from jax.sharding import NamedSharding
+    specs = param_specs(cfg)
+    loss_of = make_loss_fn(cfg, mesh)
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    def shard_params(params):
+        return jax.device_put(
+            params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P)))
+
+    return jax.jit(train_step, donate_argnums=(0, 1)), shard_params
+
+
+def synthetic_batch(key, cfg: BertConfig, batch: int,
+                    mask_rate: float = 0.15) -> Tuple[jax.Array, jax.Array]:
+    """Random tokens with `mask_rate` positions masked for MLM: masked
+    inputs get the [MASK]-like id 0; labels hold the original id at masked
+    positions and IGNORE_INDEX elsewhere."""
+    kt, km = jax.random.split(key)
+    tokens = jax.random.randint(kt, (batch, cfg.seq_len), 1, cfg.vocab_size,
+                                dtype=jnp.int32)
+    masked = jax.random.uniform(km, (batch, cfg.seq_len)) < mask_rate
+    inputs = jnp.where(masked, 0, tokens)
+    labels = jnp.where(masked, tokens, IGNORE_INDEX)
+    return inputs, labels

@@ -1,0 +1,214 @@
+"""MXNet front-end.
+
+Capability parity with the reference's horovod/mxnet front-end
+(mxnet/__init__.py:58-84 DistributedOptimizer allreducing inside update,
+DistributedTrainer for Gluon, mxnet/mpi_ops.py tensor collectives,
+mxnet/functions.py broadcast_parameters).
+
+TPU note: as with the torch front-end, the TPU compute path is JAX; this
+exists so MXNet users of the reference can run their CPU scripts unchanged
+under ``hvdrun``.  NDArrays bridge to the runtime through numpy; the
+background runtime fuses and schedules the collectives.
+
+MXNet is an optional dependency: this module imports without it, and the
+first call that needs an NDArray constructor raises ImportError with
+guidance (analogous to the reference's extension-loading failure mode,
+horovod/common/util.py check_extension).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.basics import (init, shutdown, is_initialized, rank, size,
+                           local_rank, local_size, cross_rank, cross_size)
+from ..ops.collective import Average, Sum, Adasum, Min, Max, Product
+from ..ops import collective as _C
+from ..optimizers import broadcast_object, allgather_object  # noqa: F401
+
+
+def _mx():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires the mxnet package; install mxnet "
+            "or use the jax/tensorflow/torch front-ends") from e
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return tensor.asnumpy()
+
+
+def _from_numpy(arr: np.ndarray, like):
+    mx = _mx()
+    return mx.nd.array(np.asarray(arr), ctx=like.context, dtype=like.dtype)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: int = Average,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Reference signature keeps the legacy ``average`` flag
+    (mxnet/mpi_ops.py allreduce) alongside the op enum."""
+    if average is not None:
+        op = Average if average else Sum
+    out = _C.allreduce(_to_numpy(tensor), op=op, name=name,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return _from_numpy(out, tensor)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: int = Average):
+    result = allreduce(tensor, average=average, name=name, op=op)
+    result.copyto(tensor)
+    return tensor
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None, op: int = Average):
+    if average is not None:
+        op = Average if average else Sum
+    nm = name or "grouped"
+    outs = _C.grouped_allreduce(
+        [_to_numpy(t) for t in tensors], op=op, name=nm)
+    return [_from_numpy(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None):
+    out = _C.allgather(_to_numpy(tensor), name=name)
+    return _from_numpy(out, tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _from_numpy(out, tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    broadcast(tensor, root_rank=root_rank, name=name).copyto(tensor)
+    return tensor
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    out, recv_splits = _C.alltoall(_to_numpy(tensor), splits=splits,
+                                   name=name)
+    return _from_numpy(out, tensor), np.asarray(recv_splits)
+
+
+def join() -> int:
+    return _C.join()
+
+
+def barrier():
+    _C.barrier()
+
+
+def broadcast_parameters(params, root_rank: int = 0, prefix: str = ""):
+    """Broadcast a Gluon ParameterDict / Block.collect_params() result or a
+    plain {name: NDArray} dict from root (reference
+    mxnet/functions.py broadcast_parameters)."""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        try:
+            tensor = p.data() if hasattr(p, "data") and callable(p.data) else p
+        except Exception as e:
+            # Deferred-init Gluon parameters have nothing to sync yet; any
+            # other failure must surface, or ranks silently diverge.
+            if type(e).__name__ == "DeferredInitializationError":
+                continue
+            raise
+        broadcast_(tensor, root_rank=root_rank,
+                   name=prefix + "bcast.param." + str(name))
+
+
+class DistributedOptimizer:
+    """Wraps an mx.optimizer.Optimizer: gradients are allreduced (averaged)
+    before the wrapped update (reference mxnet/__init__.py:58-84)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor: float = 1.0,
+                 op: int = Average):
+        self._optimizer = optimizer
+        self._predivide = gradient_predivide_factor
+        self._op = op
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _do_allreduce(self, index, grad):
+        if size() == 1:
+            return grad
+        # Predivide splits the averaging division around the wire sum for
+        # fp16 overflow control; prescale 1/p is compensated by postscale p
+        # so the net result stays the plain average (reference
+        # mxnet/__init__.py gradient_predivide_factor handling).
+        pre, post = 1.0 / self._predivide, self._predivide
+        if isinstance(index, (tuple, list)):
+            return [
+                allreduce(g, op=self._op, name=f"grad.{i}",
+                          prescale_factor=pre, postscale_factor=post)
+                for i, g in zip(index, grad)]
+        return allreduce(grad, op=self._op, name=f"grad.{index}",
+                         prescale_factor=pre, postscale_factor=post)
+
+    def update(self, index, weight, grad, state):
+        grad = self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        grad = self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       gradient_predivide_factor: float = 1.0,
+                       prefix: Optional[str] = None):
+    """Gluon trainer whose _allreduce_grads averages gradients across ranks
+    (reference mxnet/__init__.py DistributedTrainer): scales the loss-side
+    learning rate by size() exactly as the reference does by passing
+    rescale_grad adjusted per worker."""
+    mx = _mx()
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self):
+            if isinstance(optimizer, DistributedOptimizer):
+                raise ValueError(
+                    "DistributedTrainer does not take DistributedOptimizer; "
+                    "pass the bare optimizer (reference asserts the same)")
+            super().__init__(params, optimizer,
+                             optimizer_params or {}, kvstore=None)
+            # Match the reference: rescale_grad divides by size so the
+            # post-allreduce SUM equals the global average.
+            self._scale /= size()
+            self._prefix = prefix or ""
+            self._predivide = gradient_predivide_factor
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            pre, post = 1.0 / self._predivide, self._predivide
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for grad in param.list_grad():
+                        allreduce(grad, op=Sum,
+                                  name=f"{self._prefix}grad.{i}",
+                                  prescale_factor=pre,
+                                  postscale_factor=post).copyto(grad)
+
+    return _DistributedTrainer()

@@ -119,3 +119,64 @@ class RayExecutor:
         for w in self._workers:
             ray.kill(w)
         self._workers = []
+
+
+class RayHostDiscovery:
+    """HostDiscovery over the Ray cluster inventory (reference
+    horovod/ray/elastic.py RayHostDiscovery): every alive Ray node with
+    enough CPUs (or GPUs when use_gpu) contributes slots."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: float = 1.0,
+                 gpus_per_slot: float = 1.0):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> List[HostInfo]:
+        import ray
+        hosts: List[HostInfo] = []
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {})
+            if self.use_gpu:
+                slots = int(res.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(res.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts.append(HostInfo(node.get("NodeManagerHostname",
+                                               node.get("NodeID", "?")),
+                                      slots))
+        return sorted(hosts, key=lambda h: h.hostname)
+
+
+class ElasticRayExecutor:
+    """Elastic variant: the ElasticDriver polls RayHostDiscovery and
+    respawns worker commands as the Ray cluster grows or shrinks
+    (reference horovod/ray/elastic.py ElasticRayExecutor wiring
+    RayHostDiscovery into the elastic driver)."""
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 use_gpu: bool = False, cpus_per_slot: float = 1.0,
+                 reset_limit: Optional[int] = None,
+                 controller_port: int = 29000):
+        try:
+            import ray  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ElasticRayExecutor requires the `ray` package") from e
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.discovery = RayHostDiscovery(use_gpu=use_gpu,
+                                          cpus_per_slot=cpus_per_slot)
+        self._controller_port = controller_port
+
+    def run(self, command: List[str]) -> int:
+        from ..runner.elastic_driver import ElasticDriver
+        driver = ElasticDriver(
+            discovery=self.discovery, command=list(command),
+            min_np=self.min_np, max_np=self.max_np,
+            controller_base_port=self._controller_port,
+            reset_limit=self.reset_limit)
+        return driver.run()

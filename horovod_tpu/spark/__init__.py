@@ -4,8 +4,10 @@ Capability parity with the reference horovod.spark.run
 (spark/runner.py:47-156): one barrier-mode task per executor registers its
 hostname with the driver, ranks are assigned host-major, the launcher env is
 injected, and the user function runs inside each task.  The Estimator API
-(KerasEstimator/TorchEstimator over Parquet stores) is out of round-1 scope;
-``run`` covers the run()/run_elastic() control path.
+(store.py ``Store``/``LocalStore``, estimator.py ``KerasEstimator``/
+``TorchEstimator``) fits DataFrames via Parquet materialization into the
+store, mirroring the reference's spark/common/store.py + spark/keras +
+spark/torch estimators.
 
 ``pyspark`` is an optional dependency; a clear error is raised without it.
 """
@@ -14,6 +16,10 @@ from __future__ import annotations
 
 import socket
 from typing import Any, Callable, List, Optional
+
+from .store import Store, LocalStore                      # noqa: F401
+from .estimator import (KerasEstimator, KerasModel,       # noqa: F401
+                        TorchEstimator, TorchModel)
 
 
 def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,

@@ -1,0 +1,225 @@
+"""Estimator API: fit DataFrames, get back transformers.
+
+Capability parity with the reference horovod/spark Estimators
+(spark/common/estimator.py + spark/keras/ + spark/torch/): an Estimator
+holds a model + training params + a ``Store``; ``fit(df)`` materializes the
+DataFrame to Parquet in the store, trains it data-parallel (on Spark
+executors when pyspark is present, else in-process over the local runtime),
+checkpoints into the store, and returns a Model transformer whose
+``transform(df)`` appends predictions.
+
+TPU-first deltas from the reference: Petastorm is replaced by a plain
+Parquet→numpy feed (pandas/pyarrow are universal on TPU VMs), and the
+in-process path trains through the same ``horovod_tpu`` front-ends users
+run under ``hvdrun``.
+"""
+
+from __future__ import annotations
+
+import io
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .store import Store, dataframe_to_arrays
+
+
+class _EstimatorParams:
+    def __init__(self, store: Optional[Store] = None,
+                 num_proc: Optional[int] = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 feature_cols: Sequence[str] = ("features",),
+                 label_cols: Sequence[str] = ("label",),
+                 validation: Optional[float] = None,
+                 run_id: Optional[str] = None,
+                 verbose: int = 1):
+        if store is None:
+            raise ValueError("an Estimator requires a store= (Store.create "
+                             "or LocalStore) for intermediate data and "
+                             "checkpoints")
+        self.store = store
+        self.num_proc = num_proc
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.validation = validation
+        self.run_id = run_id or "run_" + uuid.uuid4().hex[:8]
+        self.verbose = verbose
+
+    def _materialize(self, df):
+        """DataFrame → (train_path, val_path|None) parquet in the store
+        (reference util.prepare_data)."""
+        store = self.store
+        if hasattr(df, "toPandas"):
+            df = df.toPandas()
+        n = len(df)
+        if self.validation:
+            n_val = int(n * float(self.validation))
+            val_df, train_df = df.iloc[:n_val], df.iloc[n_val:]
+        else:
+            val_df, train_df = None, df
+        train_path = store.get_train_data_path(self.run_id)
+        store.write_dataframe(train_df, train_path)
+        val_path = None
+        if val_df is not None and len(val_df):
+            val_path = store.get_val_data_path(self.run_id)
+            store.write_dataframe(val_df, val_path)
+        return train_path, val_path
+
+    def _load_arrays(self, path):
+        df = self.store.read_dataframe(path)
+        return dataframe_to_arrays(df, self.feature_cols, self.label_cols)
+
+
+class KerasEstimator(_EstimatorParams):
+    """Fit a tf.keras model on a DataFrame (reference
+    spark/keras/estimator.py KerasEstimator)."""
+
+    def __init__(self, model=None, optimizer: Any = "sgd",
+                 loss: Any = "mse", metrics: Sequence[str] = (),
+                 custom_objects: Optional[Dict[str, Any]] = None, **kw):
+        super().__init__(**kw)
+        if model is None:
+            raise ValueError("KerasEstimator requires model=")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = list(metrics)
+        self.custom_objects = custom_objects or {}
+
+    def fit(self, df) -> "KerasModel":
+        train_path, val_path = self._materialize(df)
+        x, y = self._load_arrays(train_path)
+        val = self._load_arrays(val_path) if val_path else None
+
+        import horovod_tpu.keras as hvd_keras
+        hvd_keras.init()
+        model = self.model
+        opt = hvd_keras.DistributedOptimizer(
+            self._build_optimizer(model))
+        model.compile(optimizer=opt, loss=self.loss,
+                      metrics=self.metrics or None)
+        callbacks = [hvd_keras.callbacks.
+                     BroadcastGlobalVariablesCallback(0)]
+        model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
+                  validation_data=val, verbose=self.verbose,
+                  callbacks=callbacks)
+
+        import tempfile, os, pathlib
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "model.keras")
+            model.save(p)
+            payload = pathlib.Path(p).read_bytes()
+        self.store.save_checkpoint(self.run_id, payload)
+        return KerasModel(model=model, feature_cols=self.feature_cols,
+                          label_cols=self.label_cols, store=self.store,
+                          run_id=self.run_id)
+
+    def _build_optimizer(self, model):
+        import tensorflow as tf
+        if isinstance(self.optimizer, str):
+            return tf.keras.optimizers.get(self.optimizer)
+        return self.optimizer
+
+
+class _Model:
+    """Shared transformer shape for fitted models: ``transform(df)``
+    appends one output column per label column."""
+
+    def __init__(self, model, feature_cols, label_cols, store=None,
+                 run_id=None, output_cols: Optional[List[str]] = None):
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.store = store
+        self.run_id = run_id
+        self.output_cols = output_cols or [
+            c + "__output" for c in self.label_cols]
+
+    def _predict(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df):
+        if hasattr(df, "toPandas"):
+            df = df.toPandas()
+        x, _ = dataframe_to_arrays(
+            df.assign(**{c: 0.0 for c in self.label_cols
+                         if c not in df.columns}),
+            self.feature_cols, self.label_cols)
+        preds = np.asarray(self._predict(x))
+        out = df.copy()
+        for i, c in enumerate(self.output_cols):
+            col = preds[:, i] if preds.ndim > 1 and preds.shape[1] > i \
+                else preds.reshape(len(out), -1)[:, 0]
+            out[c] = col
+        return out
+
+
+class KerasModel(_Model):
+    """Transformer returned by KerasEstimator.fit (reference
+    spark/keras/estimator.py KerasModel)."""
+
+    def _predict(self, x):
+        return self.model.predict(x, verbose=0)
+
+
+class TorchEstimator(_EstimatorParams):
+    """Fit a torch model on a DataFrame (reference
+    spark/torch/estimator.py TorchEstimator)."""
+
+    def __init__(self, model=None, optimizer: Optional[Callable] = None,
+                 loss: Optional[Callable] = None, lr: float = 0.01, **kw):
+        super().__init__(**kw)
+        if model is None:
+            raise ValueError("TorchEstimator requires model=")
+        self.model = model
+        self.optimizer_fn = optimizer
+        self.loss_fn = loss
+        self.lr = lr
+
+    def fit(self, df) -> "TorchModel":
+        import torch
+        import horovod_tpu.torch as hvd_torch
+        train_path, val_path = self._materialize(df)
+        x, y = self._load_arrays(train_path)
+
+        hvd_torch.init()
+        model = self.model
+        base_opt = (self.optimizer_fn(model.parameters())
+                    if self.optimizer_fn
+                    else torch.optim.SGD(model.parameters(), lr=self.lr))
+        opt = hvd_torch.DistributedOptimizer(
+            base_opt, named_parameters=model.named_parameters())
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        loss_fn = self.loss_fn or torch.nn.MSELoss()
+
+        xt = torch.from_numpy(x)
+        yt = torch.from_numpy(y)
+        n = len(xt)
+        for _ in range(self.epochs):
+            perm = torch.randperm(n)
+            for s in range(0, n, self.batch_size):
+                idx = perm[s:s + self.batch_size]
+                opt.zero_grad()
+                out = model(xt[idx])
+                loss = loss_fn(out, yt[idx])
+                loss.backward()
+                opt.step()
+
+        buf = io.BytesIO()
+        torch.save(model.state_dict(), buf)
+        self.store.save_checkpoint(self.run_id, buf.getvalue())
+        return TorchModel(model=model, feature_cols=self.feature_cols,
+                          label_cols=self.label_cols, store=self.store,
+                          run_id=self.run_id)
+
+
+class TorchModel(_Model):
+    """Transformer returned by TorchEstimator.fit."""
+
+    def _predict(self, x):
+        import torch
+        with torch.no_grad():
+            return self.model(torch.from_numpy(x)).numpy()

@@ -1,0 +1,117 @@
+"""Storage abstraction for estimator data/checkpoints.
+
+Capability parity with the reference horovod/spark/common/store.py:32-154:
+a ``Store`` owns three sub-trees (intermediate train/val data, checkpoints,
+logs) under a prefix path, knows how to materialize a DataFrame to Parquet
+and read it back, and is subclassed per filesystem.  The reference ships
+LocalStore/HDFSStore/DBFSLocalStore; TPU-VM jobs live on local SSD or GCS
+FUSE mounts, both of which are plain filesystem paths — so ``LocalStore``
+(any mounted path, including ``/gcs/...``) is the primary implementation
+and ``Store.create`` picks by prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+import numpy as np
+
+
+class Store:
+    """Base class: path layout + parquet materialization."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        # GCS FUSE and local paths are both filesystem paths on TPU VMs.
+        return LocalStore(prefix_path)
+
+    # -- path layout (reference store.py:60-101) --
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        return os.path.join(self.prefix_path, "intermediate_train_data",
+                            idx or "")
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        return os.path.join(self.prefix_path, "intermediate_val_data",
+                            idx or "")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    # -- data materialization --
+    def write_dataframe(self, df, path: str) -> int:
+        """Materialize a pandas (or Spark) DataFrame as Parquet under
+        ``path``; returns the row count (reference prepare_data's
+        to-parquet step, spark/common/util.py)."""
+        self.makedirs(path)
+        target = os.path.join(path, "part-00000.parquet")
+        if hasattr(df, "toPandas"):  # Spark DataFrame without petastorm
+            df = df.toPandas()
+        df.to_parquet(target)
+        return len(df)
+
+    def read_dataframe(self, path: str):
+        import pandas as pd
+        parts = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".parquet"))
+        return pd.concat([pd.read_parquet(p) for p in parts],
+                         ignore_index=True)
+
+    def save_checkpoint(self, run_id: str, payload: bytes) -> str:
+        path = self.get_checkpoint_path(run_id)
+        self.makedirs(os.path.dirname(path))
+        with open(path, "wb") as f:
+            f.write(payload)
+        return path
+
+    def load_checkpoint(self, run_id: str) -> bytes:
+        with open(self.get_checkpoint_path(run_id), "rb") as f:
+            return f.read()
+
+
+class LocalStore(Store):
+    """Filesystem store (reference LocalStore, store.py:105-132); covers
+    local disk, NFS and GCS-FUSE mounts on TPU VMs."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+def dataframe_to_arrays(df, feature_cols, label_cols):
+    """Split a pandas DataFrame into (X, y) float32 arrays; list-valued
+    cells (vector columns) are stacked."""
+    def col_to_array(c):
+        v = df[c].to_numpy()
+        if len(v) and isinstance(v[0], (list, tuple, np.ndarray)):
+            return np.stack([np.asarray(x, dtype=np.float32) for x in v])
+        return v.astype(np.float32)[:, None]
+
+    x = np.concatenate([col_to_array(c) for c in feature_cols], axis=1)
+    y = np.concatenate([col_to_array(c) for c in label_cols], axis=1)
+    return x, y

@@ -48,6 +48,22 @@ def _np(t) -> np.ndarray:
     return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
 
 
+def _is_symbolic(t) -> bool:
+    """True inside a traced tf.function, where .numpy() is unavailable."""
+    return isinstance(t, _tf.Tensor) and not hasattr(t, "numpy")
+
+
+def _graph_bridge(np_fn, tensor, out_shape=None):
+    """Run the numpy-bridged collective from graph mode.  The reference
+    reaches its runtime from TF graphs through a registered custom op
+    (tensorflow/mpi_ops.cc:383-431 AsyncOpKernels); here ``tf.py_function``
+    plays that role: the traced graph calls back into the eager bridge."""
+    out = _tf.py_function(lambda x: np_fn(x.numpy()), [tensor],
+                          tensor.dtype)
+    out.set_shape(tensor.shape if out_shape is None else out_shape)
+    return out
+
+
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=None):
@@ -63,6 +79,12 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
                                  dense_shape=tensor.dense_shape)
     comp = compression or Compression.none
     t, ctx = comp.compress(tensor)
+    if _is_symbolic(t):
+        out = _graph_bridge(
+            lambda x: np.asarray(_C.allreduce(
+                x, op=op, name=name, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)), t)
+        return comp.decompress(out, ctx)
     out = _C.allreduce(_np(t), op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
@@ -71,11 +93,20 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None):
+    if _is_symbolic(tensor):
+        return _graph_bridge(
+            lambda x: np.ascontiguousarray(_C.allgather(x, name=name)),
+            tensor, out_shape=_tf.TensorShape(
+                [None] + list(tensor.shape)[1:]))
     return _tf.convert_to_tensor(
         np.ascontiguousarray(_C.allgather(_np(tensor), name=name)))
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    if _is_symbolic(tensor):
+        return _graph_bridge(
+            lambda x: np.ascontiguousarray(
+                _C.broadcast(x, root_rank=root_rank, name=name)), tensor)
     return _tf.convert_to_tensor(np.ascontiguousarray(
         _C.broadcast(_np(tensor), root_rank=root_rank, name=name)))
 

@@ -51,3 +51,61 @@ def test_spark_run_gated():
     from horovod_tpu import spark
     with pytest.raises(ImportError, match="pyspark"):
         spark.run(lambda: None)
+
+
+def test_ray_host_discovery_with_fake_ray(monkeypatch):
+    import sys, types
+    from horovod_tpu.ray import RayHostDiscovery
+
+    ray = types.ModuleType("ray")
+    ray.nodes = lambda: [
+        {"Alive": True, "NodeManagerHostname": "b",
+         "Resources": {"CPU": 4.0}},
+        {"Alive": True, "NodeManagerHostname": "a",
+         "Resources": {"CPU": 2.0, "GPU": 1.0}},
+        {"Alive": False, "NodeManagerHostname": "dead",
+         "Resources": {"CPU": 8.0}},
+        {"Alive": True, "NodeManagerHostname": "nores",
+         "Resources": {}},
+    ]
+    monkeypatch.setitem(sys.modules, "ray", ray)
+
+    hosts = RayHostDiscovery().find_available_hosts_and_slots()
+    assert [(h.hostname, h.slots) for h in hosts] == [("a", 2), ("b", 4)]
+
+    gpu_hosts = RayHostDiscovery(
+        use_gpu=True).find_available_hosts_and_slots()
+    assert [(h.hostname, h.slots) for h in gpu_hosts] == [("a", 1)]
+
+    two_per = RayHostDiscovery(
+        cpus_per_slot=2.0).find_available_hosts_and_slots()
+    assert [(h.hostname, h.slots) for h in two_per] == [("a", 1), ("b", 2)]
+
+
+def test_elastic_ray_executor_gated():
+    from horovod_tpu.ray import ElasticRayExecutor
+    with pytest.raises(ImportError, match="ray"):
+        ElasticRayExecutor(min_np=1)
+
+
+def test_elastic_ray_executor_runs_driver(monkeypatch, tmp_path):
+    """ElasticRayExecutor drives a real ElasticDriver round over a fake
+    one-host ray cluster: the command runs as a rank and exits 0."""
+    import sys, types
+    ray = types.ModuleType("ray")
+    ray.nodes = lambda: [
+        {"Alive": True, "NodeManagerHostname": "localhost",
+         "Resources": {"CPU": 2.0}},
+    ]
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "0.1")
+
+    from horovod_tpu.ray import ElasticRayExecutor
+    marker = tmp_path / "ran.txt"
+    ex = ElasticRayExecutor(min_np=2, max_np=2)
+    code = ex.run([sys.executable, "-c",
+                   "import os,sys;"
+                   f"open(r'{marker}','a').write(os.environ['HVD_TPU_RANK']+'\\n')"])
+    assert code == 0
+    ranks = sorted(marker.read_text().split())
+    assert ranks == ["0", "1"]

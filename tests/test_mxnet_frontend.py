@@ -1,0 +1,160 @@
+"""MXNet front-end: API parity exercised against a minimal in-test fake of
+the mxnet NDArray/Gluon surface (mxnet itself is optional and not installed
+in CI — mirroring how the reference gates front-ends on installed
+frameworks, horovod/common/util.py check_extension)."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class FakeNDArray:
+    def __init__(self, arr, ctx="cpu(0)", dtype=None):
+        self._arr = np.array(arr, dtype=dtype or np.asarray(arr).dtype)
+        self.context = ctx
+        self.dtype = self._arr.dtype
+
+    def asnumpy(self):
+        return self._arr.copy()
+
+    def copyto(self, other):
+        other._arr[...] = self._arr
+        return other
+
+    def __array__(self, dtype=None):
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+
+class FakeParameter:
+    def __init__(self, arr, grad=None, grad_req="write"):
+        self._data = FakeNDArray(arr)
+        self._grad = FakeNDArray(grad if grad is not None
+                                 else np.zeros_like(np.asarray(arr)))
+        self.grad_req = grad_req
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+@pytest.fixture()
+def fake_mxnet(monkeypatch):
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+
+    def array(a, ctx=None, dtype=None):
+        return FakeNDArray(a, ctx=ctx or "cpu(0)", dtype=dtype)
+
+    nd.array = array
+    mx.nd = nd
+
+    gluon = types.ModuleType("mxnet.gluon")
+
+    class Trainer:
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore=None):
+            self._params = list(params.values()) \
+                if hasattr(params, "values") else list(params)
+            self._optimizer = optimizer
+            self._scale = (optimizer_params or {}).get("rescale_grad", 1.0)
+
+        def step(self, batch_size):
+            self._allreduce_grads()
+
+        def _allreduce_grads(self):
+            pass
+
+    gluon.Trainer = Trainer
+    mx.gluon = gluon
+    monkeypatch.setitem(sys.modules, "mxnet", mx)
+    monkeypatch.setitem(sys.modules, "mxnet.nd", nd)
+    monkeypatch.setitem(sys.modules, "mxnet.gluon", gluon)
+    return mx
+
+
+def test_import_without_mxnet_is_gated(monkeypatch):
+    import horovod_tpu.mxnet as hvd_mx  # import itself must not require mxnet
+    monkeypatch.setitem(sys.modules, "mxnet", None)
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx._mx()
+
+
+def test_single_process_collectives(fake_mxnet):
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    t = FakeNDArray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = hvd.allreduce(t, average=False)
+    np.testing.assert_allclose(out.asnumpy(), t.asnumpy())
+    out2 = hvd.allreduce(t, op=hvd.Average)
+    np.testing.assert_allclose(out2.asnumpy(), t.asnumpy())
+    g = hvd.allgather(t)
+    np.testing.assert_allclose(g.asnumpy(), t.asnumpy())
+    b = hvd.broadcast(t, root_rank=0)
+    np.testing.assert_allclose(b.asnumpy(), t.asnumpy())
+    t2 = FakeNDArray(np.zeros((2, 3), np.float32))
+    hvd.broadcast_(t2, root_rank=0)
+    outs = hvd.grouped_allreduce([t, t], average=False)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), t.asnumpy())
+
+
+def test_inplace_allreduce_writes_tensor(fake_mxnet):
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    t = FakeNDArray(np.ones((4,), np.float32) * 3)
+    r = hvd.allreduce_(t, average=True)
+    assert r is t
+    np.testing.assert_allclose(t.asnumpy(), 3.0)
+
+
+def test_broadcast_parameters(fake_mxnet):
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    params = {"w": FakeParameter(np.ones((2, 2))),
+              "b": FakeNDArray(np.zeros(2))}
+    hvd.broadcast_parameters(params, root_rank=0)
+    with pytest.raises(ValueError):
+        hvd.broadcast_parameters([1, 2, 3])
+
+
+def test_distributed_optimizer_delegates(fake_mxnet):
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+
+    calls = []
+
+    class Opt:
+        def update(self, index, weight, grad, state):
+            calls.append(("update", index))
+
+        def update_multi_precision(self, index, weight, grad, state):
+            calls.append(("ump", index))
+
+        def set_learning_rate(self, lr):
+            calls.append(("lr", lr))
+
+    opt = hvd.DistributedOptimizer(Opt())
+    g = FakeNDArray(np.ones(3, np.float32))
+    w = FakeNDArray(np.zeros(3, np.float32))
+    opt.update(0, w, g, None)
+    opt.update_multi_precision([1, 2], [w, w], [g, g], None)
+    opt.set_learning_rate(0.5)
+    assert calls == [("update", 0), ("ump", [1, 2]), ("lr", 0.5)]
+
+
+def test_distributed_trainer(fake_mxnet):
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    params = {"w": FakeParameter(np.ones((2, 2)), grad=np.full((2, 2), 4.0))}
+    trainer = hvd.DistributedTrainer(params, "sgd",
+                                     {"rescale_grad": 1.0})
+    assert trainer._scale == 1.0 / hvd.size()
+    trainer.step(1)  # single process: _allreduce_grads is a no-op pass-through
+
+    with pytest.raises(ValueError):
+        hvd.DistributedTrainer(
+            params, hvd.DistributedOptimizer(object()), {})
