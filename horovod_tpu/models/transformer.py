@@ -48,7 +48,7 @@ class TransformerConfig(NamedTuple):
     seq_len: int = 512
     n_experts: int = 0            # 0 → dense MLP; >0 → switch MoE
     capacity_factor: float = 1.25
-    attn_mode: str = "megatron"   # "megatron" (tp heads) | "ring" (sp ring)
+    attn_mode: str = "megatron"   # "megatron" (tp heads) | "ring" | "ulysses" (sp)
     dtype: Any = jnp.bfloat16
     remat: bool = True
 
@@ -126,7 +126,7 @@ def param_specs(cfg: TransformerConfig, par: ParallelConfig) -> Dict[str, Any]:
         "ln1": P("pp"),
         "ln2": P("pp"),
         # Megatron: qkv column-parallel (heads over mp), wo row-parallel.
-        # Ring: attention weights replicated over mp (sequence stays sharded).
+        # Ring/Ulysses: attention weights replicated over mp (sequence sharded).
         "wqkv": P("pp", None, None, "mp") if megatron else P("pp"),
         "wo": P("pp", None, "mp", None) if megatron else P("pp"),
     }
@@ -171,12 +171,16 @@ def _attention_block(cfg: TransformerConfig, lp: Dict[str, jax.Array],
         o = o.reshape(mb, s_full, local_heads * hd)
         return tp.row_parallel(o, lp["wo"].astype(x.dtype), "mp",
                                scatter_sequence=True)
-    else:  # ring attention: sequence stays sharded through attention
+    else:  # ring/ulysses: sequence stays sharded through attention
         qkv = jnp.einsum("bsd,de->bse", hnorm, lp["wqkv"].astype(x.dtype))
         mb, s_local = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(mb, s_local, h_heads, 3, hd)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        o = ra.ring_attention(q, k, v, axis_name="mp", causal=True)
+        if cfg.attn_mode == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+            o = ulysses_attention(q, k, v, axis_name="mp", causal=True)
+        else:
+            o = ra.ring_attention(q, k, v, axis_name="mp", causal=True)
         o = o.reshape(mb, s_local, h_heads * hd)
         return jnp.einsum("bse,ed->bsd", o, lp["wo"].astype(x.dtype))
 

@@ -43,6 +43,15 @@ Max = ReduceOp(4)
 Product = ReduceOp(5)
 
 
+def _op_range(kind: str, name, tensor):
+    """Profiler span around an eager collective (NVTX-range analog,
+    utils/profiler.py); payload size mirrors the reference's grouped-bytes
+    annotation (operations.cc:1018-1033)."""
+    from ..utils.profiler import op_range
+    nbytes = getattr(tensor, "nbytes", None)
+    return op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes)
+
+
 def _is_tracer(tensor) -> bool:
     try:
         import jax
@@ -134,10 +143,11 @@ def allreduce(tensor,
     if _is_tracer(tensor):
         return _compiled_allreduce(tensor, op, _default_axis(axis_name),
                                    prescale_factor, postscale_factor)
-    return _eager.allreduce(
-        tensor, op_fn=_eager_op_fn(op, prescale_factor, postscale_factor),
-        name=name, op_code=int(op), prescale=prescale_factor,
-        postscale=postscale_factor)
+    with _op_range("allreduce", name, tensor):
+        return _eager.allreduce(
+            tensor, op_fn=_eager_op_fn(op, prescale_factor, postscale_factor),
+            name=name, op_code=int(op), prescale=prescale_factor,
+            postscale=postscale_factor)
 
 
 def grouped_allreduce(tensors: Sequence,
@@ -185,7 +195,8 @@ def allgather(tensor, axis_name: Optional[str] = None,
     if _is_tracer(tensor):
         from jax import lax
         return lax.all_gather(tensor, _default_axis(axis_name), tiled=True)
-    return _eager.allgather(tensor, name=name)
+    with _op_range("allgather", name, tensor):
+        return _eager.allgather(tensor, name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -204,7 +215,8 @@ def broadcast(tensor, root_rank: int = 0, axis_name: Optional[str] = None,
         idx = lax.axis_index(ax)
         mask = (idx == root_rank).astype(tensor.dtype)
         return lax.psum(tensor * mask, ax)
-    return _eager.broadcast(tensor, root_rank=root_rank, name=name)
+    with _op_range("broadcast", name, tensor):
+        return _eager.broadcast(tensor, root_rank=root_rank, name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -225,7 +237,8 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
                 "uneven splits need the eager path")
         return lax.all_to_all(tensor, _default_axis(axis_name),
                               split_axis=0, concat_axis=0, tiled=True)
-    return _eager.alltoall(tensor, splits=splits, name=name)
+    with _op_range("alltoall", name, tensor):
+        return _eager.alltoall(tensor, splits=splits, name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -248,8 +261,9 @@ def reducescatter(tensor, op: int = Average,
     from . import eager
     code = Sum if op == Sum else Average
     fn = _eager_op_fn(code, 1.0, 1.0)
-    return eager.reducescatter(tensor, op_fn=fn, name=name,
-                               op_code=int(code))
+    with _op_range("reducescatter", name, tensor):
+        return eager.reducescatter(tensor, op_fn=fn, name=name,
+                                   op_code=int(code))
 
 
 # ---------------------------------------------------------------------------

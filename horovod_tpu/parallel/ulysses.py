@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence resharding.
+
+The second of the two standard long-context layouts (beside ring attention,
+ring_attention.py).  The reference's only sequence-layout primitive is
+alltoall (SURVEY.md §5.7: "the building block a Ulysses-style SP would
+use"); this module is that layout made first-class on TPU:
+
+1. activations arrive sequence-sharded: (B, S/P, H, D);
+2. one ``all_to_all`` trades the sequence shards for head shards:
+   (B, S, H/P, D) — every device now sees the **full** sequence for a
+   subset of heads;
+3. plain (flash) attention runs locally — no per-step ring hops, one
+   collective each way, which on ICI is a single fused all-to-all;
+4. a second ``all_to_all`` restores sequence sharding.
+
+Compared with ring attention: 2 collectives total instead of P ppermute
+rounds (better for moderate P / long S), but requires heads % P == 0 and
+peak activation memory holds the full sequence for H/P heads.
+
+Call inside ``shard_map`` with the sequence axis sharded over
+``axis_name``; differentiable by JAX AD (all_to_all transposes to itself).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from . import ring_attention as ra
+
+
+def _seq_to_head_sharded(x, axis_name):
+    # (B, S/P, H, D) → (B, S, H/P, D)
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _head_to_seq_sharded(x, axis_name):
+    # (B, S, H/P, D) → (B, S/P, H, D)
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence-sharded axis via head resharding.
+
+    q, k, v: (B, S_local, H, D) shards; returns the (B, S_local, H, D)
+    output shard.  Requires H divisible by the axis size.
+    """
+    sp = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"sequence-parallel degree ({sp}); use ring_attention for "
+            "head counts that don't divide")
+    if sp == 1:
+        return ra.full_attention(q, k, v, causal=causal, scale=scale)
+    qh = _seq_to_head_sharded(q, axis_name)
+    kh = _seq_to_head_sharded(k, axis_name)
+    vh = _seq_to_head_sharded(v, axis_name)
+    oh = ra.full_attention(qh, kh, vh, causal=causal, scale=scale)
+    return _head_to_seq_sharded(oh, axis_name)

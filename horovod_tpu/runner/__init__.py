@@ -1,1 +1,92 @@
+"""Launcher package + the in-process ``run()`` API.
 
+``horovod_tpu.runner.run(fn, ...)`` is the programmatic launcher the
+reference exposes as ``horovod.run`` (runner/__init__.py:92): it spawns
+``np`` local worker processes, establishes the same env contract as the
+``hvdrun`` CLI, executes ``fn`` in each as a rank, and returns the results
+ordered by rank.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as _mp
+import os
+import socket
+from typing import Any, Callable, List, Optional
+
+from .hosts import HostInfo, get_host_assignments, slot_env
+
+
+def _worker_main(fn, args, kwargs, env, q, rank):
+    os.environ.update(env)
+    try:
+        q.put((rank, True, fn(*args, **kwargs)))
+    except Exception as e:  # surface the failure to the parent
+        q.put((rank, False, repr(e)))
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, use_mpi: Optional[bool] = None,
+        use_gloo: Optional[bool] = None,
+        controller_port: int = 28500,
+        env: Optional[dict] = None) -> List[Any]:
+    """Run ``fn`` as ``np`` distributed ranks on this host and return the
+    list of per-rank results (rank order).
+
+    ``use_mpi``/``use_gloo`` are accepted for reference signature
+    compatibility (runner/__init__.py:92); the controller here is always
+    the TCP (gloo-analog) one — there is no MPI dependency on TPU VMs.
+    """
+    del use_mpi, use_gloo
+    kwargs = kwargs or {}
+    hostname = socket.gethostname()
+    slots = get_host_assignments([HostInfo(hostname, np)], np)
+    controller_addr = f"{hostname}:{controller_port}"
+
+    ctx = _mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = []
+    for slot in slots:
+        wenv = slot_env(slot, controller_addr)
+        # In-process runs stay on CPU: worker processes must not race for
+        # the single TPU chip the parent may hold.
+        wenv.setdefault("JAX_PLATFORMS", "cpu")
+        wenv.update(env or {})
+        p = ctx.Process(target=_worker_main,
+                        args=(fn, args, kwargs, wenv, q, slot.rank))
+        p.start()
+        procs.append(p)
+
+    import queue as _queue
+    results: dict = {}
+    try:
+        while len(results) < len(procs):
+            try:
+                rank, ok, value = q.get(timeout=1.0)
+            except _queue.Empty:
+                # Any worker that exited before reporting — crash, spawn
+                # re-import failure (stdin/REPL callers), sys.exit(0), or
+                # an unpicklable return value — would otherwise hang this
+                # loop forever.  Drain stragglers already in the queue
+                # before declaring the run dead.
+                if not q.empty():
+                    continue
+                lost = [(r, p.exitcode) for r, p in enumerate(procs)
+                        if not p.is_alive() and r not in results]
+                if lost:
+                    raise RuntimeError(
+                        f"worker(s) {lost} (rank, exitcode) exited before "
+                        "reporting a result. Note: run(fn) uses spawn, so "
+                        "it must be called from an importable module (not "
+                        "stdin/REPL), fn must be module-level, and its "
+                        "return value picklable.")
+                continue
+            if not ok:
+                raise RuntimeError(f"rank {rank} failed: {value}")
+            results[rank] = value
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return [results[r] for r in sorted(results)]

@@ -56,6 +56,10 @@ class _EstimatorParams:
             df = df.toPandas()
         n = len(df)
         if self.validation:
+            # Shuffle before splitting: ordered input (time- or
+            # label-sorted warehouse extracts) must not yield a biased
+            # validation set (the reference splits randomized too).
+            df = df.sample(frac=1.0, random_state=17).reset_index(drop=True)
             n_val = int(n * float(self.validation))
             val_df, train_df = df.iloc[:n_val], df.iloc[n_val:]
         else:
@@ -90,6 +94,12 @@ class KerasEstimator(_EstimatorParams):
         self.custom_objects = custom_objects or {}
 
     def fit(self, df) -> "KerasModel":
+        if self.num_proc and self.num_proc > 1:
+            raise ValueError(
+                "KerasEstimator in-process fit is single-rank; for "
+                "distributed keras training launch the script under "
+                "hvdrun or use horovod_tpu.spark.run on a pyspark "
+                "cluster (keras models don't survive spawn pickling)")
         train_path, val_path = self._materialize(df)
         x, y = self._load_arrays(train_path)
         val = self._load_arrays(val_path) if val_path else None
@@ -181,39 +191,104 @@ class TorchEstimator(_EstimatorParams):
 
     def fit(self, df) -> "TorchModel":
         import torch
-        import horovod_tpu.torch as hvd_torch
         train_path, val_path = self._materialize(df)
-        x, y = self._load_arrays(train_path)
 
-        hvd_torch.init()
-        model = self.model
-        base_opt = (self.optimizer_fn(model.parameters())
-                    if self.optimizer_fn
-                    else torch.optim.SGD(model.parameters(), lr=self.lr))
-        opt = hvd_torch.DistributedOptimizer(
-            base_opt, named_parameters=model.named_parameters())
-        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
-        loss_fn = self.loss_fn or torch.nn.MSELoss()
+        spec = {
+            "model": self.model, "optimizer_fn": self.optimizer_fn,
+            "loss_fn": self.loss_fn, "lr": self.lr, "epochs": self.epochs,
+            "batch_size": self.batch_size, "store_prefix":
+                self.store.prefix_path, "train_path": train_path,
+            "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols,
+        }
+        if self.num_proc and self.num_proc > 1:
+            # Data-parallel fit: one local rank per process, batches
+            # sharded by rank, gradients averaged by DistributedOptimizer
+            # (the reference distributes over Spark executors; pyspark jobs
+            # should use horovod_tpu.spark.run with a module-level fn).
+            from ..runner import run as _run
+            states = _run(_torch_fit_worker, args=(spec,),
+                          np=int(self.num_proc))
+            state = next(s for s in states if s is not None)
+            self.model.load_state_dict(
+                torch.load(io.BytesIO(state), weights_only=True))
+        else:
+            _torch_train_loop(spec)
 
-        xt = torch.from_numpy(x)
-        yt = torch.from_numpy(y)
-        n = len(xt)
-        for _ in range(self.epochs):
-            perm = torch.randperm(n)
-            for s in range(0, n, self.batch_size):
-                idx = perm[s:s + self.batch_size]
-                opt.zero_grad()
-                out = model(xt[idx])
-                loss = loss_fn(out, yt[idx])
-                loss.backward()
-                opt.step()
+        val_loss = None
+        if val_path:
+            xv, yv = self._load_arrays(val_path)
+            loss_fn = self.loss_fn or torch.nn.MSELoss()
+            with torch.no_grad():
+                val_loss = float(loss_fn(self.model(torch.from_numpy(xv)),
+                                         torch.from_numpy(yv)))
+            if self.verbose:
+                print(f"[TorchEstimator {self.run_id}] "
+                      f"validation loss: {val_loss:.6f}")
 
         buf = io.BytesIO()
-        torch.save(model.state_dict(), buf)
+        torch.save(self.model.state_dict(), buf)
         self.store.save_checkpoint(self.run_id, buf.getvalue())
-        return TorchModel(model=model, feature_cols=self.feature_cols,
-                          label_cols=self.label_cols, store=self.store,
-                          run_id=self.run_id)
+        out = TorchModel(model=self.model, feature_cols=self.feature_cols,
+                         label_cols=self.label_cols, store=self.store,
+                         run_id=self.run_id)
+        out.validation_loss = val_loss
+        return out
+
+
+def _torch_train_loop(spec) -> None:
+    """One rank's training loop: shard batches by rank, allreduce grads
+    through DistributedOptimizer, sync initial params from rank 0."""
+    import torch
+    import horovod_tpu.torch as hvd_torch
+    from .store import Store
+    hvd_torch.init()
+    model = spec["model"]
+    store = Store.create(spec["store_prefix"])
+    df = store.read_dataframe(spec["train_path"])
+    x, y = dataframe_to_arrays(df, spec["feature_cols"],
+                               spec["label_cols"])
+    # Shard by the eager communicator (participating processes), not
+    # hvd.size() — chip-level size can exceed the process count on a
+    # multi-device host, which would silently drop data.
+    from ..ops.collective import communicator_size
+    size = communicator_size()
+    rank = hvd_torch.rank() % size if size > 1 else 0
+    x, y = x[rank::size], y[rank::size]
+
+    base_opt = (spec["optimizer_fn"](model.parameters())
+                if spec["optimizer_fn"]
+                else torch.optim.SGD(model.parameters(), lr=spec["lr"]))
+    opt = hvd_torch.DistributedOptimizer(
+        base_opt, named_parameters=model.named_parameters())
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    loss_fn = spec["loss_fn"] or torch.nn.MSELoss()
+
+    xt, yt = torch.from_numpy(x), torch.from_numpy(y)
+    n = len(xt)
+    g = torch.Generator().manual_seed(13)
+    for _ in range(spec["epochs"]):
+        perm = torch.randperm(n, generator=g)
+        for s in range(0, n, spec["batch_size"]):
+            idx = perm[s:s + spec["batch_size"]]
+            opt.zero_grad()
+            loss = loss_fn(model(xt[idx]), yt[idx])
+            loss.backward()
+            opt.step()
+
+
+def _torch_fit_worker(spec):
+    """Module-level worker for runner.run (spawn requires picklability):
+    trains a rank; rank 0 returns the state_dict bytes."""
+    import io as _io
+    import torch
+    import horovod_tpu.torch as hvd_torch
+    _torch_train_loop(spec)
+    if hvd_torch.rank() == 0:
+        buf = _io.BytesIO()
+        torch.save(spec["model"].state_dict(), buf)
+        return buf.getvalue()
+    return None
 
 
 class TorchModel(_Model):

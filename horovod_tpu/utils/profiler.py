@@ -1,0 +1,73 @@
+"""Profiler trace ranges — the TPU-native analog of NVTX op ranges.
+
+The reference wraps every enqueued collective in an NVTX range so Nsight
+shows per-op spans (nvtx_op_range.h, operations.cc:1018-1033), disabled by
+``HOROVOD_DISABLE_NVTX_RANGES``.  On TPU the profiler is XProf/TensorBoard;
+``jax.profiler.TraceAnnotation`` plays NVTX's role: annotated spans appear
+on the host timeline of a captured trace alongside the device steps.
+
+* ``op_range(name, payload_bytes=…)`` — context manager for one collective.
+* ``start_trace(logdir)`` / ``stop_trace()`` — programmatic capture, the
+  analog of ``hvd.start_timeline``/``stop_timeline`` for device profiles
+  (the Chrome-trace Timeline of the native runtime is separate and remains
+  the coordinator-side view).
+
+Disable knob: ``HVD_TPU_DISABLE_TRACE_RANGES=1`` (reference knob:
+``HOROVOD_DISABLE_NVTX_RANGES``, common.h:96).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+
+def _enabled() -> bool:
+    return os.environ.get("HVD_TPU_DISABLE_TRACE_RANGES", "") != "1" and \
+        os.environ.get("HOROVOD_DISABLE_NVTX_RANGES", "") != "1"
+
+
+@contextlib.contextmanager
+def op_range(name: str, payload_bytes: Optional[int] = None):
+    """Annotate one collective on the profiler timeline.  Cheap no-op when
+    ranges are disabled or no trace is being captured.
+
+    Only annotation *setup* is guarded — exceptions raised by the wrapped
+    block must propagate untouched (a swallowed yield would mask every
+    eager-collective failure behind a generator error)."""
+    ann = None
+    if _enabled():
+        try:
+            import jax.profiler as _prof
+            label = name if payload_bytes is None else \
+                f"{name}#bytes={payload_bytes}"
+            ann = _prof.TraceAnnotation(label)
+        except Exception:
+            ann = None  # profiling must never break the op
+    if ann is None:
+        yield
+    else:
+        with ann:
+            yield
+
+
+def start_trace(logdir: str) -> None:
+    """Begin capturing an XProf device+host trace into ``logdir``."""
+    import jax.profiler as _prof
+    _prof.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    import jax.profiler as _prof
+    _prof.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a trace for the duration of the block."""
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()

@@ -158,3 +158,38 @@ def test_config_file(tmp_path):
                        "python", "x.py"])
     assert args.fusion_threshold_mb == 16
     assert args.cycle_time_ms == 5.0
+
+
+# ---------------------------------------------------------------------------
+# In-process run() API (reference horovod.run, runner/__init__.py:92)
+# ---------------------------------------------------------------------------
+
+def _rank_sum_fn(base):
+    import horovod_tpu as hvd
+    hvd.init()
+    import numpy as np
+    out = hvd.allreduce(np.array([float(hvd.rank() + base)]), op=hvd.Sum,
+                        name="runfn")
+    return float(out[0]), hvd.rank(), hvd.size()
+
+
+def test_run_api_two_ranks():
+    from horovod_tpu.runner import run
+    results = run(_rank_sum_fn, args=(1.0,), np=2,
+                  controller_port=28731)
+    assert len(results) == 2
+    sums = [r[0] for r in results]
+    # ranks 0,1 with base 1 → 1+2 = 3 on both
+    assert sums == [3.0, 3.0], results
+    assert [r[1] for r in results] == [0, 1]
+    assert all(r[2] == 2 for r in results)
+
+
+def _failing_fn():
+    raise RuntimeError("worker boom")
+
+
+def test_run_api_propagates_failure():
+    from horovod_tpu.runner import run
+    with pytest.raises(RuntimeError, match="failed"):
+        run(_failing_fn, np=1, controller_port=28733)

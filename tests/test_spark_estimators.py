@@ -111,3 +111,44 @@ def test_tensorflow_keras_alias_module():
     assert a.DistributedOptimizer is b.DistributedOptimizer
     assert a.callbacks.BroadcastGlobalVariablesCallback is \
         b.callbacks.BroadcastGlobalVariablesCallback
+
+
+def test_torch_estimator_distributed_fit(store):
+    """num_proc=2 fits data-parallel via runner.run: two real worker
+    processes, gradients averaged through the native controller."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import TorchEstimator
+
+    df = _regression_df(128)
+    est = TorchEstimator(
+        model=torch.nn.Linear(3, 1), lr=0.1, epochs=15, batch_size=32,
+        num_proc=2, store=store,
+        feature_cols=["features"], label_cols=["label"])
+    model = est.fit(df)
+    out = model.transform(df)
+    mse = float(np.mean((out["label__output"].values -
+                         df["label"].values) ** 2))
+    assert mse < 0.5, mse
+
+
+def test_torch_estimator_reports_validation_loss(store):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import TorchEstimator
+    df = _regression_df(96)
+    est = TorchEstimator(model=torch.nn.Linear(3, 1), lr=0.1, epochs=10,
+                         batch_size=24, store=store, validation=0.25,
+                         feature_cols=["features"], label_cols=["label"],
+                         verbose=0)
+    model = est.fit(df)
+    assert model.validation_loss is not None
+    assert model.validation_loss < 1.0
+
+
+def test_keras_estimator_rejects_inprocess_num_proc(store):
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.spark import KerasEstimator
+    m = tf.keras.Sequential([tf.keras.layers.Input(shape=(3,)),
+                             tf.keras.layers.Dense(1)])
+    est = KerasEstimator(model=m, store=store, num_proc=4)
+    with pytest.raises(ValueError, match="hvdrun|spark"):
+        est.fit(_regression_df(16))

@@ -108,3 +108,12 @@ def test_bf16_compiles_and_runs():
     opt_state = tx.init(params)
     params, opt_state, loss = step(params, opt_state, tokens, labels)
     assert np.isfinite(float(loss))
+
+
+def test_ulysses_mode_matches_serial():
+    cfg = CFG._replace(attn_mode="ulysses")
+    mesh, params, tokens, labels = _setup(cfg)
+    loss_of = tfm.make_loss_fn(cfg, PAR, mesh)
+    loss = jax.jit(loss_of)(params, tokens, labels)
+    expected = tfm.serial_forward_loss(CFG, params, tokens, labels)
+    np.testing.assert_allclose(float(loss), float(expected), rtol=1e-4)
