@@ -1,0 +1,50 @@
+"""Estimator API: fit a DataFrame, get a transformer back.
+
+Works on plain pandas DataFrames (Spark DataFrames are accepted too when
+pyspark is installed — they are materialized through the same Store).
+
+    python examples/spark_estimator.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+import numpy as np
+import pandas as pd
+import torch
+
+import horovod_tpu.spark as hvd_spark
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 4).astype(np.float32)
+    w = np.array([0.5, -1.0, 2.0, 0.25], dtype=np.float32)
+    df = pd.DataFrame({
+        "features": [row.tolist() for row in x],
+        "label": (x @ w + 0.05 * rng.randn(512)).astype(np.float32),
+    })
+
+    store = hvd_spark.Store.create(tempfile.mkdtemp(prefix="hvd_store_"))
+    est = hvd_spark.TorchEstimator(
+        model=torch.nn.Linear(4, 1),
+        lr=0.05, epochs=20, batch_size=64,
+        num_proc=2,                      # data-parallel over 2 local ranks
+        validation=0.2,
+        store=store,
+        feature_cols=["features"], label_cols=["label"])
+
+    model = est.fit(df)
+    print("validation loss:", model.validation_loss)
+    out = model.transform(df)
+    mse = float(np.mean((out["label__output"] - df["label"]) ** 2))
+    print("train MSE:", round(mse, 5))
+    print("checkpoint at:", store.get_checkpoint_path(est.run_id))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,15 +32,17 @@ from . import ring_attention as ra
 
 
 def _seq_to_head_sharded(x, axis_name):
-    # (B, S/P, H, D) → (B, S, H/P, D)
-    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                          tiled=True)
+    # (…, B, S/P, H, D) → (…, B, S, H/P, D); leading stack dims allowed.
+    nd = x.ndim
+    return lax.all_to_all(x, axis_name, split_axis=nd - 2,
+                          concat_axis=nd - 3, tiled=True)
 
 
 def _head_to_seq_sharded(x, axis_name):
-    # (B, S, H/P, D) → (B, S/P, H, D)
-    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                          tiled=True)
+    # (…, B, S, H/P, D) → (…, B, S/P, H, D)
+    nd = x.ndim
+    return lax.all_to_all(x, axis_name, split_axis=nd - 3,
+                          concat_axis=nd - 2, tiled=True)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -60,8 +62,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             "head counts that don't divide")
     if sp == 1:
         return ra.full_attention(q, k, v, causal=causal, scale=scale)
-    qh = _seq_to_head_sharded(q, axis_name)
-    kh = _seq_to_head_sharded(k, axis_name)
-    vh = _seq_to_head_sharded(v, axis_name)
-    oh = ra.full_attention(qh, kh, vh, causal=causal, scale=scale)
+    # One fused all-to-all for q/k/v (stacked on a leading dim) + one for
+    # the output: 2 collective launches per attention, not 4.
+    import jax.numpy as jnp
+    qkv = _seq_to_head_sharded(jnp.stack([q, k, v]), axis_name)
+    oh = ra.full_attention(qkv[0], qkv[1], qkv[2], causal=causal,
+                           scale=scale)
     return _head_to_seq_sharded(oh, axis_name)

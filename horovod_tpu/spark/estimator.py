@@ -196,8 +196,8 @@ class TorchEstimator(_EstimatorParams):
         spec = {
             "model": self.model, "optimizer_fn": self.optimizer_fn,
             "loss_fn": self.loss_fn, "lr": self.lr, "epochs": self.epochs,
-            "batch_size": self.batch_size, "store_prefix":
-                self.store.prefix_path, "train_path": train_path,
+            "batch_size": self.batch_size, "store": self.store,
+            "train_path": train_path,
             "feature_cols": self.feature_cols,
             "label_cols": self.label_cols,
         }
@@ -244,17 +244,21 @@ def _torch_train_loop(spec) -> None:
     from .store import Store
     hvd_torch.init()
     model = spec["model"]
-    store = Store.create(spec["store_prefix"])
+    store = spec["store"]  # user Store subclass travels to workers intact
     df = store.read_dataframe(spec["train_path"])
     x, y = dataframe_to_arrays(df, spec["feature_cols"],
                                spec["label_cols"])
     # Shard by the eager communicator (participating processes), not
     # hvd.size() — chip-level size can exceed the process count on a
-    # multi-device host, which would silently drop data.
+    # multi-device host, which would silently drop data.  Truncate to the
+    # common per-rank length: ragged shards would desynchronize the
+    # blocking per-gradient allreduces (mixed-step averages, then a hang
+    # when one rank runs an extra batch).
     from ..ops.collective import communicator_size
     size = communicator_size()
     rank = hvd_torch.rank() % size if size > 1 else 0
-    x, y = x[rank::size], y[rank::size]
+    n_local = len(x) // size if size > 1 else len(x)
+    x, y = x[rank::size][:n_local], y[rank::size][:n_local]
 
     base_opt = (spec["optimizer_fn"](model.parameters())
                 if spec["optimizer_fn"]

@@ -174,24 +174,87 @@ class DistributedGradientTape:
         return out
 
 
+class _LocalGradientAggregationHelper:
+    """Accumulate gradients locally for N backward passes, communicating
+    (and applying) only every Nth step (reference
+    tensorflow/gradient_aggregation.py LocalGradientAggregationHelper for
+    backward_passes_per_step > 1).  State lives in ``tf.Variable``s and the
+    every-Nth gate is a ``tf.cond`` so the logic survives ``tf.function``
+    tracing (Python-side counters would freeze at trace time — the same
+    reason the reference uses variable counters)."""
+
+    def __init__(self, passes: int):
+        self.passes = int(passes)
+        self.counter = None
+        self._acc: dict = {}
+
+    def _init_state(self, gv):
+        if self.counter is None:
+            self.counter = _tf.Variable(
+                0, trainable=False, dtype=_tf.int64,
+                name="hvd_agg_counter")
+        for i, (g, _v) in enumerate(gv):
+            if g is not None and i not in self._acc:
+                self._acc[i] = _tf.Variable(
+                    _tf.zeros_like(g), trainable=False,
+                    name=f"hvd_agg_{i}")
+
+    def apply(self, super_apply, grads_and_vars, reduce_fn):
+        gv = list(grads_and_vars)
+        self._init_state(gv)
+        for i, (g, _v) in enumerate(gv):
+            if g is not None:
+                self._acc[i].assign_add(_tf.convert_to_tensor(g))
+        self.counter.assign_add(1)
+
+        def _communicate_and_apply():
+            reduced = []
+            for i, (g, v) in enumerate(gv):
+                if g is None:
+                    reduced.append((None, v))
+                    continue
+                avg = self._acc[i] / _tf.cast(self.passes, g.dtype)
+                reduced.append((reduce_fn(avg, i), v))
+            super_apply(reduced)
+            for i, (g, _v) in enumerate(gv):
+                if g is not None:
+                    self._acc[i].assign(_tf.zeros_like(self._acc[i]))
+            return _tf.constant(True)
+
+        return _tf.cond(
+            _tf.equal(self.counter % self.passes, 0),
+            _communicate_and_apply,
+            lambda: _tf.constant(False))
+
+
 def DistributedOptimizer(optimizer, op: int = Average, compression=None,
                          backward_passes_per_step: int = 1,
                          name: Optional[str] = None):
     """Wrap a keras optimizer: apply_gradients allreduces first (graph-mode
-    _DistributedOptimizer analog for TF2 eager)."""
-    del backward_passes_per_step  # eager TF2 path communicates every step
+    _DistributedOptimizer analog for TF2 eager).  With
+    ``backward_passes_per_step`` > 1, gradients accumulate locally and
+    communication + weight update happen every Nth call (reference
+    gradient_aggregation.py)."""
 
     class _Wrapped(optimizer.__class__):
+        _hvd_agg = (_LocalGradientAggregationHelper(backward_passes_per_step)
+                    if backward_passes_per_step > 1 else None)
+
         def apply_gradients(self_, grads_and_vars, *args, **kwargs):
+            def _reduce(g, i):
+                return allreduce(g, op=op, name=f"opt.grad.{i}",
+                                 compression=compression)
+
+            def _super_apply(reduced):
+                return super(_Wrapped, self_).apply_gradients(
+                    reduced, *args, **kwargs)
+
             gv = list(grads_and_vars)
-            reduced = []
-            for i, (g, v) in enumerate(gv):
-                if g is not None:
-                    g = allreduce(g, op=op, name=f"opt.grad.{i}",
-                                  compression=compression)
-                reduced.append((g, v))
-            return super(_Wrapped, self_).apply_gradients(
-                reduced, *args, **kwargs)
+            if self_._hvd_agg is not None:
+                return self_._hvd_agg.apply(_super_apply, gv, _reduce)
+            return _super_apply(
+                [(None if g is None else _reduce(g, i), v)
+                 for i, (g, v) in enumerate(gv)])
 
     wrapped = _Wrapped.from_config(optimizer.get_config())
     # Carry over slot/iteration state where possible.

@@ -27,6 +27,8 @@ from ..core.state import global_state
 from ..ops.collective import (Average, Sum, Adasum, Min, Max, Product)
 from ..ops import collective as _C
 from ..optimizers import broadcast_object, allgather_object
+from .sync_batch_norm import SyncBatchNorm
+from . import elastic  # noqa: F401  (hvd.elastic.TorchState / ElasticSampler)
 
 
 class Compression:

@@ -1,0 +1,130 @@
+"""Torch elastic state: model/optimizer handlers + resumable sampler.
+
+Capability parity with the reference horovod/torch/elastic/:
+
+* ``TorchState(model=…, optimizer=…, **objs)`` — commit/restore snapshot
+  model and optimizer ``state_dict``s to host memory; ``sync`` broadcasts
+  them from rank 0 to (re)joining workers (torch/elastic/state.py:27-80).
+* ``ElasticSampler`` — a shard sampler that records processed indices so a
+  restored epoch resumes mid-batch after a world-size change
+  (torch/elastic/sampler.py).
+
+Usage matches the reference:
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+    @hvd.elastic.run
+    def train(state): ...
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Iterator, List, Optional
+
+import torch
+
+from ..elastic.state import ObjectState, run  # noqa: F401 (re-export)
+from ..optimizers import broadcast_object
+
+
+class TorchState(ObjectState):
+    def __init__(self, model: Optional[torch.nn.Module] = None,
+                 optimizer: Optional[torch.optim.Optimizer] = None,
+                 **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._model_snapshot = None
+        self._opt_snapshot = None
+        super().__init__(**kwargs)
+        self.save()
+
+    # -- handlers ----------------------------------------------------------
+    def save(self):
+        if self._model is not None:
+            self._model_snapshot = copy.deepcopy(self._model.state_dict())
+        if self._optimizer is not None:
+            self._opt_snapshot = copy.deepcopy(
+                self._optimizer.state_dict())
+        super().save()
+
+    def restore(self):
+        if self._model is not None and self._model_snapshot is not None:
+            self._model.load_state_dict(self._model_snapshot)
+        if self._optimizer is not None and self._opt_snapshot is not None:
+            self._optimizer.load_state_dict(self._opt_snapshot)
+        super().restore()
+
+    def sync(self):
+        if self._model is not None:
+            synced = broadcast_object(self._model_snapshot, root_rank=0,
+                                      name="torchstate.model")
+            self._model_snapshot = synced
+            self._model.load_state_dict(synced)
+        if self._optimizer is not None:
+            synced = broadcast_object(self._opt_snapshot, root_rank=0,
+                                      name="torchstate.opt")
+            self._opt_snapshot = synced
+            self._optimizer.load_state_dict(synced)
+        super().sync()
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Rank-sharded sampler that can resume an epoch after re-rendezvous:
+    indices already processed (recorded via ``record_batch``) are excluded
+    when the world re-shards (reference torch/elastic/sampler.py)."""
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self.reset()
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int):
+        """Mark one local batch as processed (call after each step)."""
+        start = batch_idx * batch_size
+        new = self.indices[start:start + batch_size]
+        self.processed_indices.update(new)
+
+    def load_state_dict(self, state):
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self.reset()
+
+    def state_dict(self):
+        return {"epoch": self.epoch,
+                "processed_indices": sorted(self.processed_indices)}
+
+    def reset(self):
+        """Re-shard the remaining (unprocessed) indices over the current
+        world; called on init, set_epoch, and elastic reset."""
+        from ..ops.collective import communicator_size
+        from ..core.basics import rank, is_initialized
+        size = communicator_size() if is_initialized() else 1
+        my_rank = rank() % size if is_initialized() and size > 1 else 0
+
+        remaining = [i for i in range(len(self.dataset))
+                     if i not in self.processed_indices]
+        if self.shuffle:
+            g = torch.Generator().manual_seed(self.seed + self.epoch)
+            order = torch.randperm(len(remaining), generator=g).tolist()
+            remaining = [remaining[i] for i in order]
+        # Pad so every rank draws the same number of batches.
+        if size > 1 and len(remaining) % size != 0:
+            pad = size - len(remaining) % size
+            remaining = remaining + remaining[:pad]
+        self.num_samples = len(remaining) // size if remaining else 0
+        self.indices: List[int] = remaining[my_rank::size] if remaining \
+            else []
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,123 @@
+"""Synchronized batch normalization for the torch front-end.
+
+Capability parity with the reference horovod/torch/sync_batch_norm.py:
+moments are computed over the *global* batch — local sums and counts are
+allreduced in the forward pass, and the backward pass allreduces the
+gradient statistics so ``grad_input`` matches exactly what a single-process
+run over the concatenated batch would produce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+from torch import nn
+from torch.autograd.function import Function
+
+from ..ops import collective as _C
+from ..ops.collective import Sum
+
+
+def _allreduce_sum(arr: np.ndarray, name: str) -> np.ndarray:
+    return np.asarray(_C.allreduce(arr, op=Sum, name=name))
+
+
+class _SyncBatchNormFn(Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var,
+                eps, momentum, track_running_stats, name):
+        c = input.shape[1]
+        x = input.transpose(0, 1).reshape(c, -1)          # (C, N*spatial)
+        local_count = x.shape[1]
+        s = x.sum(dim=1)
+        ssum = (x * x).sum(dim=1)
+
+        stats = np.concatenate([
+            s.detach().numpy().astype(np.float64),
+            ssum.detach().numpy().astype(np.float64),
+            np.array([float(local_count)])])
+        stats = _allreduce_sum(stats, name + ".fwd")
+        count = float(stats[-1])
+        mean = torch.from_numpy(stats[:c] / count).to(input.dtype)
+        var = torch.from_numpy(stats[c:2 * c] / count).to(input.dtype) \
+            - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        if track_running_stats and running_mean is not None:
+            unbiased = var * count / max(count - 1.0, 1.0)
+            running_mean.mul_(1 - momentum).add_(mean, alpha=momentum)
+            running_var.mul_(1 - momentum).add_(unbiased, alpha=momentum)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape)
+        if bias is not None:
+            out = out + bias.view(shape)
+
+        ctx.save_for_backward(input, weight, mean, invstd)
+        ctx.count = count
+        ctx.name = name
+        ctx.has_bias = bias is not None
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input, weight, mean, invstd = ctx.saved_tensors
+        c = input.shape[1]
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+
+        reduce_dims = [0] + list(range(2, input.dim()))
+        sum_dy = grad_output.sum(dim=reduce_dims)
+        sum_dy_xhat = (grad_output * xhat).sum(dim=reduce_dims)
+
+        grad_weight = sum_dy_xhat if weight is not None else None
+        grad_bias = sum_dy.clone() if ctx.has_bias else None
+
+        stats = np.concatenate([
+            sum_dy.detach().numpy().astype(np.float64),
+            sum_dy_xhat.detach().numpy().astype(np.float64)])
+        stats = _allreduce_sum(stats, ctx.name + ".bwd")
+        g_dy = torch.from_numpy(stats[:c]).to(input.dtype)
+        g_dy_xhat = torch.from_numpy(stats[c:]).to(input.dtype)
+
+        w = weight.view(shape) if weight is not None else 1.0
+        n = ctx.count
+        grad_input = (grad_output
+                      - g_dy.view(shape) / n
+                      - xhat * g_dy_xhat.view(shape) / n) \
+            * invstd.view(shape) * w
+        return (grad_input, grad_weight, grad_bias,
+                None, None, None, None, None, None)
+
+
+class SyncBatchNorm(nn.modules.batchnorm._BatchNorm):
+    """Drop-in BatchNorm whose statistics span all ranks (reference
+    torch/sync_batch_norm.py SyncBatchNorm)."""
+
+    _instances = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._name = f"syncbn.{SyncBatchNorm._instances}"
+        SyncBatchNorm._instances += 1
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if not self.training or _C.communicator_size() == 1:
+            return super().forward(input)
+        if self.momentum is None:
+            exponential_average_factor = 0.0
+        else:
+            exponential_average_factor = self.momentum
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, exponential_average_factor,
+            self.track_running_stats, self._name)

@@ -1,0 +1,184 @@
+"""Torch SyncBatchNorm, TorchState elastic handlers, ElasticSampler, and
+TF backward_passes_per_step aggregation (reference test/parallel/test_torch.py
+sync-BN tests, test_torch_elastic.py state round-trips)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def test_sync_batch_norm_single_process_matches_bn():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    torch.manual_seed(0)
+    x = torch.randn(8, 4, 5, 5)
+    sbn = hvd.SyncBatchNorm(4)
+    bn = torch.nn.BatchNorm2d(4)
+    bn.load_state_dict(sbn.state_dict())
+    # size()==1 short-circuits to plain BN.
+    out_s = sbn(x)
+    out_b = bn(x)
+    assert torch.allclose(out_s, out_b, atol=1e-6)
+
+
+def test_sync_batch_norm_fn_statistics_and_grad():
+    """Exercise the cross-rank Function directly (communicator size 1 so the
+    allreduce is identity): output/grad must match autograd through plain
+    batch-norm math over the same batch."""
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.sync_batch_norm import _SyncBatchNormFn
+    hvd.init()
+    torch.manual_seed(1)
+    x = torch.randn(6, 3, 4, requires_grad=True)
+    w = torch.randn(3, requires_grad=True)
+    b = torch.randn(3, requires_grad=True)
+
+    out = _SyncBatchNormFn.apply(x, w, b, None, None, 1e-5, 0.1, False,
+                                 "t1")
+    loss = (out ** 2).sum()
+    loss.backward()
+    gx, gw, gb = x.grad.clone(), w.grad.clone(), b.grad.clone()
+
+    x2 = x.detach().clone().requires_grad_(True)
+    w2 = w.detach().clone().requires_grad_(True)
+    b2 = b.detach().clone().requires_grad_(True)
+    mean = x2.mean(dim=(0, 2), keepdim=True)
+    var = x2.var(dim=(0, 2), unbiased=False, keepdim=True)
+    xhat = (x2 - mean) * torch.rsqrt(var + 1e-5)
+    out2 = xhat * w2.view(1, 3, 1) + b2.view(1, 3, 1)
+    ((out2 ** 2).sum()).backward()
+
+    assert torch.allclose(out, out2, atol=1e-5)
+    assert torch.allclose(gx, x2.grad, atol=1e-4)
+    assert torch.allclose(gw, w2.grad, atol=1e-4)
+    assert torch.allclose(gb, b2.grad, atol=1e-4)
+
+
+def test_sync_batch_norm_updates_running_stats():
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.sync_batch_norm import _SyncBatchNormFn
+    hvd.init()
+    torch.manual_seed(2)
+    x = torch.randn(16, 2)
+    rm = torch.zeros(2)
+    rv = torch.ones(2)
+    _SyncBatchNormFn.apply(x, None, None, rm, rv, 1e-5, 1.0, True, "t2")
+    assert torch.allclose(rm, x.mean(dim=0), atol=1e-5)
+    assert torch.allclose(rv, x.var(dim=0, unbiased=True), atol=1e-4)
+
+
+def test_torch_state_commit_restore_sync():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0,
+                                   batch=0)
+    state.commit()
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(1.0)
+    state.epoch = 7
+    state.restore()
+    for k, v in model.state_dict().items():
+        assert torch.allclose(v, before[k]), k
+    assert state.epoch == 0
+
+    state.epoch = 3
+    state.commit()
+    state.sync()  # single process: broadcast is identity
+    assert state.epoch == 3
+
+
+def test_elastic_sampler_resumes_mid_epoch():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    ds = list(range(20))
+    s = hvd.elastic.ElasticSampler(ds, shuffle=False)
+    assert len(s) == 20
+    first = list(s)[:8]
+    s.record_batch(0, 4)
+    s.record_batch(1, 4)
+    sd = s.state_dict()
+
+    s2 = hvd.elastic.ElasticSampler(ds, shuffle=False)
+    s2.load_state_dict(sd)
+    remaining = list(s2)
+    assert sorted(remaining) == sorted(set(range(20)) - set(first))
+    # New epoch clears the processed set.
+    s2.set_epoch(1)
+    assert len(list(s2)) == 20
+
+
+def test_tf_backward_passes_per_step_aggregates():
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    v = tf.Variable([1.0, 1.0])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.5),
+                                   backward_passes_per_step=3)
+    g = tf.constant([1.0, 2.0])
+    opt.apply_gradients([(g, v)])       # accumulate
+    opt.apply_gradients([(g, v)])       # accumulate
+    np.testing.assert_allclose(v.numpy(), [1.0, 1.0])  # no update yet
+    opt.apply_gradients([(g, v)])       # 3rd pass: avg + apply
+    np.testing.assert_allclose(v.numpy(), [0.5, 0.0], atol=1e-6)
+
+
+def _syncbn_worker():
+    import torch
+    import numpy as np
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    torch.manual_seed(100 + hvd.rank())
+    x = torch.randn(4, 3, 2, requires_grad=True)
+    sbn = hvd.SyncBatchNorm(3, affine=False)
+    sbn.train()
+    out = sbn(x)
+    (out ** 2).sum().backward()
+    return (x.detach().numpy(), out.detach().numpy(), x.grad.numpy(),
+            sbn.running_mean.numpy())
+
+
+def test_sync_batch_norm_two_ranks_global_stats():
+    """2 real processes: SyncBatchNorm output must equal plain BatchNorm
+    over the concatenated global batch."""
+    from horovod_tpu.runner import run
+    res = run(_syncbn_worker, np=2, controller_port=28741)
+    xs = np.concatenate([r[0] for r in res], axis=0)
+    outs = np.concatenate([r[1] for r in res], axis=0)
+    grads = np.concatenate([r[2] for r in res], axis=0)
+
+    xt = torch.from_numpy(xs).requires_grad_(True)
+    bn = torch.nn.BatchNorm1d(3, affine=False)
+    bn.train()
+    ref = bn(xt)
+    (ref ** 2).sum().backward()
+
+    np.testing.assert_allclose(outs, ref.detach().numpy(), atol=1e-4)
+    np.testing.assert_allclose(grads, xt.grad.numpy(), atol=1e-4)
+    np.testing.assert_allclose(res[0][3], res[1][3], atol=1e-6)  # same stats
+
+
+def test_tf_backward_passes_inside_tf_function():
+    """Aggregation must survive tf.function tracing (compiled model.fit
+    path): tf.Variable counter + tf.cond, not Python state."""
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    v = tf.Variable([4.0])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                   backward_passes_per_step=2)
+
+    @tf.function
+    def step():
+        opt.apply_gradients([(tf.constant([1.0]), v)])
+
+    seq = []
+    for _ in range(4):
+        step()
+        seq.append(float(v.numpy()[0]))
+    assert seq == [4.0, 3.0, 3.0, 2.0], seq
