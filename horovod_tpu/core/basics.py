@@ -44,15 +44,15 @@ def init(mesh=None,
     if global_state.initialized:
         return
 
-    import jax
-
     global_state.config = Config.from_env()
 
     # --- topology ---------------------------------------------------------
-    global_state.process_rank = jax.process_index()
-    global_state.process_count = jax.process_count()
-    local_devices = jax.local_device_count()
-    total_devices = jax.device_count()
+    # Launcher-spawned workers MUST NOT touch the JAX backend here: N
+    # workers initializing the accelerator platform on one host contend for
+    # the same chip(s) and block forever (the reference's init never touches
+    # a device either — gloo_run workers get topology purely from env,
+    # gloo_run.py:64-75).  JAX is consulted only in the single-process /
+    # jax.distributed fallback, and the mesh is built lazily on first use.
 
     # Elastic workers fetch their (re-)assignment from the rendezvous KV
     # each init — the world may have changed since the last round.
@@ -73,7 +73,9 @@ def init(mesh=None,
     env_rank = _env_int("RANK")
     env_size = _env_int("SIZE")
     if elastic_assignment is not None:
-        pass  # topology set above
+        # One process per slot: process topology == slot topology.
+        global_state.process_rank = global_state.rank
+        global_state.process_count = global_state.size
     elif env_rank is not None and env_size is not None:
         # Launcher-provided chip topology (one launched process per slot).
         global_state.rank = env_rank
@@ -82,8 +84,15 @@ def init(mesh=None,
         global_state.local_size = _env_int("LOCAL_SIZE") or 1
         global_state.cross_rank = _env_int("CROSS_RANK") or 0
         global_state.cross_size = _env_int("CROSS_SIZE") or 1
+        global_state.process_rank = env_rank
+        global_state.process_count = env_size
     else:
         # Derive from JAX: rank = chip-rank of this process's first device.
+        import jax
+        global_state.process_rank = jax.process_index()
+        global_state.process_count = jax.process_count()
+        local_devices = jax.local_device_count()
+        total_devices = jax.device_count()
         global_state.rank = global_state.process_rank * local_devices
         global_state.size = total_devices
         global_state.local_rank = 0
@@ -91,11 +100,12 @@ def init(mesh=None,
         global_state.cross_rank = global_state.process_rank
         global_state.cross_size = global_state.process_count
 
-    # --- mesh -------------------------------------------------------------
+    # --- mesh (lazy: built on first mesh() access) ------------------------
     if mesh is not None:
         global_state.mesh = mesh
     else:
-        global_state.mesh = _build_default_mesh(axes)
+        global_state.mesh = None
+        global_state.mesh_axes_hint = tuple(axes) if axes else None
 
     # --- eager-path controller -------------------------------------------
     if use_controller is None:
@@ -117,7 +127,7 @@ def init(mesh=None,
         "initialized: rank=%d size=%d local=%d/%d cross=%d/%d mesh=%s",
         global_state.rank, global_state.size, global_state.local_rank,
         global_state.local_size, global_state.cross_rank,
-        global_state.cross_size, global_state.mesh)
+        global_state.cross_size, global_state.mesh or "<lazy>")
 
 
 def _build_default_mesh(axes: Optional[Sequence[str]] = None):
@@ -206,8 +216,12 @@ def process_count() -> int:
 
 
 def mesh():
-    """The global device mesh created by init()."""
+    """The global device mesh.  Built lazily on first access so eager-only
+    workers (launcher-spawned, native TCP data plane) never initialize the
+    JAX backend at all."""
     _check_init()
+    if global_state.mesh is None:
+        global_state.mesh = _build_default_mesh(global_state.mesh_axes_hint)
     return global_state.mesh
 
 

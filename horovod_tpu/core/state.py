@@ -39,7 +39,10 @@ class GlobalState:
     process_count: int = 1
 
     # The global device mesh. 1-D over DATA_AXIS unless the user passed one.
+    # Built lazily by basics.mesh() so eager-only workers never touch the
+    # JAX backend; mesh_axes_hint carries init(axes=...) until then.
     mesh: Optional[Any] = None
+    mesh_axes_hint: Optional[Any] = None
 
     # Native eager-path runtime (attached lazily; None in pure-compiled mode).
     controller: Optional[Any] = None
@@ -52,6 +55,7 @@ class GlobalState:
     def reset(self) -> None:
         self.initialized = False
         self.mesh = None
+        self.mesh_axes_hint = None
         self.controller = None
 
 

@@ -19,6 +19,11 @@ from .hosts import HostInfo, get_host_assignments, slot_env
 
 def _worker_main(fn, args, kwargs, env, q, rank):
     os.environ.update(env)
+    # Env alone is not enough where a sitecustomize pins the platform via
+    # jax.config at interpreter start — apply the in-process override before
+    # fn's first backend-initializing jax call.
+    from .bootstrap import apply_platform
+    apply_platform()
     try:
         q.put((rank, True, fn(*args, **kwargs)))
     except Exception as e:  # surface the failure to the parent
@@ -50,7 +55,8 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         wenv = slot_env(slot, controller_addr)
         # In-process runs stay on CPU: worker processes must not race for
         # the single TPU chip the parent may hold.
-        wenv.setdefault("JAX_PLATFORMS", "cpu")
+        wenv.setdefault("HVD_TPU_WORKER_PLATFORM", "cpu")
+        wenv.setdefault("HVD_TPU_WORKER_CPU_DEVICES", "1")
         wenv.update(env or {})
         p = ctx.Process(target=_worker_main,
                         args=(fn, args, kwargs, wenv, q, slot.rank))

@@ -1,0 +1,75 @@
+"""Worker bootstrap: apply the per-slot accelerator platform *before* the
+user script runs, then exec it in-process.
+
+Why this exists: the launcher partitions a host's TPU chips among its worker
+processes via env (``TPU_VISIBLE_DEVICES`` et al. — the TPU analog of the
+reference's per-slot env contract, gloo_run.py:64-75).  But some
+environments force a hardware platform through ``jax.config`` at interpreter
+startup (sitecustomize PJRT registration), where a plain ``JAX_PLATFORMS``
+env var is silently ignored.  The only reliable override is an in-process
+``jax.config.update`` made before the backend initializes — which must
+happen before the *user's* ``import jax``.  So the launcher rewrites
+``python train.py ...`` into ``python -m horovod_tpu.runner.bootstrap --
+train.py ...`` whenever a platform override is needed.
+
+Env contract (set by the launcher, see runner/launch.py):
+  HVD_TPU_WORKER_PLATFORM      "cpu" | "tpu" | unset (inherit)
+  HVD_TPU_WORKER_CPU_DEVICES   device count for the cpu platform (default 1)
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def apply_platform() -> None:
+    """Pin jax to the slot's platform before any backend init.  Safe to call
+    when jax is absent (non-JAX workers) or the platform is inherited."""
+    plat = os.environ.get("HVD_TPU_WORKER_PLATFORM")
+    if not plat or plat == "inherit":
+        return
+    try:
+        import jax
+    except ImportError:
+        return
+    try:
+        jax.config.update("jax_platforms", plat)
+        if plat == "cpu":
+            n = int(os.environ.get("HVD_TPU_WORKER_CPU_DEVICES", "1"))
+            jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        # Backend already initialized (user imported+used jax before us via
+        # a PYTHONSTARTUP hook?) — nothing we can do; leave it.
+        pass
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    apply_platform()
+    if not argv:
+        return 0
+    if argv[0] == "-m":
+        if len(argv) < 2:
+            print("bootstrap: -m requires a module name", file=sys.stderr)
+            return 2
+        sys.argv = argv[1:]
+        runpy.run_module(argv[1], run_name="__main__", alter_sys=True)
+    elif argv[0] == "-c":
+        if len(argv) < 2:
+            print("bootstrap: -c requires a command", file=sys.stderr)
+            return 2
+        sys.argv = ["-c"] + argv[2:]
+        exec(compile(argv[1], "<string>", "exec"),  # noqa: S102
+             {"__name__": "__main__", "__builtins__": __builtins__})
+    else:
+        sys.argv = argv
+        runpy.run_path(argv[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

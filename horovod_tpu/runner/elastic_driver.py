@@ -83,9 +83,11 @@ class ElasticDriver:
                  discovery_interval: float = 1.0,
                  reset_limit: Optional[int] = None,
                  extra_env: Optional[Dict[str, str]] = None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 platform_policy: str = "auto"):
         self._discovery = discovery
         self._command = command
+        self._platform_policy = platform_policy
         self._min_np = min_np
         self._max_np = max_np
         self._base_port = controller_base_port
@@ -104,6 +106,7 @@ class ElasticDriver:
         self._workers: Dict[str, exec_mod.WorkerProcess] = {}  # slot_id →
         self._shutdown = threading.Event()
         self._finished: Dict[str, int] = {}
+        self._succeeded = False  # any worker exited 0: job is completing
         self._result: Optional[int] = None
         self._result_cv = threading.Condition()
 
@@ -198,7 +201,8 @@ class ElasticDriver:
             [s], self._command, controller_addr="elastic",
             extra_env=env,
             on_exit=lambda slot, code, sid=self._slot_id(s):
-                self._on_worker_exit(sid, slot, code))
+                self._on_worker_exit(sid, slot, code),
+            platform_policy=self._platform_policy)
         self._workers[self._slot_id(s)] = ws[0]
 
     def _on_worker_exit(self, sid: str, slot: SlotInfo, code: int):
@@ -211,6 +215,13 @@ class ElasticDriver:
                 # Success of any worker ends the job successfully once all
                 # live workers drain (reference: results registered per
                 # rank; first completed round wins).
+                self._succeeded = True
+                if not self._workers:
+                    self._set_result(0)
+                return
+            if self._succeeded:
+                # A rank already completed the job: a straggler failing on
+                # the way out must not blacklist hosts or spawn a new round.
                 if not self._workers:
                     self._set_result(0)
                 return
@@ -220,7 +231,8 @@ class ElasticDriver:
             if self._verbose:
                 print(f"[elastic] worker {sid} failed (exit {code}); "
                       f"blacklisting {slot.hostname}")
-            self._bump_reset()
+            if self._bump_reset():
+                return
             try:
                 hosts = self._discover_filtered()
             except RuntimeError:
@@ -235,11 +247,14 @@ class ElasticDriver:
             self._publish_host_event(added_only=False)
             self._start_round(hosts)
 
-    def _bump_reset(self):
+    def _bump_reset(self) -> bool:
+        """Count a reset; True (job over) once the limit is exceeded."""
         self._resets += 1
         if self._reset_limit is not None and self._resets > self._reset_limit:
             print(f"[elastic] reset limit {self._reset_limit} exceeded")
             self._set_result(1)
+            return True
+        return False
 
     def _set_result(self, code: int):
         with self._result_cv:
@@ -266,6 +281,10 @@ class ElasticDriver:
                     print(f"[elastic] discovery error: {e}")
                 continue
             with self._lock:
+                if self._succeeded or self._result is not None:
+                    # A rank already completed the job: host churn must not
+                    # respawn finished slots in a fresh round.
+                    return
                 cur = {h.hostname: h.slots for h in self._current_hosts}
                 new = {h.hostname: h.slots for h in hosts}
                 if new == cur:
@@ -295,5 +314,6 @@ def run_elastic(args) -> int:
     driver = ElasticDriver(
         discovery, args.command, min_np=min_np, max_np=args.max_np,
         reset_limit=args.reset_limit, extra_env=knob_env(args),
-        verbose=args.verbose)
+        verbose=args.verbose,
+        platform_policy=getattr(args, "worker_platform", "auto"))
     return driver.run()

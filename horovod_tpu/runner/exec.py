@@ -52,17 +52,38 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                    controller_addr: str,
                    extra_env: Optional[Dict[str, str]] = None,
                    on_exit: Optional[Callable[[SlotInfo, int], None]] = None,
-                   prefix_output: bool = True) -> List[WorkerProcess]:
-    """Start one process per slot; returns immediately with handles."""
+                   prefix_output: bool = True,
+                   platform_policy: str = "auto") -> List[WorkerProcess]:
+    """Start one process per slot; returns immediately with handles.
+
+    ``platform_policy`` decides how each host's workers share its TPU chips
+    (chips.plan_host_platform): exclusive inherit, per-slot chip partition
+    env, or CPU-pinned eager workers.  Workers needing an in-process
+    platform override are routed through the bootstrap module.
+    """
+    from . import chips as chips_mod
+    plans = {}
+    for slot in slots:
+        if slot.hostname not in plans:
+            chips, part = chips_mod.host_chip_inventory(
+                slot.hostname, _is_local(slot.hostname))
+            plans[slot.hostname] = chips_mod.plan_host_platform(
+                slot.local_size, platform_policy,
+                chips=chips, partitionable=part)
     workers = []
     for slot in slots:
+        platform = plans[slot.hostname].slot_env(
+            slot.local_rank, slot.local_size)
         env = dict(os.environ)
         env.update(slot_env(slot, controller_addr))
+        env.update(platform)
         if extra_env:
             env.update(extra_env)
-        cmd = build_command(slot, command,
+        slot_command = chips_mod.wrap_python_command(command) \
+            if chips_mod.needs_bootstrap(platform) else command
+        cmd = build_command(slot, slot_command,
                             {**slot_env(slot, controller_addr),
-                             **(extra_env or {})})
+                             **platform, **(extra_env or {})})
         proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, bufsize=1, start_new_session=True)

@@ -38,6 +38,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="hostfile path (mpirun-style slots=N supported)")
     p.add_argument("--controller-port", type=int, default=26000)
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--worker-platform", choices=("auto", "cpu", "tpu"),
+                   default="auto",
+                   help="how workers share each host's TPU chips: auto = "
+                        "exclusive/partition/fall-back-to-cpu, cpu = force "
+                        "CPU eager workers, tpu = inherit (externally "
+                        "partitioned)")
     p.add_argument("--config-file", default=None)
     # Elastic.
     p.add_argument("--min-np", type=int, default=None)
@@ -162,7 +168,8 @@ def run_static(args: argparse.Namespace) -> int:
             print(f"rank {s.rank} -> {s.hostname} (local {s.local_rank}/"
                   f"{s.local_size}, cross {s.cross_rank}/{s.cross_size})")
     workers = exec_mod.launch_workers(slots, args.command, controller_addr,
-                                      extra_env=extra_env)
+                                      extra_env=extra_env,
+                                      platform_policy=args.worker_platform)
     try:
         return exec_mod.wait_all(workers)
     finally:

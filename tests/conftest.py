@@ -35,3 +35,44 @@ def _fresh_runtime():
     import horovod_tpu as hvd
     if hvd.is_initialized():
         hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Timeout enforcement.  pytest-timeout is not installed in this image, so
+# @pytest.mark.timeout marks would silently be no-ops; enforce them (plus a
+# default ceiling for unmarked tests) with SIGALRM so a wedged subprocess
+# test fails loudly instead of hanging the whole suite.
+# ---------------------------------------------------------------------------
+
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+_DEFAULT_TEST_TIMEOUT = int(os.environ.get("HVD_TPU_TEST_TIMEOUT", "180"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args \
+        else _DEFAULT_TEST_TIMEOUT
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {seconds}s timeout (conftest SIGALRM enforcer)")
+
+    use_alarm = threading.current_thread() is threading.main_thread()
+    if use_alarm:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        if use_alarm:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test wall-clock limit "
+        "(enforced by conftest SIGALRM)")

@@ -1,0 +1,116 @@
+"""Launcher chip-partitioning policy (runner/chips.py): the TPU analog of
+the reference's per-slot env contract (gloo_run.py:64-75)."""
+
+import os
+
+import pytest
+
+from horovod_tpu.runner import chips
+
+
+def test_partition_env_four_chips_four_procs():
+    env = chips.partition_env(2, 4, 4)
+    assert env["TPU_VISIBLE_DEVICES"] == "2"
+    assert env["TPU_PROCESS_BOUNDS"] == "2,2,1"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["CLOUD_TPU_TASK_ID"] == "2"
+    ports = env["TPU_PROCESS_ADDRESSES"].split(",")
+    assert len(ports) == 4
+    assert env["TPU_PROCESS_PORT"] == ports[2].split(":")[1]
+
+
+def test_partition_env_eight_chips_two_procs():
+    env = chips.partition_env(1, 2, 8)
+    assert env["TPU_VISIBLE_DEVICES"] == "4,5,6,7"
+    pb = [int(x) for x in env["TPU_PROCESS_BOUNDS"].split(",")]
+    cb = [int(x) for x in env["TPU_CHIPS_PER_PROCESS_BOUNDS"].split(",")]
+    assert pb[0] * pb[1] * pb[2] == 2
+    assert cb[0] * cb[1] * cb[2] == 4
+    # Process grid × chips-per-process grid must tile the 2x4x1 host board.
+    assert [p * c for p, c in zip(pb, cb)] == [2, 4, 1]
+
+
+def test_partition_env_indivisible_returns_none():
+    assert chips.partition_env(0, 3, 4) is None
+    assert chips.partition_env(0, 2, 0) is None
+
+
+def test_plan_auto_single_worker_inherits():
+    plan = chips.plan_host_platform(1, "auto", chips=1, partitionable=False)
+    assert plan.mode == "inherit"
+    assert plan.slot_env(0, 1) == {}
+
+
+def test_plan_auto_contended_tunnel_falls_back_to_cpu():
+    # The bench-machine shape: one non-partitionable (tunneled) chip and two
+    # workers — both must be pinned to the CPU platform.
+    plan = chips.plan_host_platform(2, "auto", chips=1, partitionable=False)
+    assert plan.mode == "cpu"
+    env = plan.slot_env(1, 2)
+    assert env["HVD_TPU_WORKER_PLATFORM"] == "cpu"
+    assert env["HVD_TPU_WORKER_CPU_DEVICES"] == "1"
+
+
+def test_plan_auto_partitions_when_divisible():
+    plan = chips.plan_host_platform(4, "auto", chips=4, partitionable=True)
+    assert plan.mode == "partition"
+    assert plan.slot_env(0, 4)["TPU_VISIBLE_DEVICES"] == "0"
+    assert plan.slot_env(3, 4)["TPU_VISIBLE_DEVICES"] == "3"
+
+
+def test_plan_forced_cpu_and_tpu():
+    assert chips.plan_host_platform(4, "cpu").mode == "cpu"
+    plan = chips.plan_host_platform(
+        4, "tpu", chips=1, partitionable=False)
+    assert plan.mode == "inherit"
+    assert plan.slot_env(0, 4) == {}
+
+
+def test_chip_inventory_env_override(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_CHIPS_PER_HOST", "4")
+    count, partitionable = chips.local_chip_inventory()
+    assert count == 4 and partitionable
+
+
+def test_wrap_python_command():
+    wrapped = chips.wrap_python_command(
+        ["python", "train.py", "--epochs", "3"])
+    assert wrapped[:4] == ["python", "-m", "horovod_tpu.runner.bootstrap",
+                           "--"]
+    assert wrapped[4:] == ["train.py", "--epochs", "3"]
+    assert chips.wrap_python_command(["./a.out"]) == ["./a.out"]
+
+
+def test_wrap_python_command_keeps_interpreter_flags():
+    wrapped = chips.wrap_python_command(
+        ["python3", "-u", "-W", "ignore", "train.py", "-m", "x"])
+    assert wrapped == ["python3", "-u", "-W", "ignore", "-m",
+                       "horovod_tpu.runner.bootstrap", "--",
+                       "train.py", "-m", "x"]
+    # -m/-c stay on the bootstrap side so runpy handles them.
+    wrapped = chips.wrap_python_command(["python", "-m", "mymod", "--flag"])
+    assert wrapped == ["python", "-m", "horovod_tpu.runner.bootstrap", "--",
+                       "-m", "mymod", "--flag"]
+
+
+def test_partition_plan_falls_back_to_cpu_when_split_invalid():
+    plan = chips.HostPlatformPlan("partition", chips=4)
+    env = plan.slot_env(0, 3)  # 3 does not divide 4
+    assert env["HVD_TPU_WORKER_PLATFORM"] == "cpu"
+
+
+def test_remote_unknown_inventory(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_CHIPS_PER_HOST", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    count, part = chips.host_chip_inventory("far-away-host", is_local=False)
+    assert (count, part) == (-1, False)
+    # Unknown remote: sole worker inherits, multiple workers CPU-pin.
+    assert chips.plan_host_platform(1, "auto", chips=-1,
+                                    partitionable=False).mode == "inherit"
+    assert chips.plan_host_platform(4, "auto", chips=-1,
+                                    partitionable=False).mode == "cpu"
+
+
+def test_needs_bootstrap():
+    assert chips.needs_bootstrap({"HVD_TPU_WORKER_PLATFORM": "cpu"})
+    assert not chips.needs_bootstrap({"TPU_VISIBLE_DEVICES": "0"})
