@@ -15,7 +15,12 @@ from typing import Any, Callable, Dict, Optional
 
 
 class Handle:
-    __slots__ = ("_result", "_error", "_done", "_poll_fn", "_wait_fn")
+    """One in-flight eager op.  ``poll_fn`` answers "has the op completed?"
+    without finalizing it; ``wait_fn`` blocks, finalizes (releases native
+    resources) and returns the result — it runs exactly once even if poll
+    already reported completion."""
+
+    __slots__ = ("_result", "_error", "_finalized", "_poll_fn", "_wait_fn")
 
     def __init__(self,
                  result: Any = None,
@@ -23,21 +28,27 @@ class Handle:
                  wait_fn: Optional[Callable[[], Any]] = None):
         self._result = result
         self._error: Optional[BaseException] = None
-        self._done = poll_fn is None
+        self._finalized = wait_fn is None
         self._poll_fn = poll_fn
         self._wait_fn = wait_fn
 
     def poll(self) -> bool:
-        if self._done:
+        if self._finalized:
             return True
-        if self._poll_fn is not None and self._poll_fn():
-            self._done = True
-        return self._done
+        if self._poll_fn is None:
+            return True
+        return bool(self._poll_fn())
 
     def wait(self) -> Any:
-        if not self._done and self._wait_fn is not None:
-            self._result = self._wait_fn()
-            self._done = True
+        if not self._finalized:
+            try:
+                self._result = self._wait_fn()
+            except Exception as e:  # surfaced on this and later waits
+                self._error = e
+            # KeyboardInterrupt/SystemExit propagate un-finalized: the op is
+            # still pending and a later wait must retry (and release native
+            # resources) rather than replay a stale interrupt.
+            self._finalized = True
         if self._error is not None:
             raise self._error
         return self._result

@@ -221,9 +221,14 @@ class NativeController:
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_native_poll(handle))
 
-    def allreduce(self, arr: np.ndarray, op: int = 1,
-                  prescale: float = 1.0, postscale: float = 1.0,
-                  name: Optional[str] = None) -> np.ndarray:
+    def allreduce_submit(self, arr: np.ndarray, op: int = 1,
+                         prescale: float = 1.0, postscale: float = 1.0,
+                         name: Optional[str] = None
+                         ) -> Tuple[int, np.ndarray, np.ndarray]:
+        """Enqueue an allreduce; returns (handle, in_buf, out_buf).  The
+        caller must keep both buffers alive until the matching
+        ``allreduce_finish`` (true-async contract: the background runtime
+        streams from/to them while the op is in flight)."""
         arr = np.ascontiguousarray(arr)
         out = np.empty_like(arr)
         ndim, shape = _shape_arg(arr)
@@ -232,9 +237,21 @@ class NativeController:
             arr.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
             ndim, shape, _dtype_code(arr), op, prescale, postscale)
+        if h < 0:
+            raise NativeError(self._last_error())
+        return h, arr, out
+
+    def allreduce_finish(self, h: int, out: np.ndarray) -> np.ndarray:
         self._wait(h)
         self._lib.hvd_native_release(h)
         return out
+
+    def allreduce(self, arr: np.ndarray, op: int = 1,
+                  prescale: float = 1.0, postscale: float = 1.0,
+                  name: Optional[str] = None) -> np.ndarray:
+        h, _arr, out = self.allreduce_submit(arr, op=op, prescale=prescale,
+                                             postscale=postscale, name=name)
+        return self.allreduce_finish(h, out)
 
     def grouped_allreduce(self, arrs, op: int = 1, prescale: float = 1.0,
                           postscale: float = 1.0,
@@ -256,14 +273,20 @@ class NativeController:
             self.wait(h)
         return outs
 
-    def allgather(self, arr: np.ndarray,
-                  name: Optional[str] = None) -> np.ndarray:
+    def allgather_submit(self, arr: np.ndarray,
+                         name: Optional[str] = None
+                         ) -> Tuple[int, np.ndarray]:
         arr = np.ascontiguousarray(arr)
         ndim, shape = _shape_arg(arr)
         h = self._lib.hvd_native_allgather(
             self._auto_name("allgather", name),
             arr.ctypes.data_as(ctypes.c_void_p), ndim, shape,
             _dtype_code(arr))
+        if h < 0:
+            raise NativeError(self._last_error())
+        return h, arr
+
+    def allgather_finish(self, h: int, arr: np.ndarray) -> np.ndarray:
         self._wait(h)
         nbytes = self._lib.hvd_native_result_bytes(h)
         dims = (ctypes.c_int64 * self.size())()
@@ -276,8 +299,14 @@ class NativeController:
         self._lib.hvd_native_release(h)
         return out
 
-    def broadcast(self, arr: np.ndarray, root_rank: int = 0,
+    def allgather(self, arr: np.ndarray,
                   name: Optional[str] = None) -> np.ndarray:
+        h, arr = self.allgather_submit(arr, name=name)
+        return self.allgather_finish(h, arr)
+
+    def broadcast_submit(self, arr: np.ndarray, root_rank: int = 0,
+                         name: Optional[str] = None
+                         ) -> Tuple[int, np.ndarray, np.ndarray]:
         arr = np.ascontiguousarray(arr)
         out = arr.copy()
         ndim, shape = _shape_arg(arr)
@@ -286,14 +315,25 @@ class NativeController:
             arr.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
             ndim, shape, _dtype_code(arr), root_rank)
+        if h < 0:
+            raise NativeError(self._last_error())
+        return h, arr, out
+
+    def broadcast_finish(self, h: int, out: np.ndarray) -> np.ndarray:
         self._wait(h)
         self._lib.hvd_native_release(h)
         return out
 
-    def alltoall(self, arr: np.ndarray,
-                 splits: Optional[Sequence[int]] = None,
-                 name: Optional[str] = None
-                 ) -> Tuple[np.ndarray, np.ndarray]:
+    def broadcast(self, arr: np.ndarray, root_rank: int = 0,
+                  name: Optional[str] = None) -> np.ndarray:
+        h, _arr, out = self.broadcast_submit(arr, root_rank=root_rank,
+                                             name=name)
+        return self.broadcast_finish(h, out)
+
+    def alltoall_submit(self, arr: np.ndarray,
+                        splits: Optional[Sequence[int]] = None,
+                        name: Optional[str] = None
+                        ) -> Tuple[int, np.ndarray]:
         arr = np.ascontiguousarray(arr)
         size = self.size()
         if splits is None:
@@ -306,7 +346,14 @@ class NativeController:
             self._auto_name("alltoall", name),
             arr.ctypes.data_as(ctypes.c_void_p), ndim, shape,
             _dtype_code(arr), sp, len(splits))
+        if h < 0:
+            raise NativeError(self._last_error())
+        return h, arr
+
+    def alltoall_finish(self, h: int, arr: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
         self._wait(h)
+        size = self.size()
         dims = (ctypes.c_int64 * size)()
         self._lib.hvd_native_result_dims(h, dims, size)
         recv_splits = np.array(list(dims), dtype=np.int32)
@@ -316,6 +363,13 @@ class NativeController:
             h, out.ctypes.data_as(ctypes.c_void_p), max(out.nbytes, 1))
         self._lib.hvd_native_release(h)
         return out, recv_splits
+
+    def alltoall(self, arr: np.ndarray,
+                 splits: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        h, arr = self.alltoall_submit(arr, splits=splits, name=name)
+        return self.alltoall_finish(h, arr)
 
     def join(self) -> int:
         return self._lib.hvd_native_join()

@@ -144,6 +144,7 @@ void Controller::AbsorbCacheHits(const std::vector<RequestList>& lists,
           it = table_.find(name);
         }
         auto rm = ct.by_rank.find(r);
+        if (!it->second.by_rank.count(r)) RecordReady(name, r);
         it->second.by_rank[r] = rm != ct.by_rank.end() ? rm->second
                                                        : ct.meta;
         hit_counts[bit]++;
@@ -177,7 +178,9 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
         pt.by_rank[r] = q;
         table_.emplace(q.name, std::move(pt));
         arrival_order_.push_back(q.name);
+        RecordReady(q.name, r);
       } else {
+        if (!it->second.by_rank.count(r)) RecordReady(q.name, r);
         it->second.by_rank[r] = q;
       }
       // Note: a full request for a cached name does NOT invalidate the
@@ -331,6 +334,15 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
 
   CheckStalls(rl);
   return rl;
+}
+
+void Controller::RecordReady(const std::string& name, int32_t rank) {
+  // Per-rank NEGOTIATE ready instant — the reference timeline's #1
+  // debugging feature: which rank is late for which tensor
+  // (timeline.cc:496-541).
+  if (timeline_ && timeline_->active())
+    timeline_->Record(name, "i", "NEGOTIATE_READY",
+                      "{\"rank\":" + std::to_string(rank) + "}");
 }
 
 void Controller::CheckStalls(ResponseList& rl) {

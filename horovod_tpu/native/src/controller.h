@@ -22,6 +22,7 @@
 
 #include "cache.h"
 #include "net.h"
+#include "timeline.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -47,6 +48,10 @@ class Controller {
   void SetFusionThreshold(int64_t bytes) {
     fusion_threshold_.store(bytes);
   }
+
+  // Coordinator-side timeline: per-rank NEGOTIATE ready instants are
+  // recorded as each rank's report arrives (reference timeline.cc:496-541).
+  void set_timeline(Timeline* t) { timeline_ = t; }
   int64_t effective_fusion_threshold() const {
     int64_t dyn = fusion_threshold_.load();
     return dyn > 0 ? dyn : cfg_.fusion_threshold_bytes;
@@ -65,8 +70,11 @@ class Controller {
     bool stall_warned = false;
   };
 
+  void RecordReady(const std::string& name, int32_t rank);
+
   Network* net_;
   ControllerConfig cfg_;
+  Timeline* timeline_ = nullptr;
   std::atomic<int64_t> fusion_threshold_{0};  // 0 -> use cfg_ value
   // Coordinator-only state (persists across rounds).
   ResponseCache cache_;

@@ -27,6 +27,7 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
   ccfg.stall_shutdown_s = stall_shutdown_s;
   ccfg.cache_capacity = cache_capacity;
   controller_ = std::make_unique<Controller>(net_.get(), ccfg);
+  controller_->set_timeline(&timeline_);
   fusion_threshold_ = fusion_threshold;
   cycle_time_ms_ = cycle_time_ms;
   if (!timeline_file.empty() && rank == 0)

@@ -30,13 +30,13 @@ void Timeline::Stop() {
 }
 
 void Timeline::Record(const std::string& name, const char* ph,
-                      const std::string& category) {
+                      const std::string& category, const std::string& args) {
   if (!active_) return;
   int64_t ts = std::chrono::duration_cast<std::chrono::microseconds>(
                    std::chrono::steady_clock::now() - t0_).count();
   {
     std::lock_guard<std::mutex> lk(mu_);
-    queue_.push(Event{name, category, ph[0], ts});
+    queue_.push(Event{name, category, ph[0], ts, args});
   }
   cv_.notify_one();
 }
@@ -52,10 +52,12 @@ void Timeline::WriterLoop() {
       queue_.pop();
       lk.unlock();
       fprintf(file_, "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"%c\","
-              "\"ts\":%lld,\"pid\":%d,\"tid\":0%s}",
+              "\"ts\":%lld,\"pid\":%d,\"tid\":0%s",
               first_event_ ? "" : ",\n", ev.name.c_str(), ev.cat.c_str(),
               ev.ph, static_cast<long long>(ev.ts_us), rank_,
               ev.ph == 'i' ? ",\"s\":\"g\"" : "");
+      if (!ev.args.empty()) fprintf(file_, ",\"args\":%s", ev.args.c_str());
+      fprintf(file_, "}");
       first_event_ = false;
       lk.lock();
     }

@@ -24,9 +24,12 @@ class Timeline {
   void Stop();
   bool active() const { return active_; }
 
-  // ph: "B" begin / "E" end / "i" instant. category groups rows.
+  // ph: "B" begin / "E" end / "i" instant. category groups rows.  args,
+  // when non-empty, is a pre-rendered JSON object body (e.g. {"rank":2})
+  // attached to the event — used for the per-rank NEGOTIATE ready instants
+  // (reference timeline.cc:496-541).
   void Record(const std::string& name, const char* ph,
-              const std::string& category);
+              const std::string& category, const std::string& args = "");
   void MarkCycle();
 
  private:
@@ -36,6 +39,7 @@ class Timeline {
     std::string cat;
     char ph;
     int64_t ts_us;
+    std::string args;
   };
   std::atomic<bool> active_{false};
   bool stop_requested_ = false;

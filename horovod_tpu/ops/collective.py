@@ -290,11 +290,38 @@ def barrier() -> None:
 
 # ---------------------------------------------------------------------------
 # async handle API (eager path; reference torch/mpi_ops.py:843-882)
+#
+# With the native controller attached the op is genuinely in flight after
+# *_async returns (the background runtime negotiates + streams while the
+# caller computes); poll() answers completion without blocking and
+# synchronize() finalizes.  Without a controller (single-process / jax
+# regimes) the op completes synchronously and the handle wraps the result —
+# the same degradation the reference has when size()==1.
 # ---------------------------------------------------------------------------
+
+def _native_async(submit, finish) -> int:
+    """Submit through the native controller, return a managed handle whose
+    wait finalizes (and releases) the native op exactly once.  Both legs go
+    through eager._ctl so transport failures map to HorovodInternalError
+    like the sync path."""
+    from .eager import _ctl as _ctl_call
+    ctl = global_state.controller
+    submitted = _ctl_call(submit, ctl)
+    h = submitted[0]
+    return _handles.handle_manager.allocate(_handles.Handle(
+        poll_fn=lambda: ctl.poll(h),
+        wait_fn=lambda: _ctl_call(finish, ctl, submitted)))
+
 
 def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0) -> int:
+    if global_state.controller is not None and not _is_tracer(tensor):
+        return _native_async(
+            lambda ctl: ctl.allreduce_submit(
+                np.asarray(tensor), op=int(op), prescale=prescale_factor,
+                postscale=postscale_factor, name=name),
+            lambda ctl, s: ctl.allreduce_finish(s[0], s[2]))
     result = allreduce(tensor, op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
@@ -302,17 +329,31 @@ def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
+    if global_state.controller is not None and not _is_tracer(tensor):
+        return _native_async(
+            lambda ctl: ctl.allgather_submit(np.asarray(tensor), name=name),
+            lambda ctl, s: ctl.allgather_finish(s[0], s[1]))
     result = allgather(tensor, name=name)
     return _handles.handle_manager.allocate(_handles.Handle(result=result))
 
 
 def broadcast_async(tensor, root_rank: int = 0,
                     name: Optional[str] = None) -> int:
+    if global_state.controller is not None and not _is_tracer(tensor):
+        return _native_async(
+            lambda ctl: ctl.broadcast_submit(
+                np.asarray(tensor), root_rank=root_rank, name=name),
+            lambda ctl, s: ctl.broadcast_finish(s[0], s[2]))
     result = broadcast(tensor, root_rank=root_rank, name=name)
     return _handles.handle_manager.allocate(_handles.Handle(result=result))
 
 
 def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    if global_state.controller is not None and not _is_tracer(tensor):
+        return _native_async(
+            lambda ctl: ctl.alltoall_submit(
+                np.asarray(tensor), splits=splits, name=name),
+            lambda ctl, s: ctl.alltoall_finish(s[0], s[1]))
     result = alltoall(tensor, splits=splits, name=name)
     return _handles.handle_manager.allocate(_handles.Handle(result=result))
 

@@ -194,7 +194,10 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
         if dups:
             raise ValueError(f"duplicate parameter names: {dups}")
         self._names = {p: n for n, p in named}
-        self._handles: Dict[_torch.nn.Parameter, Tuple[Any, np.ndarray]] = {}
+        # param → (native handle | None, wire-dtype grad tensor, compression
+        # ctx) as stored by _allreduce_grad_async.
+        self._handles: Dict[_torch.nn.Parameter,
+                            Tuple[Any, _torch.Tensor, Any]] = {}
         self._grad_accs = []
         self._pass_counts: Dict[_torch.nn.Parameter, int] = {}
         self._register_hooks()
@@ -237,27 +240,34 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
         return hook
 
     def _allreduce_grad_async(self, p):
+        """Fire the wire-side allreduce for p.grad.  Compression (reference
+        torch/compression.py) converts the payload to its wire dtype (e.g.
+        fp16) before transport; synchronize() decompresses back into
+        p.grad."""
         ctl = global_state.controller
         name = "grad." + self._names[p]
-        grad_np = p.grad.detach().numpy()  # shared memory with the tensor
+        compressed, ctx = self._compression.compress(p.grad)
+        grad_np = compressed.detach().numpy()  # shares memory w/ compressed
         if ctl is None:
-            if self.op == Average and global_state.process_count == 1:
-                return (None, grad_np)
-            out = _C.allreduce(grad_np, op=self.op, name=name)
-            grad_np[...] = np.asarray(out)
-            return (None, grad_np)
+            if not (self.op == Average and global_state.process_count == 1):
+                out = _C.allreduce(grad_np, op=self.op, name=name)
+                grad_np[...] = np.asarray(out)
+            return (None, compressed, ctx)
         scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
         h = ctl.allreduce_async_(grad_np, grad_np, op=int(self.op),
                                  prescale=self._prescale * scale,
                                  postscale=self._postscale, name=name)
-        return (h, grad_np)
+        return (h, compressed, ctx)
 
     def synchronize(self):
         ctl = global_state.controller
-        for p, (h, _buf) in list(self._handles.items()):
+        for p, (h, compressed, ctx) in list(self._handles.items()):
             if h is not None and ctl is not None:
                 from ..ops.eager import _ctl
                 _ctl(ctl.wait, h)
+            if compressed.data_ptr() != p.grad.data_ptr():
+                # Wire dtype differed: restore into the model-dtype grad.
+                p.grad.copy_(self._compression.decompress(compressed, ctx))
         self._handles.clear()
 
     def step(self, closure=None):

@@ -39,3 +39,15 @@ def test_timeline_chrome_trace(tmp_path):
     assert "RING_ALLREDUCE" in cats
     phases = {e["ph"] for e in events}
     assert {"B", "E"} <= phases
+    # Per-rank NEGOTIATE ready instants (reference timeline.cc:496-541):
+    # every rank's report time for every tensor, as instant events with the
+    # reporting rank in args.
+    ready = [e for e in events if e.get("cat") == "NEGOTIATE_READY"]
+    assert ready, "no per-rank negotiate instants recorded"
+    for e in ready:
+        assert e["ph"] == "i"
+        assert "rank" in e.get("args", {})
+    for i in range(3):
+        ranks = {e["args"]["rank"] for e in ready
+                 if e["name"] == f"tl.{i}"}
+        assert ranks == {0, 1}, f"tensor tl.{i} ready ranks {ranks}"
