@@ -18,6 +18,7 @@ Reduce-op codes match the reference C API (operations.cc:911-913).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -101,8 +102,11 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
     return out
 
 
+@functools.lru_cache(maxsize=256)
 def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
-    """Build a stack-reducer callable((P, ...)) -> (...) for the eager path."""
+    """Build a stack-reducer callable((P, ...)) -> (...) for the eager path.
+    Cached so repeat calls return the same callable — the eager device
+    plane's jit cache is keyed on reducer identity."""
     def fn(stack):
         import jax.numpy as jnp
         x = stack

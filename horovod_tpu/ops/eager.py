@@ -35,6 +35,55 @@ def _controller():
     return global_state.controller
 
 
+def _is_device_array(tensor) -> bool:
+    """Concrete jax.Array (device-resident HBM buffer, not a tracer)."""
+    try:
+        import jax
+        return isinstance(tensor, jax.Array) and \
+            not isinstance(tensor, jax.core.Tracer)
+    except Exception:
+        return False
+
+
+def _device_allreduce(tensor, op_fn, ctl):
+    """Device-resident eager allreduce: the TPU analog of the reference's
+    on-device NCCL data plane (nccl_operations.cc:126-184) — the tensor
+    stays in HBM end to end, no host round-trip.
+
+    Regimes:
+    * multi-process JAX (jax.distributed initialized, e.g. by the launcher's
+      chip-partition bootstrap): the per-process shard is assembled into a
+      global array **from its existing device buffer**, reduced by a jitted
+      collective riding ICI/DCN, and returned replicated — still a
+      jax.Array.
+    * single process, world size 1: identity reduce on device.
+    * single jax process inside a larger TCP world: no ICI path exists to
+      the other ranks — returns None so the caller uses the host TCP plane
+      (the CPU/test backend).
+    """
+    import jax
+    comm_size = ctl.size() if ctl is not None else global_state.process_count
+    if jax.process_count() > 1:
+        if jax.process_count() != comm_size:
+            # The JAX world does not span the whole communicator (e.g. one
+            # jax.distributed world per host in a multi-host launch): a
+            # device-plane reduce would silently drop remote ranks.  Host
+            # plane handles it.
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = _cached_process_mesh()
+        me = mesh.devices.flat[jax.process_index()]
+        local = jax.device_put(tensor[None], me)  # D2D at most; never host
+        sharding = NamedSharding(mesh, P("proc"))
+        global_shape = (jax.process_count(),) + tuple(tensor.shape)
+        garr = jax.make_array_from_single_device_arrays(
+            global_shape, sharding, [local])
+        return _jitted_global(op_fn)(garr)
+    if comm_size == 1 and global_state.process_count == 1:
+        return _jitted_local(op_fn)(tensor[None])
+    return None
+
+
 def _ctl(fn, *args, **kwargs):
     """Run a native-controller call, mapping transport/collective failures
     to HorovodInternalError so the elastic retry loop can restore state
@@ -84,10 +133,42 @@ def _replicated_out(mesh):
     return NamedSharding(mesh, P())
 
 
-def _run_global(fn, garr):
+@functools.lru_cache(maxsize=256)
+def _jitted_global(fn):
+    """jit cache keyed on the reducer's identity: eager collectives are the
+    hot path, so every call must reuse the compiled executable (a fresh
+    jax.jit wrapper per call would re-trace each time)."""
     import jax
     mesh = _cached_process_mesh()
-    out = jax.jit(fn, out_shardings=_replicated_out(mesh))(garr)
+    return jax.jit(fn, out_shardings=_replicated_out(mesh))
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_local(fn):
+    import jax
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _take_fn(index: int):
+    return lambda a: a[index]
+
+
+@functools.lru_cache(maxsize=64)
+def _take_col_fn(index: int):
+    return lambda a: a[:, index]
+
+
+def _identity(a):
+    return a
+
+
+def _sum0(a):
+    return a.sum(0)
+
+
+def _run_global(fn, garr):
+    out = _jitted_global(fn)(garr)
     return np.asarray(out.addressable_shards[0].data)
 
 
@@ -98,6 +179,12 @@ def allreduce(tensor, op_fn, name: Optional[str] = None,
     the ReduceOp code for the native controller path (which does not take
     callables across the C boundary)."""
     ctl = _controller()
+    if _is_device_array(tensor):
+        # TPU-resident tensors take the on-device ICI plane when one exists
+        # (never copies to host); None → no device path to the other ranks.
+        out = _device_allreduce(tensor, op_fn, ctl)
+        if out is not None:
+            return out
     if ctl is not None:
         return _ctl(ctl.allreduce, _np(tensor),
                     op=1 if op_code is None else int(op_code),
@@ -120,13 +207,12 @@ def allgather(tensor, name: Optional[str] = None):
     # gather payloads, and slice (reference: controller.cc:576-648 does the
     # same displacement math on the coordinator).
     x = _np(tensor)
-    sizes = allreduce(
-        _one_hot_sizes(x.shape[0]), op_fn=lambda s: s.sum(0))
+    sizes = allreduce(_one_hot_sizes(x.shape[0]), op_fn=_sum0)
     max_rows = int(sizes.max())
     padded = np.zeros((max_rows,) + x.shape[1:], dtype=x.dtype)
     padded[: x.shape[0]] = x
     garr = _global_over_processes(padded)
-    gathered = _run_global(lambda a: a, garr)  # (P, max_rows, ...)
+    gathered = _run_global(_identity, garr)  # (P, max_rows, ...)
     parts = [gathered[p, : int(sizes[p])] for p in range(len(sizes))]
     return np.concatenate(parts, axis=0)
 
@@ -145,7 +231,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     if global_state.process_count == 1:
         return _np(tensor)
     garr = _global_over_processes(_np(tensor))
-    return _run_global(lambda a: a[root_rank], garr)
+    return _run_global(_take_fn(root_rank), garr)
 
 
 def alltoall(tensor, splits: Optional[Sequence[int]] = None,
@@ -176,7 +262,7 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
         segs[dest, : seg.shape[0]] = seg
     garr = _global_over_processes(segs)  # (P_src, P_dest, max_seg, ...)
     me = global_state.process_rank
-    all_segs = _run_global(lambda a: a[:, me], garr)  # (P_src, max_seg, ...)
+    all_segs = _run_global(_take_col_fn(me), garr)  # (P_src, max_seg, ...)
     recv_splits = split_table[:, me]
     parts = [all_segs[src, : int(recv_splits[src])] for src in range(p)]
     return (np.concatenate(parts, axis=0),
@@ -203,7 +289,7 @@ def barrier() -> None:
         return
     if global_state.process_count == 1:
         return
-    allreduce(np.zeros((1,), dtype=np.float32), op_fn=lambda s: s.sum(0))
+    allreduce(np.zeros((1,), dtype=np.float32), op_fn=_sum0)
 
 
 def join() -> int:

@@ -45,11 +45,34 @@ def apply_platform() -> None:
         pass
 
 
+def apply_jax_distributed() -> None:
+    """Join the launcher-declared JAX world (chip-partitioned workers):
+    compiled multi-process programs and the eager on-device ICI plane both
+    need jax.distributed before backend init."""
+    addr = os.environ.get("HVD_TPU_JAX_COORD_ADDR")
+    if not addr:
+        return
+    try:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ["HVD_TPU_JAX_NUM_PROCS"]),
+            process_id=int(os.environ["HVD_TPU_JAX_PROC_ID"]))
+    except Exception as e:
+        # A launcher-declared world that fails to form must be fatal: a
+        # worker silently falling back to single-process would reduce over
+        # the wrong world while its peers hang waiting for it.
+        print(f"[hvd_tpu bootstrap] jax.distributed.initialize failed: {e}",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--":
         argv = argv[1:]
     apply_platform()
+    apply_jax_distributed()
     if not argv:
         return 0
     if argv[0] == "-m":

@@ -107,9 +107,13 @@ def _split_grid(grid: Tuple[int, int, int],
 
 
 def partition_env(local_rank: int, local_size: int, chips: int,
-                  hostname: str = "localhost") -> Optional[Dict[str, str]]:
+                  hostname: str = "localhost",
+                  jax_coord_port: int = 0) -> Optional[Dict[str, str]]:
     """The per-slot libtpu env splitting ``chips`` among ``local_size``
-    processes on one host.  None when no clean split exists."""
+    processes on one host.  None when no clean split exists.
+    ``jax_coord_port``: per-launch port for the jax.distributed coordinator
+    (0 falls back to a fixed default — collides across concurrent launches,
+    so plans allocate a fresh one)."""
     if chips <= 0 or chips % local_size:
         return None
     grid = _HOST_TOPOLOGY.get(chips)
@@ -131,7 +135,23 @@ def partition_env(local_rank: int, local_size: int, chips: int,
         "TPU_PROCESS_ADDRESSES": addresses,
         "TPU_PROCESS_PORT": str(_BASE_TPU_PORT + local_rank),
         "CLOUD_TPU_TASK_ID": str(local_rank),
+        # jax.distributed bootstrap (applied by runner/bootstrap.py before
+        # backend init): partitioned workers form one JAX world so compiled
+        # multi-process programs AND the eager on-device ICI plane work.
+        "HVD_TPU_JAX_COORD_ADDR":
+            f"{hostname}:{jax_coord_port or (_BASE_TPU_PORT - 1)}",
+        "HVD_TPU_JAX_NUM_PROCS": str(local_size),
+        "HVD_TPU_JAX_PROC_ID": str(local_rank),
     }
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 @dataclasses.dataclass
@@ -139,11 +159,20 @@ class HostPlatformPlan:
     """Resolved platform decision for one host's workers."""
     mode: str                      # "inherit" | "partition" | "cpu"
     chips: int = 0
+    # Per-launch jax.distributed coordinator port (partition mode only):
+    # allocated fresh so concurrent launches on a host don't join each
+    # other's worlds.
+    jax_coord_port: int = 0
+
+    def __post_init__(self):
+        if self.mode == "partition" and not self.jax_coord_port:
+            self.jax_coord_port = _free_port()
 
     def slot_env(self, local_rank: int, local_size: int,
                  hostname: str = "localhost") -> Dict[str, str]:
         if self.mode == "partition":
-            env = partition_env(local_rank, local_size, self.chips, hostname)
+            env = partition_env(local_rank, local_size, self.chips, hostname,
+                                jax_coord_port=self.jax_coord_port)
             if env is not None:
                 return env
             # Split no longer valid (topology shifted between planning and
@@ -182,9 +211,11 @@ def plan_host_platform(local_size: int, policy: str = "auto",
 
 
 def needs_bootstrap(env: Dict[str, str]) -> bool:
-    """True when the slot env carries a platform override that must be
-    applied in-process before the user's ``import jax``."""
-    return "HVD_TPU_WORKER_PLATFORM" in env
+    """True when the slot env carries a platform override or a JAX world
+    declaration that must be applied in-process before the user's
+    ``import jax``."""
+    return "HVD_TPU_WORKER_PLATFORM" in env or \
+        "HVD_TPU_JAX_COORD_ADDR" in env
 
 
 # Interpreter options that consume a following value and so must travel

@@ -1,0 +1,100 @@
+"""Eager on-device data plane: TPU/HBM-resident arrays must never round-trip
+the host (the reference's on-device NCCL contract, nccl_operations.cc:126-184
+— here the ICI plane via a jitted collective over the process mesh), with the
+host TCP plane kept as the CPU/test backend."""
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_device_array_stays_on_device(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager
+
+    hvd.init()
+
+    def boom(_):
+        raise AssertionError("device-resident tensor was copied to host")
+
+    monkeypatch.setattr(eager, "_np", boom)
+    x = jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    # Average path too (scales applied on device).
+    out = hvd.allreduce(x, op=hvd.Average)
+    assert isinstance(out, jax.Array)
+
+
+def test_numpy_input_uses_host_plane():
+    import horovod_tpu as hvd
+    hvd.init()
+    x = np.ones((4,), dtype=np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_allclose(out, 1.0)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _dist_worker(rank, size, coord_port, q):
+    sys.path.insert(0, REPO)
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_processes=size, process_id=rank)
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import eager
+
+        hvd.init()
+        # Tripwire: the device plane must not touch numpy conversion.
+        eager._np = lambda _t: (_ for _ in ()).throw(
+            AssertionError("host copy on device plane"))
+        x = jnp.full((8,), float(rank + 1), dtype=jnp.float32)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        assert isinstance(out, jax.Array)
+        got = float(np.asarray(out)[0])
+        q.put((rank, "ok", got))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "error", repr(e)))
+
+
+@pytest.mark.timeout(240)
+def test_multiprocess_jax_distributed_device_plane():
+    """Two jax.distributed processes (CPU backend standing in for two TPU
+    hosts): eager allreduce of device arrays rides the in-graph collective,
+    no host numpy conversion."""
+    size = 2
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_dist_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=180)
+        assert status == "ok", f"rank {rank}: {payload}"
+        results[rank] = payload
+    for p in procs:
+        p.join(timeout=30)
+    assert all(v == 3.0 for v in results.values()), results
