@@ -18,3 +18,4 @@ from ..tensorflow import (init, shutdown, rank, size, local_rank,
                           broadcast_variables, DistributedOptimizer,
                           Average, Sum, Adasum, Compression)
 from . import callbacks  # noqa: F401  (re-export module)
+from . import elastic  # noqa: F401  (KerasState + commit callbacks)

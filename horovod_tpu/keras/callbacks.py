@@ -112,3 +112,36 @@ class LearningRateScheduleCallback(_tf.keras.callbacks.Callback):
             _tf.keras.backend.set_value(opt.learning_rate, lr)
         if self.verbose:
             print(f"\nEpoch {epoch}: lr = {lr:.6f}")
+
+
+class CommitStateCallback(_tf.keras.callbacks.Callback):
+    """Commit the elastic state every ``batches_per_commit`` batches
+    (reference _keras/elastic.py:17-45): a worker failure rolls training
+    back at most that many batches."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = max(int(batches_per_commit), 1)
+        self._batches = 0
+
+    def on_batch_end(self, batch, logs=None):
+        self._batches += 1
+        if self._batches % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateEpochStateCallback(_tf.keras.callbacks.Callback):
+    """Track the current epoch in the elastic state (reference
+    _keras/elastic.py:66-80) so a restarted worker resumes from the right
+    epoch instead of epoch 0."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.epoch = epoch + 1

@@ -229,7 +229,7 @@ class NativeController:
         caller must keep both buffers alive until the matching
         ``allreduce_finish`` (true-async contract: the background runtime
         streams from/to them while the op is in flight)."""
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")  # keeps 0-d shape
         out = np.empty_like(arr)
         ndim, shape = _shape_arg(arr)
         h = self._lib.hvd_native_allreduce(
@@ -263,7 +263,7 @@ class NativeController:
                 self._auto_name("grouped", None).decode())
         outs, handles = [], []
         for i, arr in enumerate(arrs):
-            arr = np.ascontiguousarray(arr)
+            arr = np.asarray(arr, order="C")  # keeps 0-d shape
             out = np.empty_like(arr)
             outs.append(out)
             handles.append(self.allreduce_async_(
@@ -276,7 +276,7 @@ class NativeController:
     def allgather_submit(self, arr: np.ndarray,
                          name: Optional[str] = None
                          ) -> Tuple[int, np.ndarray]:
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")  # keeps 0-d shape
         ndim, shape = _shape_arg(arr)
         h = self._lib.hvd_native_allgather(
             self._auto_name("allgather", name),
@@ -307,7 +307,7 @@ class NativeController:
     def broadcast_submit(self, arr: np.ndarray, root_rank: int = 0,
                          name: Optional[str] = None
                          ) -> Tuple[int, np.ndarray, np.ndarray]:
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")  # keeps 0-d shape
         out = arr.copy()
         ndim, shape = _shape_arg(arr)
         h = self._lib.hvd_native_broadcast(
@@ -334,7 +334,7 @@ class NativeController:
                         splits: Optional[Sequence[int]] = None,
                         name: Optional[str] = None
                         ) -> Tuple[int, np.ndarray]:
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr, order="C")  # keeps 0-d shape
         size = self.size()
         if splits is None:
             if arr.shape[0] % size != 0:

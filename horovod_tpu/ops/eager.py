@@ -60,7 +60,19 @@ def _device_allreduce(tensor, op_fn, ctl):
     * single jax process inside a larger TCP world: no ICI path exists to
       the other ranks — returns None so the caller uses the host TCP plane
       (the CPU/test backend).
+
+    CONTRACT: the multi-process device plane is an SPMD collective — every
+    process must issue device-plane ops in the same order with matching
+    shapes and input *kinds* (all jax.Array or all host arrays for a given
+    logical tensor); there is no name-based reordering like the controller
+    plane.  That matches normal SPMD training code.  Set
+    ``HVD_TPU_EAGER_DEVICE_PLANE=0`` to force every eager op through the
+    controller's named-tensor negotiation (host plane) when per-rank code
+    paths genuinely diverge.
     """
+    import os
+    if os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE", "1") == "0":
+        return None
     import jax
     comm_size = ctl.size() if ctl is not None else global_state.process_count
     if jax.process_count() > 1:

@@ -146,6 +146,12 @@ def partition_env(local_rank: int, local_size: int, chips: int,
 
 
 def _free_port() -> int:
+    """Probe a free port on the launcher.  Best effort for the worker-host
+    coordinator bind: on localhost launches (the partition-mode norm) it is
+    authoritative minus a close→bind race; for ssh-remote hosts an
+    ephemeral port is merely unlikely to be taken there.  A losing worker
+    fails fast in bootstrap.apply_jax_distributed rather than joining the
+    wrong world."""
     import socket
     s = socket.socket()
     s.bind(("", 0))

@@ -48,6 +48,12 @@ def _np(t) -> np.ndarray:
     return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
 
 
+def _to_tf(out):
+    """numpy → tf without np.ascontiguousarray, which promotes 0-d arrays
+    to shape (1,) and breaks scalar-variable assigns."""
+    return _tf.convert_to_tensor(np.asarray(out))
+
+
 def _is_symbolic(t) -> bool:
     """True inside a traced tf.function, where .numpy() is unavailable."""
     return isinstance(t, _tf.Tensor) and not hasattr(t, "numpy")
@@ -88,8 +94,7 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
     out = _C.allreduce(_np(t), op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
-    return comp.decompress(
-        _tf.convert_to_tensor(np.asarray(out)), ctx)
+    return comp.decompress(_to_tf(out), ctx)
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -98,8 +103,7 @@ def allgather(tensor, name: Optional[str] = None):
             lambda x: np.ascontiguousarray(_C.allgather(x, name=name)),
             tensor, out_shape=_tf.TensorShape(
                 [None] + list(tensor.shape)[1:]))
-    return _tf.convert_to_tensor(
-        np.ascontiguousarray(_C.allgather(_np(tensor), name=name)))
+    return _to_tf(_C.allgather(_np(tensor), name=name))
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
@@ -107,14 +111,12 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
         return _graph_bridge(
             lambda x: np.ascontiguousarray(
                 _C.broadcast(x, root_rank=root_rank, name=name)), tensor)
-    return _tf.convert_to_tensor(np.ascontiguousarray(
-        _C.broadcast(_np(tensor), root_rank=root_rank, name=name)))
+    return _to_tf(_C.broadcast(_np(tensor), root_rank=root_rank, name=name))
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
     out, recv_splits = _C.alltoall(_np(tensor), splits=splits, name=name)
-    return (_tf.convert_to_tensor(np.asarray(out)),
-            _tf.convert_to_tensor(np.asarray(recv_splits)))
+    return _to_tf(out), _to_tf(recv_splits)
 
 
 def join() -> int:
@@ -227,6 +229,31 @@ class _LocalGradientAggregationHelper:
             lambda: _tf.constant(False))
 
 
+def _make_adasum_delta_optimizer(optimizer, compression):
+    """Adasum delta model (reference _DistributedAdasumOptimizer,
+    tensorflow/__init__.py:502-596): stateful optimizers (momentum, Adam)
+    produce *update vectors* that are not plain gradients, so Adasum must
+    combine the per-rank weight deltas, not the raw grads.  Each
+    apply_gradients: snapshot weights → local optimizer step → delta =
+    new - start → Adasum-allreduce deltas → weights = start + combined."""
+
+    class _AdasumWrapped(optimizer.__class__):
+        def apply_gradients(self_, grads_and_vars, *args, **kwargs):
+            gv = [(g, v) for g, v in grads_and_vars if g is not None]
+            starts = [_tf.identity(v) for _g, v in gv]
+            result = super(_AdasumWrapped, self_).apply_gradients(
+                gv, *args, **kwargs)
+            comp = compression or Compression.none
+            for i, ((_g, v), w0) in enumerate(zip(gv, starts)):
+                delta = v - w0
+                d, ctx = comp.compress(delta)
+                d = allreduce(d, op=Adasum, name=f"adasum.delta.{i}")
+                v.assign(w0 + comp.decompress(d, ctx))
+            return result
+
+    return _AdasumWrapped.from_config(optimizer.get_config())
+
+
 def DistributedOptimizer(optimizer, op: int = Average, compression=None,
                          backward_passes_per_step: int = 1,
                          name: Optional[str] = None):
@@ -234,7 +261,14 @@ def DistributedOptimizer(optimizer, op: int = Average, compression=None,
     _DistributedOptimizer analog for TF2 eager).  With
     ``backward_passes_per_step`` > 1, gradients accumulate locally and
     communication + weight update happen every Nth call (reference
-    gradient_aggregation.py)."""
+    gradient_aggregation.py).  ``op=Adasum`` switches to the delta model
+    (see _make_adasum_delta_optimizer)."""
+    if op == Adasum:
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "Adasum does not compose with backward_passes_per_step > 1 "
+                "(reference restriction)")
+        return _make_adasum_delta_optimizer(optimizer, compression)
 
     class _Wrapped(optimizer.__class__):
         _hvd_agg = (_LocalGradientAggregationHelper(backward_passes_per_step)
@@ -274,3 +308,6 @@ class SyncBatchNormalization(_tf.keras.layers.BatchNormalization):
                                 name=self.name + ".meansq")
             var = mean_sq - _tf.square(mean)
         return mean, var
+
+
+from . import elastic  # noqa: E402,F401  (hvd.elastic.TensorFlowState etc.)
