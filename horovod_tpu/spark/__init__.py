@@ -1,62 +1,176 @@
 """Spark integration — run a training function on Spark executors as ranks.
 
-Capability parity with the reference horovod.spark.run
-(spark/runner.py:47-156): one barrier-mode task per executor registers its
-hostname with the driver, ranks are assigned host-major, the launcher env is
-injected, and the user function runs inside each task.  The Estimator API
-(store.py ``Store``/``LocalStore``, estimator.py ``KerasEstimator``/
-``TorchEstimator``) fits DataFrames via Parquet materialization into the
-store, mirroring the reference's spark/common/store.py + spark/keras +
-spark/torch estimators.
+Capability parity with the reference horovod.spark (spark/runner.py):
+
+* ``run(fn, ...)`` (reference runner.py:47-156) — one barrier-mode task per
+  executor registers its hostname, ranks are assigned host-major, the
+  launcher env is injected, and ``fn`` runs inside each task.
+* ``run_elastic(fn, ...)`` (reference runner.py:306) — elastic variant:
+  executor hosts feed the elastic driver, workers are (re)spawned across
+  rendezvous rounds, and per-rank results are collected from the round
+  that completes.
+* Estimator API (store.py ``Store``/``LocalStore``, estimator.py
+  ``KerasEstimator``/``TorchEstimator``/``LightningEstimator``).
 
 ``pyspark`` is an optional dependency; a clear error is raised without it.
 """
 
 from __future__ import annotations
 
+import os
+import pickle
 import socket
-from typing import Any, Callable, List, Optional
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional, Tuple
 
 from .store import Store, LocalStore                      # noqa: F401
 from .estimator import (KerasEstimator, KerasModel,       # noqa: F401
-                        TorchEstimator, TorchModel)
+                        TorchEstimator, TorchModel,
+                        LightningEstimator, LightningModel)
+from ..runner.hosts import (HostInfo, SlotInfo, get_host_assignments,
+                            slot_env)
+
+
+def _require_pyspark():
+    try:
+        from pyspark import BarrierTaskContext
+        from pyspark.sql import SparkSession
+        return BarrierTaskContext, SparkSession
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark; install pyspark or use "
+            "the hvdrun launcher instead") from e
+
+
+def _resolve_slot(infos: List[str], pid: int) -> Tuple[SlotInfo, str]:
+    """Map this barrier task to its slot from the gathered hostname list.
+
+    ``infos[i]`` is partition i's hostname (BarrierTaskContext.allGather
+    preserves partition order).  Slots are host-major over sorted
+    hostnames, but partition→host placement is arbitrary — so the task's
+    slot is found by its OWN hostname and its index among same-host
+    partitions, never by raw partition id (which mis-assigns whenever
+    partition order differs from sorted-host order; the controller then
+    binds on the wrong machine and the job cannot form).
+
+    Returns (slot, controller_host) where controller_host is rank 0's
+    actual hostname.
+    """
+    hostname = infos[pid]
+    counts: dict = {}
+    for h in infos:
+        counts[h] = counts.get(h, 0) + 1
+    hosts = [HostInfo(h, c) for h, c in sorted(counts.items())]
+    slots = get_host_assignments(hosts, len(infos))
+    local_idx = sum(1 for h in infos[:pid] if h == hostname)
+    my_slot = next(s for s in slots
+                   if s.hostname == hostname and s.local_rank == local_idx)
+    return my_slot, slots[0].hostname
 
 
 def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
         controller_port: int = 29100) -> List[Any]:
-    try:
-        from pyspark import BarrierTaskContext
-        from pyspark.sql import SparkSession
-    except ImportError as e:
-        raise ImportError(
-            "horovod_tpu.spark.run requires pyspark; install pyspark or "
-            "use the hvdrun launcher instead") from e
-
+    BarrierTaskContext, SparkSession = _require_pyspark()
     kwargs = kwargs or {}
     spark = SparkSession.builder.getOrCreate()
     sc = spark.sparkContext
     num_proc = num_proc or int(sc.defaultParallelism)
 
-    from ..runner.hosts import HostInfo, get_host_assignments, slot_env
-
     def _task(_):
         ctx = BarrierTaskContext.get()
-        hostname = socket.gethostname()
-        # Barrier all-gather of hostnames establishes the host->slots map
-        # (reference: driver/task registration, spark/runner.py:47-156).
-        infos = ctx.allGather(hostname)
-        counts = {}
-        for h in infos:
-            counts[h] = counts.get(h, 0) + 1
-        hosts = [HostInfo(h, c) for h, c in sorted(counts.items())]
-        slots = get_host_assignments(hosts, len(infos))
-        # This task's rank: position among same-host partitions.
-        pid = ctx.partitionId()
-        my_slot = slots[pid]
-        controller_addr = f"{slots[0].hostname}:{controller_port}"
-        import os
+        infos = list(ctx.allGather(socket.gethostname()))
+        my_slot, controller_host = _resolve_slot(infos, ctx.partitionId())
+        controller_addr = f"{controller_host}:{controller_port}"
         os.environ.update(slot_env(my_slot, controller_addr))
-        return [fn(*args, **kwargs)]
+        return [(my_slot.rank, fn(*args, **kwargs))]
 
     rdd = sc.parallelize(range(num_proc), num_proc).barrier()
-    return rdd.mapPartitions(_task).collect()
+    results = rdd.mapPartitions(_task).collect()
+    return [value for _rank, value in sorted(results)]
+
+
+def _discover_executor_hosts(num_proc: int) -> List[HostInfo]:
+    """Barrier-mode job gathering executor hostnames → HostInfo list (the
+    reference's driver/task registration, spark/runner.py:47+).  Barrier
+    mode forces one concurrent task per slot, so the host→slot counts
+    reflect real executor capacity — a plain job could run every task on
+    one fast executor and oversubscribe it."""
+    BarrierTaskContext, SparkSession = _require_pyspark()
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+
+    def _task(_):
+        BarrierTaskContext.get()
+        return [socket.gethostname()]
+
+    names = sc.parallelize(range(num_proc), num_proc).barrier() \
+        .mapPartitions(_task).collect()
+    counts: dict = {}
+    for h in names:
+        counts[h] = counts.get(h, 0) + 1
+    return [HostInfo(h, c) for h, c in sorted(counts.items())]
+
+
+def run_elastic(fn: Callable, args=(), kwargs=None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
+                controller_base_port: int = 29400,
+                work_dir: Optional[str] = None,
+                hosts: Optional[List[HostInfo]] = None,
+                verbose: bool = False) -> List[Any]:
+    """Elastic Spark run (reference spark/runner.py:306 run_elastic).
+
+    ``fn`` must be importable/picklable (module-level) and should drive its
+    training with ``hvd.elastic.run(state)`` so worker failures restore
+    committed state.  Completed ranks' return values are collected (rank
+    order).  Worker payload and results travel through ``work_dir`` — a
+    path visible to every executor host (defaults to a local temp dir,
+    which is correct for local-mode Spark; pass a shared-filesystem path,
+    e.g. a Store prefix, on real clusters).
+
+    ``hosts`` overrides executor discovery (test seam / static clusters).
+    """
+    import cloudpickle
+
+    from ..runner.elastic_driver import ElasticDriver, FixedHosts
+
+    kwargs = kwargs or {}
+    num_proc = num_proc or (sum(h.slots for h in hosts) if hosts else 1)
+    if hosts is None:
+        hosts = _discover_executor_hosts(num_proc)
+    min_np = min_np or num_proc
+
+    own_tmp = work_dir is None
+    work_dir = work_dir or tempfile.mkdtemp(prefix="hvd_spark_elastic_")
+    payload_path = os.path.join(work_dir, "payload.pkl")
+    results_dir = os.path.join(work_dir, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    with open(payload_path, "wb") as f:
+        cloudpickle.dump({"fn": fn, "args": tuple(args),
+                          "kwargs": dict(kwargs)}, f)
+
+    command = [sys.executable, "-m", "horovod_tpu.spark.elastic_exec",
+               payload_path, results_dir]
+    driver = ElasticDriver(
+        FixedHosts(hosts), command, min_np=min_np, max_np=max_np,
+        controller_base_port=controller_base_port, verbose=verbose)
+    rc = driver.run()
+    if rc != 0:
+        raise RuntimeError(f"elastic spark job failed (exit {rc})")
+
+    results = []
+    # Only finalized results: a worker killed mid-write (the failure mode
+    # elastic exists for) leaves an orphaned .rank_N.tmp behind.
+    for name in sorted(os.listdir(results_dir)):
+        if not (name.startswith("rank_") and name.endswith(".pkl")):
+            continue
+        with open(os.path.join(results_dir, name), "rb") as f:
+            results.append(pickle.load(f))
+    results.sort(key=lambda rv: rv[0])
+    out = [v for _r, v in results]
+    if own_tmp:
+        import shutil
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return out

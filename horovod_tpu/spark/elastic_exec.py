@@ -1,0 +1,33 @@
+"""Elastic Spark worker main (spawned by spark.run_elastic through the
+elastic driver): loads the pickled user function, runs it as this rank, and
+drops the (rank, result) pickle into the shared results directory."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main(payload_path: str, results_dir: str) -> int:
+    import cloudpickle
+
+    with open(payload_path, "rb") as f:
+        payload = cloudpickle.load(f)
+
+    result = payload["fn"](*payload["args"], **payload["kwargs"])
+
+    # global_state keeps the last assignment's topology across the user
+    # fn's own shutdown() (reset() clears only mesh/controller/initialized)
+    # — hvd.rank() itself refuses to answer post-shutdown.
+    from horovod_tpu.core.state import global_state
+    rank = global_state.rank
+    tmp = os.path.join(results_dir, f".rank_{rank}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump((rank, result), f)
+    os.replace(tmp, os.path.join(results_dir, f"rank_{rank}.pkl"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

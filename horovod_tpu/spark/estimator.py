@@ -50,10 +50,23 @@ class _EstimatorParams:
 
     def _materialize(self, df):
         """DataFrame → (train_path, val_path|None) parquet in the store
-        (reference util.prepare_data)."""
+        (reference util.prepare_data).  Spark DataFrames split and write
+        executor-side (randomSplit + distributed parquet write) — nothing
+        funnels through driver memory."""
         store = self.store
-        if hasattr(df, "toPandas"):
-            df = df.toPandas()
+        train_path = store.get_train_data_path(self.run_id)
+        val_path = None
+        if hasattr(df, "toPandas"):  # Spark DataFrame
+            if self.validation:
+                v = float(self.validation)
+                val_df, train_df = df.randomSplit([v, 1.0 - v], seed=17)
+            else:
+                val_df, train_df = None, df
+            store.write_dataframe(train_df, train_path)
+            if val_df is not None:
+                val_path = store.get_val_data_path(self.run_id)
+                store.write_dataframe(val_df, val_path)
+            return train_path, val_path
         n = len(df)
         if self.validation:
             # Shuffle before splitting: ordered input (time- or
@@ -64,9 +77,7 @@ class _EstimatorParams:
             val_df, train_df = df.iloc[:n_val], df.iloc[n_val:]
         else:
             val_df, train_df = None, df
-        train_path = store.get_train_data_path(self.run_id)
         store.write_dataframe(train_df, train_path)
-        val_path = None
         if val_df is not None and len(val_df):
             val_path = store.get_val_data_path(self.run_id)
             store.write_dataframe(val_df, val_path)
@@ -237,28 +248,24 @@ class TorchEstimator(_EstimatorParams):
 
 
 def _torch_train_loop(spec) -> None:
-    """One rank's training loop: shard batches by rank, allreduce grads
-    through DistributedOptimizer, sync initial params from rank 0."""
+    """One rank's training loop: parquet chunks streamed from the store
+    (never the whole dataset in memory — the reference's Petastorm role),
+    rows sharded by rank within each chunk, grads allreduced through
+    DistributedOptimizer, initial params synced from rank 0."""
     import torch
     import horovod_tpu.torch as hvd_torch
-    from .store import Store
     hvd_torch.init()
     model = spec["model"]
     store = spec["store"]  # user Store subclass travels to workers intact
-    df = store.read_dataframe(spec["train_path"])
-    x, y = dataframe_to_arrays(df, spec["feature_cols"],
-                               spec["label_cols"])
     # Shard by the eager communicator (participating processes), not
     # hvd.size() — chip-level size can exceed the process count on a
-    # multi-device host, which would silently drop data.  Truncate to the
-    # common per-rank length: ragged shards would desynchronize the
-    # blocking per-gradient allreduces (mixed-step averages, then a hang
-    # when one rank runs an extra batch).
+    # multi-device host, which would silently drop data.  Per-rank rows are
+    # truncated to the common length within each chunk: ragged shards would
+    # desynchronize the blocking per-gradient allreduces (every rank reads
+    # the same files, so the chunk schedule is identical everywhere).
     from ..ops.collective import communicator_size
     size = communicator_size()
     rank = hvd_torch.rank() % size if size > 1 else 0
-    n_local = len(x) // size if size > 1 else len(x)
-    x, y = x[rank::size][:n_local], y[rank::size][:n_local]
 
     base_opt = (spec["optimizer_fn"](model.parameters())
                 if spec["optimizer_fn"]
@@ -268,17 +275,25 @@ def _torch_train_loop(spec) -> None:
     hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
     loss_fn = spec["loss_fn"] or torch.nn.MSELoss()
 
-    xt, yt = torch.from_numpy(x), torch.from_numpy(y)
-    n = len(xt)
     g = torch.Generator().manual_seed(13)
+    chunk_rows = int(spec.get("chunk_rows") or 65536)
     for _ in range(spec["epochs"]):
-        perm = torch.randperm(n, generator=g)
-        for s in range(0, n, spec["batch_size"]):
-            idx = perm[s:s + spec["batch_size"]]
-            opt.zero_grad()
-            loss = loss_fn(model(xt[idx]), yt[idx])
-            loss.backward()
-            opt.step()
+        for x, y in store.iter_array_batches(
+                spec["train_path"], spec["feature_cols"],
+                spec["label_cols"], chunk_rows=chunk_rows):
+            n_local = len(x) // size if size > 1 else len(x)
+            if size > 1:
+                x, y = x[rank::size][:n_local], y[rank::size][:n_local]
+            if n_local == 0:
+                continue
+            xt, yt = torch.from_numpy(x), torch.from_numpy(y)
+            perm = torch.randperm(n_local, generator=g)
+            for s in range(0, n_local, spec["batch_size"]):
+                idx = perm[s:s + spec["batch_size"]]
+                opt.zero_grad()
+                loss = loss_fn(model(xt[idx]), yt[idx])
+                loss.backward()
+                opt.step()
 
 
 def _torch_fit_worker(spec):
@@ -297,6 +312,128 @@ def _torch_fit_worker(spec):
 
 class TorchModel(_Model):
     """Transformer returned by TorchEstimator.fit."""
+
+    def _predict(self, x):
+        import torch
+        with torch.no_grad():
+            return self.model(torch.from_numpy(x)).numpy()
+
+
+class LightningEstimator(_EstimatorParams):
+    """Fit a LightningModule-style model on a DataFrame (reference
+    spark/lightning/estimator.py LightningEstimator).
+
+    Duck-typed against the LightningModule protocol —
+    ``configure_optimizers()`` and ``training_step(batch, batch_idx)`` on a
+    torch ``nn.Module`` — so it works with real ``pytorch_lightning``
+    modules *and* without the lightning package installed (TPU VMs rarely
+    ship it).  The optimizer the module configures is wrapped with
+    hvd.DistributedOptimizer; batches stream from the store in chunks."""
+
+    def __init__(self, model=None, **kw):
+        super().__init__(**kw)
+        if model is None:
+            raise ValueError("LightningEstimator requires model= (a "
+                             "LightningModule or any nn.Module with "
+                             "configure_optimizers + training_step)")
+        for required in ("configure_optimizers", "training_step"):
+            if not callable(getattr(model, required, None)):
+                raise TypeError(f"model lacks {required}(); pass a "
+                                "LightningModule-style module")
+        self.model = model
+
+    def fit(self, df) -> "LightningModel":
+        import torch
+        train_path, _val_path = self._materialize(df)
+        spec = {
+            "model": self.model, "epochs": self.epochs,
+            "batch_size": self.batch_size, "store": self.store,
+            "train_path": train_path,
+            "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols,
+        }
+        if self.num_proc and self.num_proc > 1:
+            from ..runner import run as _run
+            states = _run(_lightning_fit_worker, args=(spec,),
+                          np=int(self.num_proc))
+            state = next(s for s in states if s is not None)
+            self.model.load_state_dict(
+                torch.load(io.BytesIO(state), weights_only=True))
+        else:
+            _lightning_train_loop(spec)
+        buf = io.BytesIO()
+        torch.save(self.model.state_dict(), buf)
+        self.store.save_checkpoint(self.run_id, buf.getvalue())
+        return LightningModel(
+            model=self.model, feature_cols=self.feature_cols,
+            label_cols=self.label_cols, store=self.store,
+            run_id=self.run_id)
+
+
+def _first_optimizer(configured):
+    """configure_optimizers may return an optimizer, a list, or the
+    lightning ([optimizers], [schedulers]) pair."""
+    if isinstance(configured, (list, tuple)):
+        first = configured[0]
+        if isinstance(first, (list, tuple)):
+            first = first[0]
+        return first
+    return configured
+
+
+def _lightning_train_loop(spec) -> None:
+    import horovod_tpu.torch as hvd_torch
+    hvd_torch.init()
+    model = spec["model"]
+    store = spec["store"]
+    from ..ops.collective import communicator_size
+    size = communicator_size()
+    rank = hvd_torch.rank() % size if size > 1 else 0
+
+    import torch
+    base_opt = _first_optimizer(model.configure_optimizers())
+    opt = hvd_torch.DistributedOptimizer(
+        base_opt, named_parameters=model.named_parameters())
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    g = torch.Generator().manual_seed(13)
+    batch_idx = 0
+    for _ in range(spec["epochs"]):
+        for x, y in store.iter_array_batches(
+                spec["train_path"], spec["feature_cols"],
+                spec["label_cols"]):
+            n_local = len(x) // size if size > 1 else len(x)
+            if size > 1:
+                x, y = x[rank::size][:n_local], y[rank::size][:n_local]
+            if n_local == 0:
+                continue
+            xt, yt = torch.from_numpy(x), torch.from_numpy(y)
+            perm = torch.randperm(n_local, generator=g)
+            for s in range(0, n_local, spec["batch_size"]):
+                idx = perm[s:s + spec["batch_size"]]
+                opt.zero_grad()
+                loss = model.training_step((xt[idx], yt[idx]), batch_idx)
+                if isinstance(loss, dict):  # lightning allows {"loss": t}
+                    loss = loss["loss"]
+                loss.backward()
+                opt.step()
+                batch_idx += 1
+
+
+def _lightning_fit_worker(spec):
+    import io as _io
+    import torch
+    import horovod_tpu.torch as hvd_torch
+    _lightning_train_loop(spec)
+    if hvd_torch.rank() == 0:
+        buf = _io.BytesIO()
+        torch.save(spec["model"].state_dict(), buf)
+        return buf.getvalue()
+    return None
+
+
+class LightningModel(_Model):
+    """Transformer returned by LightningEstimator.fit."""
 
     def _predict(self, x):
         import torch

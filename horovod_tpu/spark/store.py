@@ -56,23 +56,51 @@ class Store:
 
     # -- data materialization --
     def write_dataframe(self, df, path: str) -> int:
-        """Materialize a pandas (or Spark) DataFrame as Parquet under
-        ``path``; returns the row count (reference prepare_data's
-        to-parquet step, spark/common/util.py)."""
+        """Materialize a DataFrame as Parquet under ``path``; returns the
+        row count.  Spark DataFrames are written executor-side
+        (``df.write.parquet``) — the dataset never funnels through driver
+        memory, unlike a ``toPandas()`` materialization (the reference
+        streams through Petastorm for the same reason,
+        spark/keras/remote.py:102)."""
+        if hasattr(df, "write") and hasattr(df, "toPandas"):
+            # Spark DataFrame: distributed write straight to the store.
+            # Row count comes from the written parquet footers — a
+            # pre-write df.count() would execute the input lineage twice.
+            df.write.mode("overwrite").parquet(path)
+            try:
+                import pyarrow.parquet as pq
+                return sum(pq.ParquetFile(p).metadata.num_rows
+                           for p in self._parquet_parts(path))
+            except Exception:
+                return -1  # non-local store path; count unknown
         self.makedirs(path)
         target = os.path.join(path, "part-00000.parquet")
-        if hasattr(df, "toPandas"):  # Spark DataFrame without petastorm
-            df = df.toPandas()
         df.to_parquet(target)
         return len(df)
 
-    def read_dataframe(self, path: str):
-        import pandas as pd
-        parts = sorted(
+    def _parquet_parts(self, path: str):
+        return sorted(
             os.path.join(path, f) for f in os.listdir(path)
             if f.endswith(".parquet"))
-        return pd.concat([pd.read_parquet(p) for p in parts],
+
+    def read_dataframe(self, path: str):
+        import pandas as pd
+        return pd.concat([pd.read_parquet(p)
+                          for p in self._parquet_parts(path)],
                          ignore_index=True)
+
+    def iter_array_batches(self, path: str, feature_cols, label_cols,
+                           chunk_rows: int = 65536):
+        """Stream (X, y) float32 chunks from the parquet files under
+        ``path`` without loading the dataset into memory — the worker-side
+        analog of the reference's Petastorm batch feed
+        (spark/keras/remote.py:102)."""
+        import pyarrow.parquet as pq
+        for part in self._parquet_parts(path):
+            pf = pq.ParquetFile(part)
+            for rb in pf.iter_batches(batch_size=chunk_rows):
+                yield dataframe_to_arrays(rb.to_pandas(), feature_cols,
+                                          label_cols)
 
     def save_checkpoint(self, run_id: str, payload: bytes) -> str:
         path = self.get_checkpoint_path(run_id)

@@ -100,7 +100,7 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
 def allgather(tensor, name: Optional[str] = None):
     if _is_symbolic(tensor):
         return _graph_bridge(
-            lambda x: np.ascontiguousarray(_C.allgather(x, name=name)),
+            lambda x: np.asarray(_C.allgather(x, name=name)),
             tensor, out_shape=_tf.TensorShape(
                 [None] + list(tensor.shape)[1:]))
     return _to_tf(_C.allgather(_np(tensor), name=name))
@@ -109,7 +109,7 @@ def allgather(tensor, name: Optional[str] = None):
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     if _is_symbolic(tensor):
         return _graph_bridge(
-            lambda x: np.ascontiguousarray(
+            lambda x: np.asarray(
                 _C.broadcast(x, root_rank=root_rank, name=name)), tensor)
     return _to_tf(_C.broadcast(_np(tensor), root_rank=root_rank, name=name))
 

@@ -147,7 +147,10 @@ def run(func):
             return func(state, *args, **kwargs)
         except _tf.errors.OpError as e:
             msg = getattr(e, "message", str(e))
-            if "HorovodInternalError" in msg or "hvd" in msg.lower():
+            # Only errors that actually wrap our runtime's failure: a
+            # broader heuristic would reclassify deterministic user errors
+            # (NotFoundError etc.) and loop the retry forever.
+            if "HorovodInternalError" in msg:
                 raise HorovodInternalError(msg) from e
             raise
 
