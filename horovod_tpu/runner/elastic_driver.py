@@ -97,7 +97,9 @@ class ElasticDriver:
         self._extra_env = dict(extra_env or {})
         self._verbose = verbose
 
-        self._rendezvous = RendezvousServer()
+        from .rendezvous import generate_secret
+        self._rdv_secret = generate_secret()
+        self._rendezvous = RendezvousServer(secret=self._rdv_secret)
         self._lock = threading.RLock()
         self._round = -1
         self._resets = 0
@@ -115,8 +117,17 @@ class ElasticDriver:
     def run(self) -> int:
         import socket
         port = self._rendezvous.start()
-        self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = \
-            f"{socket.gethostname()}:{port}"
+        try:
+            initial_hosts = self._discover_filtered()
+        except RuntimeError:
+            initial_hosts = []
+        from . import exec as _exec
+        from .probe import advertised_host
+        rdv_host = advertised_host(
+            [h.hostname for h in initial_hosts
+             if not _exec._is_local(h.hostname)])
+        self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{port}"
+        self._extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = self._rdv_secret
         self._extra_env["HVD_TPU_ELASTIC"] = "1"
         try:
             hosts = self._discover_filtered()

@@ -26,19 +26,31 @@ def _is_local(hostname: str) -> bool:
 
 
 def build_command(slot: SlotInfo, command: List[str], env: Dict[str, str],
-                  ssh_port: Optional[int] = None) -> List[str]:
+                  ssh_port: Optional[int] = None
+                  ) -> Tuple[List[str], Optional[str]]:
+    """Returns (argv, stdin_payload).  Secrets never travel in the remote
+    argv — /proc/*/cmdline is world-readable on both machines, which would
+    hand the rendezvous-forging capability the HMAC exists to deny back to
+    any local user.  They are piped through ssh stdin instead."""
     if _is_local(slot.hostname):
-        return command
+        return command, None
+    env = dict(env)
+    secret = env.pop("HVD_TPU_RENDEZVOUS_SECRET", None)
     # Remote: ssh with env assignments inline (reference gloo_run.py builds
     # the same "env k=v ... cmd" remote line).
     assignments = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env.items())
     remote = f"cd {shlex.quote(os.getcwd())} && env {assignments} " + \
         " ".join(shlex.quote(c) for c in command)
+    payload = None
+    if secret is not None:
+        remote = ("IFS= read -r HVD_TPU_RENDEZVOUS_SECRET && "
+                  "export HVD_TPU_RENDEZVOUS_SECRET && " + remote)
+        payload = secret + "\n"
     ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         ssh_cmd += ["-p", str(ssh_port)]
-    return ssh_cmd + [slot.hostname, remote]
+    return ssh_cmd + [slot.hostname, remote], payload
 
 
 class WorkerProcess:
@@ -81,12 +93,21 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
             env.update(extra_env)
         slot_command = chips_mod.wrap_python_command(command) \
             if chips_mod.needs_bootstrap(platform) else command
-        cmd = build_command(slot, slot_command,
-                            {**slot_env(slot, controller_addr),
-                             **platform, **(extra_env or {})})
+        cmd, stdin_payload = build_command(
+            slot, slot_command,
+            {**slot_env(slot, controller_addr),
+             **platform, **(extra_env or {})})
         proc = subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cmd, env=env,
+            stdin=subprocess.PIPE if stdin_payload else subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, bufsize=1, start_new_session=True)
+        if stdin_payload:
+            try:
+                proc.stdin.write(stdin_payload)
+                proc.stdin.close()
+            except OSError:
+                pass  # worker died instantly; exit watcher reports it
         w = WorkerProcess(slot, proc)
         workers.append(w)
         if prefix_output:

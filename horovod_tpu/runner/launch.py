@@ -156,11 +156,20 @@ def run_static(args: argparse.Namespace) -> int:
     slots = get_host_assignments(hosts, np_)
     controller_addr = _controller_addr(hosts, args.controller_port)
 
-    rendezvous = RendezvousServer()
+    from .rendezvous import generate_secret
+    secret = generate_secret()
+    rendezvous = RendezvousServer(secret=secret)
     rdv_port = rendezvous.start()
     extra_env = knob_env(args)
-    extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = \
-        f"{socket.gethostname()}:{rdv_port}"
+    # Advertise a driver address every remote host can actually route to
+    # (NIC matching; reference driver_service.py:49-218) — gethostname()
+    # may resolve to an unreachable interface on multi-NIC machines.
+    from .probe import advertised_host
+    rdv_host = advertised_host(
+        [h.hostname for h in hosts if not exec_mod._is_local(h.hostname)],
+        ssh_port=args.ssh_port)
+    extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{rdv_port}"
+    extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = secret
     rendezvous.put("global", "controller", controller_addr.encode())
 
     if args.verbose:

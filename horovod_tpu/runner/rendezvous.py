@@ -6,13 +6,43 @@ PUT/GET/DELETE of scoped keys ("/scope/key"), used by elastic workers to
 discover the current controller address and by auxiliary tooling.  GET on a
 missing key returns 404 (clients poll); the elastic handler additionally
 serves slot assignments per rendezvous round.
+
+Requests are HMAC-SHA256-signed with a per-launch secret (the reference
+signs its RPC messages the same way, runner/common/util/network.py:60-67 +
+secret.py): without it, anyone on the network could rewrite slot
+assignments or the controller address.  The launcher generates the secret
+and exports it to workers as ``HVD_TPU_RENDEZVOUS_SECRET``; a server
+created without a secret accepts unsigned requests (unit-test/loopback
+mode).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import os
+import secrets as _secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
+
+_SIG_HEADER = "X-HVD-Signature"
+
+
+def generate_secret() -> str:
+    return _secrets.token_hex(16)
+
+
+def _signature(secret: str, method: str, scope: str, key: str,
+               body: bytes = b"") -> str:
+    mac = _hmac.new(secret.encode(), digestmod=hashlib.sha256)
+    mac.update(f"{method}\n{scope}/{key}\n".encode())
+    mac.update(body)
+    return mac.hexdigest()
+
+
+def _env_secret() -> Optional[str]:
+    return os.environ.get("HVD_TPU_RENDEZVOUS_SECRET")
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -27,16 +57,33 @@ class _KVHandler(BaseHTTPRequestHandler):
             return "", parts[0]
         return parts[0], parts[1]
 
+    def _verify(self, method: str, scope: str, key: str,
+                body: bytes = b"") -> bool:
+        secret = self.server.secret  # type: ignore[attr-defined]
+        if not secret:
+            return True
+        provided = self.headers.get(_SIG_HEADER, "")
+        expected = _signature(secret, method, scope, key, body)
+        return _hmac.compare_digest(provided, expected)
+
+    def _reject(self):
+        self.send_response(403)
+        self.end_headers()
+
     def do_PUT(self):
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if not self._verify("PUT", scope, key, value):
+            return self._reject()
         self.server.store_put(scope, key, value)  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
 
     def do_GET(self):
         scope, key = self._split()
+        if not self._verify("GET", scope, key):
+            return self._reject()
         value = self.server.store_get(scope, key)  # type: ignore[attr-defined]
         if value is None:
             self.send_response(404)
@@ -49,6 +96,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         scope, key = self._split()
+        if not self._verify("DELETE", scope, key):
+            return self._reject()
         self.server.store_delete(scope, key)  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
@@ -57,8 +106,9 @@ class _KVHandler(BaseHTTPRequestHandler):
 class _KVServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr):
+    def __init__(self, addr, secret: Optional[str] = None):
         super().__init__(addr, _KVHandler)
+        self.secret = secret
         self._store: Dict[Tuple[str, str], bytes] = {}
         self._lock = threading.Lock()
 
@@ -76,9 +126,11 @@ class _KVServer(ThreadingHTTPServer):
 
 
 class RendezvousServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
-        self._server = _KVServer((host, port))
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 secret: Optional[str] = None):
+        self._server = _KVServer((host, port), secret=secret)
         self._thread: Optional[threading.Thread] = None
+        self.secret = secret
 
     @property
     def port(self) -> int:
@@ -102,28 +154,48 @@ class RendezvousServer:
             self._thread.join(timeout=5)
 
 
-def http_get(addr: str, scope: str, key: str,
-             timeout: float = 5.0) -> Optional[bytes]:
-    """Tiny client (reference http/http_client.py)."""
+def http_get(addr: str, scope: str, key: str, timeout: float = 5.0,
+             secret: Optional[str] = None) -> Optional[bytes]:
+    """Tiny client (reference http/http_client.py); signs with the launch
+    secret (arg or HVD_TPU_RENDEZVOUS_SECRET env) when one is present."""
     import urllib.error
     import urllib.request
+    secret = secret or _env_secret()
+    req = urllib.request.Request(f"http://{addr}/{scope}/{key}")
+    if secret:
+        req.add_header(_SIG_HEADER, _signature(secret, "GET", scope, key))
     try:
-        with urllib.request.urlopen(
-                f"http://{addr}/{scope}/{key}", timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read()
-    except urllib.error.HTTPError:
+    except urllib.error.HTTPError as e:
+        if e.code == 403:
+            # Auth failure must not look like "key not published yet" —
+            # pollers would spin forever with a missing/stale secret.
+            raise PermissionError(
+                f"rendezvous server at {addr} rejected the request "
+                "signature (missing or wrong HVD_TPU_RENDEZVOUS_SECRET)")
         return None
     except OSError:
         return None
 
 
 def http_put(addr: str, scope: str, key: str, value: bytes,
-             timeout: float = 5.0) -> bool:
+             timeout: float = 5.0, secret: Optional[str] = None) -> bool:
     import urllib.request
+    secret = secret or _env_secret()
     req = urllib.request.Request(
         f"http://{addr}/{scope}/{key}", data=value, method="PUT")
+    if secret:
+        req.add_header(_SIG_HEADER,
+                       _signature(secret, "PUT", scope, key, value))
     try:
         with urllib.request.urlopen(req, timeout=timeout):
             return True
+    except urllib.error.HTTPError as e:
+        if e.code == 403:
+            raise PermissionError(
+                f"rendezvous server at {addr} rejected the request "
+                "signature (missing or wrong HVD_TPU_RENDEZVOUS_SECRET)")
+        return False
     except OSError:
         return False

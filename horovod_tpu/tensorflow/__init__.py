@@ -59,15 +59,66 @@ def _is_symbolic(t) -> bool:
     return isinstance(t, _tf.Tensor) and not hasattr(t, "numpy")
 
 
+_custom_ops: Any = None
+
+
+def _load_custom_ops():
+    """The compiled TF custom-op bridge (tensorflow/ops/hvd_tf_ops.cc):
+    AsyncOpKernels — GIL-free, SavedModel-serializable, usable under
+    tf.function(input_signature=...).  The .so ships prebuilt; if absent
+    it is built once under an flock (concurrent workers on a host must
+    not race g++ onto the same output).  Falls back to the py_function
+    bridge — with a logged warning — when build/load fails."""
+    global _custom_ops
+    if _custom_ops is not None:
+        return _custom_ops or None
+    import os
+    so = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "hvd_tf_ops.so")
+    if not os.path.exists(so):
+        import fcntl
+        import subprocess
+        src = os.path.join(os.path.dirname(so), "ops")
+        lock_path = so + ".lock"
+        try:
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if not os.path.exists(so):  # first holder builds
+                    subprocess.run(["make", "-C", src], check=True,
+                                   capture_output=True, timeout=300)
+        except Exception as e:
+            from ..utils import logging as log
+            log.warning("TF custom-op bridge build failed (%s); graph "
+                        "collectives fall back to tf.py_function", e)
+            _custom_ops = False
+            return None
+    try:
+        from ..native.controller import _lib_path
+        os.environ.setdefault("HVD_TPU_NATIVE_LIB", _lib_path())
+        _custom_ops = _tf.load_op_library(so)
+    except Exception as e:
+        from ..utils import logging as log
+        log.warning("TF custom-op bridge load failed (%s); graph "
+                    "collectives fall back to tf.py_function", e)
+        _custom_ops = False
+        return None
+    return _custom_ops
+
+
 def _graph_bridge(np_fn, tensor, out_shape=None):
-    """Run the numpy-bridged collective from graph mode.  The reference
-    reaches its runtime from TF graphs through a registered custom op
-    (tensorflow/mpi_ops.cc:383-431 AsyncOpKernels); here ``tf.py_function``
-    plays that role: the traced graph calls back into the eager bridge."""
+    """Run the numpy-bridged collective from graph mode when the compiled
+    custom op cannot serve (no native controller, unsupported op/dtype):
+    ``tf.py_function`` calls back into the eager bridge."""
     out = _tf.py_function(lambda x: np_fn(x.numpy()), [tensor],
                           tensor.dtype)
     out.set_shape(tensor.shape if out_shape is None else out_shape)
     return out
+
+
+def _native_graph_ready() -> bool:
+    from ..core.state import global_state
+    return global_state.controller is not None and \
+        _load_custom_ops() is not None
 
 
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
@@ -86,10 +137,15 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
     comp = compression or Compression.none
     t, ctx = comp.compress(tensor)
     if _is_symbolic(t):
-        out = _graph_bridge(
-            lambda x: np.asarray(_C.allreduce(
-                x, op=op, name=name, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)), t)
+        if _native_graph_ready():
+            out = _load_custom_ops().hvd_tpu_allreduce(
+                t, op_code=int(op), prescale=prescale_factor,
+                postscale=postscale_factor, tensor_name=name or "")
+        else:
+            out = _graph_bridge(
+                lambda x: np.asarray(_C.allreduce(
+                    x, op=op, name=name, prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)), t)
         return comp.decompress(out, ctx)
     out = _C.allreduce(_np(t), op=op, name=name,
                        prescale_factor=prescale_factor,
@@ -108,6 +164,9 @@ def allgather(tensor, name: Optional[str] = None):
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     if _is_symbolic(tensor):
+        if _native_graph_ready():
+            return _load_custom_ops().hvd_tpu_broadcast(
+                tensor, root_rank=root_rank, tensor_name=name or "")
         return _graph_bridge(
             lambda x: np.asarray(
                 _C.broadcast(x, root_rank=root_rank, name=name)), tensor)

@@ -1,0 +1,236 @@
+// TensorFlow custom ops bridging TF graphs to the native runtime.
+//
+// The reference reaches its runtime from TF graphs through registered
+// AsyncOpKernels (tensorflow/mpi_ops.cc:383-962).  This is the TPU-native
+// equivalent: real graph ops (GIL-free, SavedModel-serializable, usable
+// under tf.function(input_signature=...)) that call the same
+// hvd_native_* C API the ctypes layer uses.  The native library is
+// dlopened from HVD_TPU_NATIVE_LIB (set by the Python loader) so this .so
+// carries no link-time coupling; in-process it resolves to the same
+// runtime singleton the Python controller initialized.
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+
+#include "tensorflow/core/framework/common_shape_fns.h"
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+namespace {
+
+using tensorflow::AsyncOpKernel;
+using tensorflow::OpKernel;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+using tensorflow::errors::Internal;
+
+// hvd_native_* entry points resolved at first use.
+struct NativeApi {
+  int64_t (*allreduce)(const char*, const void*, void*, int,
+                       const int64_t*, int, int, double, double) = nullptr;
+  int64_t (*broadcast)(const char*, const void*, void*, int,
+                       const int64_t*, int, int) = nullptr;
+  int (*wait)(int64_t) = nullptr;
+  void (*release)(int64_t) = nullptr;
+  const char* (*last_error)() = nullptr;
+  int (*initialized)() = nullptr;
+  bool ok = false;
+  std::string error;
+};
+
+const NativeApi& Api() {
+  static NativeApi api = [] {
+    NativeApi a;
+    const char* path = getenv("HVD_TPU_NATIVE_LIB");
+    if (!path) {
+      a.error = "HVD_TPU_NATIVE_LIB not set";
+      return a;
+    }
+    void* h = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    if (!h) {
+      a.error = std::string("dlopen failed: ") + dlerror();
+      return a;
+    }
+    auto resolve = [&](const char* name) -> void* {
+      void* sym = dlsym(h, name);
+      if (!sym) a.error = std::string("missing symbol ") + name;
+      return sym;
+    };
+    a.allreduce = reinterpret_cast<decltype(a.allreduce)>(
+        resolve("hvd_native_allreduce"));
+    a.broadcast = reinterpret_cast<decltype(a.broadcast)>(
+        resolve("hvd_native_broadcast"));
+    a.wait = reinterpret_cast<decltype(a.wait)>(resolve("hvd_native_wait"));
+    a.release = reinterpret_cast<decltype(a.release)>(
+        resolve("hvd_native_release"));
+    a.last_error = reinterpret_cast<decltype(a.last_error)>(
+        resolve("hvd_native_last_error"));
+    a.initialized = reinterpret_cast<decltype(a.initialized)>(
+        resolve("hvd_native_initialized"));
+    a.ok = a.error.empty();
+    return a;
+  }();
+  return api;
+}
+
+int DtypeCode(tensorflow::DataType dt) {
+  switch (dt) {
+    case tensorflow::DT_UINT8: return 0;
+    case tensorflow::DT_INT8: return 1;
+    case tensorflow::DT_INT32: return 2;
+    case tensorflow::DT_INT64: return 3;
+    case tensorflow::DT_HALF: return 4;
+    case tensorflow::DT_FLOAT: return 5;
+    case tensorflow::DT_DOUBLE: return 6;
+    case tensorflow::DT_BOOL: return 7;
+    case tensorflow::DT_BFLOAT16: return 8;
+    default: return -1;
+  }
+}
+
+std::string LastError() {
+  const NativeApi& api = Api();
+  if (!api.ok) return api.error;
+  const char* e = api.last_error();
+  return e ? e : "unknown native error";
+}
+
+// Both kernels are AsyncOpKernels: the enqueue happens on the executor
+// thread but the wait-for-completion runs on a scheduled closure.  A
+// blocking Compute() would pin executor threads on collectives whose
+// completion needs OTHER collectives to be enqueued by those same threads
+// — the distributed-deadlock hazard the reference's async design exists
+// to prevent (tensorflow/mpi_ops.cc:383-431).
+class HvdTpuAllreduceOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAllreduceOp(OpKernelConstruction* ctx)
+      : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("op_code", &op_code_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+    if (tensor_name_.empty()) tensor_name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const NativeApi& api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok,
+                      Internal("hvd native runtime: ", LastError()), done);
+    OP_REQUIRES_ASYNC(ctx, api.initialized(),
+                      Internal("hvd native runtime not initialized; call "
+                               "hvd.init() under the launcher first"),
+                      done);
+    const Tensor& input = ctx->input(0);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      Internal("unsupported dtype for hvd allreduce"),
+                      done);
+    int ndim = input.dims();
+    std::vector<int64_t> dims(std::max(ndim, 1), 1);
+    for (int i = 0; i < ndim; ++i) dims[i] = input.dim_size(i);
+    int64_t h = api.allreduce(
+        tensor_name_.c_str(), input.tensor_data().data(),
+        const_cast<char*>(output->tensor_data().data()), ndim, dims.data(),
+        code, op_code_, prescale_, postscale_);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("allreduce enqueue: ", LastError()), done);
+    tensorflow::Env::Default()->SchedClosure(
+        [ctx, done = std::move(done), h, &api]() {
+          int rc = api.wait(h);
+          api.release(h);
+          if (rc != 0) {
+            ctx->SetStatus(Internal("allreduce: ", LastError()));
+          }
+          done();
+        });
+  }
+
+ private:
+  int op_code_;
+  float prescale_;
+  float postscale_;
+  std::string tensor_name_;
+};
+
+class HvdTpuBroadcastOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuBroadcastOp(OpKernelConstruction* ctx)
+      : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("root_rank", &root_rank_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+    if (tensor_name_.empty()) tensor_name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const NativeApi& api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok,
+                      Internal("hvd native runtime: ", LastError()), done);
+    OP_REQUIRES_ASYNC(ctx, api.initialized(),
+                      Internal("hvd native runtime not initialized"), done);
+    const Tensor& input = ctx->input(0);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      Internal("unsupported dtype for hvd broadcast"),
+                      done);
+    int ndim = input.dims();
+    std::vector<int64_t> dims(std::max(ndim, 1), 1);
+    for (int i = 0; i < ndim; ++i) dims[i] = input.dim_size(i);
+    int64_t h = api.broadcast(
+        tensor_name_.c_str(), input.tensor_data().data(),
+        const_cast<char*>(output->tensor_data().data()), ndim, dims.data(),
+        code, root_rank_);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("broadcast enqueue: ", LastError()), done);
+    tensorflow::Env::Default()->SchedClosure(
+        [ctx, done = std::move(done), h, &api]() {
+          int rc = api.wait(h);
+          api.release(h);
+          if (rc != 0) {
+            ctx->SetStatus(Internal("broadcast: ", LastError()));
+          }
+          done();
+        });
+  }
+
+ private:
+  int root_rank_;
+  std::string tensor_name_;
+};
+
+}  // namespace
+
+REGISTER_OP("HvdTpuAllreduce")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, int32, int64, half, float, double, bfloat16}")
+    .Attr("op_code: int = 1")
+    .Attr("prescale: float = 1.0")
+    .Attr("postscale: float = 1.0")
+    .Attr("tensor_name: string = ''")
+    .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+REGISTER_OP("HvdTpuBroadcast")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, int32, int64, half, float, double, bfloat16}")
+    .Attr("root_rank: int = 0")
+    .Attr("tensor_name: string = ''")
+    .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce")
+                            .Device(tensorflow::DEVICE_CPU),
+                        HvdTpuAllreduceOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast")
+                            .Device(tensorflow::DEVICE_CPU),
+                        HvdTpuBroadcastOp);

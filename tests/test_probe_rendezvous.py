@@ -1,0 +1,124 @@
+"""Rendezvous KV HMAC auth (reference network.py:60-67 signed RPC) and the
+driver/task connectivity probe with NIC matching (reference
+driver_service.py:49-218)."""
+
+import json
+
+import pytest
+
+from horovod_tpu.runner import probe
+from horovod_tpu.runner.rendezvous import (RendezvousServer, generate_secret,
+                                           http_get, http_put)
+
+
+@pytest.fixture()
+def secured_server():
+    secret = generate_secret()
+    srv = RendezvousServer(secret=secret)
+    port = srv.start()
+    yield srv, f"127.0.0.1:{port}", secret
+    srv.stop()
+
+
+def test_signed_put_get_roundtrip(secured_server):
+    _srv, addr, secret = secured_server
+    assert http_put(addr, "s", "k", b"payload", secret=secret)
+    assert http_get(addr, "s", "k", secret=secret) == b"payload"
+
+
+def test_unsigned_request_rejected(secured_server):
+    srv, addr, secret = secured_server
+    srv.put("s", "k", b"secret-value")
+    # No signature → 403 surfaces as PermissionError (NOT a silent None —
+    # pollers must fail fast, not spin on a missing secret).
+    with pytest.raises(PermissionError):
+        http_get(addr, "s", "k", secret=None)
+    with pytest.raises(PermissionError):
+        http_put(addr, "s", "k", b"overwrite", secret=None)
+    # The forged write must not have landed.
+    assert srv.get("s", "k") == b"secret-value"
+
+
+def test_wrong_secret_rejected(secured_server):
+    srv, addr, _secret = secured_server
+    srv.put("s", "k", b"v")
+    with pytest.raises(PermissionError):
+        http_get(addr, "s", "k", secret="deadbeef" * 4)
+
+
+def test_env_secret_used(secured_server, monkeypatch):
+    _srv, addr, secret = secured_server
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_SECRET", secret)
+    assert http_put(addr, "s", "env", b"1")
+    assert http_get(addr, "s", "env") == b"1"
+
+
+def test_unsecured_server_accepts_unsigned():
+    srv = RendezvousServer()
+    port = srv.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        assert http_put(addr, "a", "b", b"x", secret=None)
+        assert http_get(addr, "a", "b", secret=None) == b"x"
+    finally:
+        srv.stop()
+
+
+# --- probe -----------------------------------------------------------------
+
+def test_local_addresses_nonempty():
+    addrs = probe.local_addresses()
+    assert addrs and "127.0.0.1" in addrs
+
+
+def test_probe_listener_roundtrip():
+    lst = probe.ProbeListener("tok123")
+    try:
+        assert probe.check_reachable("127.0.0.1", lst.port, "tok123")
+        assert not probe.check_reachable("127.0.0.1", lst.port, "wrong!!")
+    finally:
+        lst.close()
+    # Listener closed: unreachable.
+    assert not probe.check_reachable("127.0.0.1", lst.port, "tok123")
+
+
+def test_probe_script_runs_locally():
+    lst = probe.ProbeListener("t0k")
+    try:
+        script = probe.probe_script(["127.0.0.1", "203.0.113.9"],
+                                    lst.port, "t0k")
+        import subprocess, sys
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0
+        assert json.loads(out.stdout.strip()) == ["127.0.0.1"]
+    finally:
+        lst.close()
+
+
+def test_match_driver_address_intersects_hosts():
+    calls = {}
+
+    def fake_probe(host, script, ssh_port=None):
+        calls[host] = True
+        # host-a reaches both candidates; host-b only the second usable one.
+        reach = {"host-a": probe.local_addresses(),
+                 "host-b": probe.local_addresses()[1:] or
+                 probe.local_addresses()}
+        return reach[host]
+
+    chosen, per_host = probe.match_driver_address(
+        ["host-a", "host-b"], remote_probe=fake_probe)
+    assert set(calls) == {"host-a", "host-b"}
+    assert chosen in probe.local_addresses()
+    assert all(chosen in reach for reach in per_host.values())
+
+
+def test_match_driver_address_none_when_disjoint():
+    def fake_probe(host, script, ssh_port=None):
+        return []
+
+    chosen, per_host = probe.match_driver_address(
+        ["host-x"], remote_probe=fake_probe)
+    assert chosen is None
+    assert per_host == {"host-x": []}
