@@ -115,25 +115,24 @@ class ElasticDriver:
     # -- public ------------------------------------------------------------
 
     def run(self) -> int:
-        import socket
         port = self._rendezvous.start()
         try:
-            initial_hosts = self._discover_filtered()
-        except RuntimeError:
-            initial_hosts = []
-        from . import exec as _exec
-        from .probe import advertised_host
-        rdv_host = advertised_host(
-            [h.hostname for h in initial_hosts
-             if not _exec._is_local(h.hostname)])
-        self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{port}"
-        self._extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = self._rdv_secret
-        self._extra_env["HVD_TPU_ELASTIC"] = "1"
-        try:
+            # One discovery (it may be a user subprocess) serves both the
+            # capacity check and the NIC-matching probe.  The advertised
+            # address is fixed for the job: later-joining hosts must be
+            # able to route to an address probed against the initial set
+            # (the practical assumption: elastic pools share a network).
             hosts = self._discover_filtered()
             if sum(h.slots for h in hosts) < self._min_np:
                 raise RuntimeError(
                     f"not enough slots to reach --min-np {self._min_np}")
+            from .probe import advertised_host
+            rdv_host = advertised_host(
+                [h.hostname for h in hosts
+                 if not exec_mod._is_local(h.hostname)])
+            self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{port}"
+            self._extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = self._rdv_secret
+            self._extra_env["HVD_TPU_ELASTIC"] = "1"
             self._start_round(hosts)
             watcher = threading.Thread(target=self._discovery_loop,
                                        daemon=True)

@@ -15,7 +15,7 @@ import signal
 import subprocess
 import sys
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .hosts import SlotInfo, slot_env
 
@@ -65,7 +65,8 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                    extra_env: Optional[Dict[str, str]] = None,
                    on_exit: Optional[Callable[[SlotInfo, int], None]] = None,
                    prefix_output: bool = True,
-                   platform_policy: str = "auto") -> List[WorkerProcess]:
+                   platform_policy: str = "auto",
+                   ssh_port: Optional[int] = None) -> List[WorkerProcess]:
     """Start one process per slot; returns immediately with handles.
 
     ``platform_policy`` decides how each host's workers share its TPU chips
@@ -96,7 +97,8 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
         cmd, stdin_payload = build_command(
             slot, slot_command,
             {**slot_env(slot, controller_addr),
-             **platform, **(extra_env or {})})
+             **platform, **(extra_env or {})},
+            ssh_port=ssh_port)
         proc = subprocess.Popen(
             cmd, env=env,
             stdin=subprocess.PIPE if stdin_payload else subprocess.DEVNULL,

@@ -178,7 +178,8 @@ def run_static(args: argparse.Namespace) -> int:
                   f"{s.local_size}, cross {s.cross_rank}/{s.cross_size})")
     workers = exec_mod.launch_workers(slots, args.command, controller_addr,
                                       extra_env=extra_env,
-                                      platform_policy=args.worker_platform)
+                                      platform_policy=args.worker_platform,
+                                      ssh_port=args.ssh_port)
     try:
         return exec_mod.wait_all(workers)
     finally:

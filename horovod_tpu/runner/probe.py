@@ -181,6 +181,8 @@ def match_driver_address(remote_hosts: List[str],
     """
     import secrets
     from concurrent.futures import ThreadPoolExecutor
+    if not remote_hosts:
+        return None, {}
     token = token or secrets.token_hex(8)
     candidates = local_addresses()
     listener = ProbeListener(token)

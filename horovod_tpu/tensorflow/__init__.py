@@ -73,33 +73,48 @@ def _load_custom_ops():
     if _custom_ops is not None:
         return _custom_ops or None
     import os
+    from ..utils import logging as log
     so = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "hvd_tf_ops.so")
-    if not os.path.exists(so):
+
+    def _build(force: bool = False) -> bool:
         import fcntl
         import subprocess
         src = os.path.join(os.path.dirname(so), "ops")
-        lock_path = so + ".lock"
         try:
-            with open(lock_path, "w") as lock:
+            with open(so + ".lock", "w") as lock:
                 fcntl.flock(lock, fcntl.LOCK_EX)
-                if not os.path.exists(so):  # first holder builds
-                    subprocess.run(["make", "-C", src], check=True,
+                # Re-check under the lock: concurrent workers must not
+                # each pay the build (first holder built it already).
+                if force or not os.path.exists(so):
+                    subprocess.run(["make", "-B", "-C", src], check=True,
                                    capture_output=True, timeout=300)
-        except Exception as e:
-            from ..utils import logging as log
-            log.warning("TF custom-op bridge build failed (%s); graph "
-                        "collectives fall back to tf.py_function", e)
-            _custom_ops = False
-            return None
-    try:
+            return True
+        except Exception as e:  # noqa: BLE001
+            log.warning("TF custom-op bridge build failed (%s)", e)
+            return False
+
+    def _load():
         from ..native.controller import _lib_path
         os.environ.setdefault("HVD_TPU_NATIVE_LIB", _lib_path())
-        _custom_ops = _tf.load_op_library(so)
-    except Exception as e:
-        from ..utils import logging as log
+        return _tf.load_op_library(so)
+
+    if not os.path.exists(so) and not _build():
+        _custom_ops = False
+        return None
+    try:
+        _custom_ops = _load()
+    except Exception as first_err:  # noqa: BLE001
+        # A prebuilt .so can mismatch the installed TF wheel's C++ ABI —
+        # rebuild once against the local headers before giving up.
+        if _build(force=True):
+            try:
+                _custom_ops = _load()
+                return _custom_ops
+            except Exception as e:  # noqa: BLE001
+                first_err = e
         log.warning("TF custom-op bridge load failed (%s); graph "
-                    "collectives fall back to tf.py_function", e)
+                    "collectives fall back to tf.py_function", first_err)
         _custom_ops = False
         return None
     return _custom_ops
@@ -115,10 +130,28 @@ def _graph_bridge(np_fn, tensor, out_shape=None):
     return out
 
 
+_warned_trace_before_init = False
+
+
 def _native_graph_ready() -> bool:
+    """Whether graph-mode collectives can lower to the compiled custom op.
+    Evaluated at tf.function TRACE time — trace after hvd.init() (under
+    the launcher) or the graph permanently bakes the py_function bridge."""
     from ..core.state import global_state
-    return global_state.controller is not None and \
+    ready = global_state.controller is not None and \
         _load_custom_ops() is not None
+    if not ready and not global_state.initialized and \
+            _load_custom_ops() is not None:
+        global _warned_trace_before_init
+        if not _warned_trace_before_init:
+            _warned_trace_before_init = True
+            from ..utils import logging as log
+            log.warning(
+                "tf.function traced a collective before hvd.init(): the "
+                "graph bakes the py_function bridge (GIL-bound, not "
+                "SavedModel-serializable). Call hvd.init() before tracing "
+                "to use the compiled op.")
+    return ready
 
 
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
@@ -184,6 +217,38 @@ def join() -> int:
 
 def barrier():
     _C.barrier()
+
+
+def size_op(name: Optional[str] = None):
+    """Graph-time world size that reads the LIVE runtime at execution
+    (reference HorovodSize, mpi_ops.cc:787-867): elastic graphs must not
+    bake a traced world size into the program.  Falls back to a constant
+    without the compiled op library."""
+    lib = _load_custom_ops()
+    if lib is None:
+        return _tf.constant(size(), dtype=_tf.int32, name=name)
+    return lib.hvd_tpu_size(name=name)
+
+
+def rank_op(name: Optional[str] = None):
+    lib = _load_custom_ops()
+    if lib is None:
+        return _tf.constant(rank(), dtype=_tf.int32, name=name)
+    return lib.hvd_tpu_rank(name=name)
+
+
+def local_rank_op(name: Optional[str] = None):
+    lib = _load_custom_ops()
+    if lib is None:
+        return _tf.constant(local_rank(), dtype=_tf.int32, name=name)
+    return lib.hvd_tpu_local_rank(name=name)
+
+
+def local_size_op(name: Optional[str] = None):
+    lib = _load_custom_ops()
+    if lib is None:
+        return _tf.constant(local_size(), dtype=_tf.int32, name=name)
+    return lib.hvd_tpu_local_size(name=name)
 
 
 def broadcast_variables(variables: List, root_rank: int = 0):

@@ -39,6 +39,8 @@ struct NativeApi {
   void (*release)(int64_t) = nullptr;
   const char* (*last_error)() = nullptr;
   int (*initialized)() = nullptr;
+  int (*rank)() = nullptr;
+  int (*size)() = nullptr;
   bool ok = false;
   std::string error;
 };
@@ -72,6 +74,10 @@ const NativeApi& Api() {
         resolve("hvd_native_last_error"));
     a.initialized = reinterpret_cast<decltype(a.initialized)>(
         resolve("hvd_native_initialized"));
+    a.rank = reinterpret_cast<decltype(a.rank)>(
+        resolve("hvd_native_rank"));
+    a.size = reinterpret_cast<decltype(a.size)>(
+        resolve("hvd_native_size"));
     a.ok = a.error.empty();
     return a;
   }();
@@ -208,7 +214,94 @@ class HvdTpuBroadcastOp : public AsyncOpKernel {
   std::string tensor_name_;
 };
 
+// Scalar topology query ops (reference HorovodSize/Rank/LocalRank/
+// LocalSize, tensorflow/mpi_ops.cc:787-867): graph-time constants would
+// bake a world size into elastic graphs; these read the live runtime
+// (local topology from the launcher env contract).
+class HvdTpuQueryOp : public OpKernel {
+ public:
+  enum class Kind { kRank, kSize, kLocalRank, kLocalSize };
+
+  HvdTpuQueryOp(OpKernelConstruction* ctx, Kind kind)
+      : OpKernel(ctx), kind_(kind) {}
+
+  void Compute(OpKernelContext* ctx) override {
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK(ctx, ctx->allocate_output(
+                            0, tensorflow::TensorShape({}), &output));
+    int value = -1;
+    const NativeApi& api = Api();
+    switch (kind_) {
+      case Kind::kRank:
+        value = (api.ok && api.initialized()) ? api.rank()
+                                              : EnvInt("RANK", 0);
+        break;
+      case Kind::kSize:
+        value = (api.ok && api.initialized()) ? api.size()
+                                              : EnvInt("SIZE", 1);
+        break;
+      case Kind::kLocalRank:
+        value = EnvInt("LOCAL_RANK", 0);
+        break;
+      case Kind::kLocalSize:
+        value = EnvInt("LOCAL_SIZE", 1);
+        break;
+    }
+    output->scalar<int32_t>()() = value;
+  }
+
+ private:
+  static int EnvInt(const char* suffix, int fallback) {
+    for (const char* prefix : {"HVD_TPU_", "HOROVOD_"}) {
+      std::string name = std::string(prefix) + suffix;
+      const char* v = getenv(name.c_str());
+      if (v) return atoi(v);
+    }
+    return fallback;
+  }
+
+  Kind kind_;
+};
+
+#define HVD_QUERY_KERNEL(OPNAME, KIND)                                   \
+  class OPNAME##Kernel : public HvdTpuQueryOp {                          \
+   public:                                                               \
+    explicit OPNAME##Kernel(OpKernelConstruction* ctx)                   \
+        : HvdTpuQueryOp(ctx, HvdTpuQueryOp::Kind::KIND) {}               \
+  };
+
+HVD_QUERY_KERNEL(HvdTpuRank, kRank)
+HVD_QUERY_KERNEL(HvdTpuSize, kSize)
+HVD_QUERY_KERNEL(HvdTpuLocalRank, kLocalRank)
+HVD_QUERY_KERNEL(HvdTpuLocalSize, kLocalSize)
+
+#undef HVD_QUERY_KERNEL
+
 }  // namespace
+
+REGISTER_OP("HvdTpuRank").Output("rank: int32")
+    .SetShapeFn(tensorflow::shape_inference::ScalarShape)
+    .SetIsStateful();
+REGISTER_OP("HvdTpuSize").Output("size: int32")
+    .SetShapeFn(tensorflow::shape_inference::ScalarShape)
+    .SetIsStateful();
+REGISTER_OP("HvdTpuLocalRank").Output("local_rank: int32")
+    .SetShapeFn(tensorflow::shape_inference::ScalarShape)
+    .SetIsStateful();
+REGISTER_OP("HvdTpuLocalSize").Output("local_size: int32")
+    .SetShapeFn(tensorflow::shape_inference::ScalarShape)
+    .SetIsStateful();
+
+REGISTER_KERNEL_BUILDER(Name("HvdTpuRank").Device(tensorflow::DEVICE_CPU),
+                        HvdTpuRankKernel);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuSize").Device(tensorflow::DEVICE_CPU),
+                        HvdTpuSizeKernel);
+REGISTER_KERNEL_BUILDER(
+    Name("HvdTpuLocalRank").Device(tensorflow::DEVICE_CPU),
+    HvdTpuLocalRankKernel);
+REGISTER_KERNEL_BUILDER(
+    Name("HvdTpuLocalSize").Device(tensorflow::DEVICE_CPU),
+    HvdTpuLocalSizeKernel);
 
 REGISTER_OP("HvdTpuAllreduce")
     .Input("tensor: T")

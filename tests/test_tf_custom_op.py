@@ -20,6 +20,28 @@ def test_custom_op_library_loads():
     assert lib is not None, "hvd_tf_ops.so failed to build/load"
     assert hasattr(lib, "hvd_tpu_allreduce")
     assert hasattr(lib, "hvd_tpu_broadcast")
+    assert hasattr(lib, "hvd_tpu_size")
+
+
+def test_query_ops_read_live_env(monkeypatch):
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "3")
+    monkeypatch.setenv("HVD_TPU_LOCAL_SIZE", "4")
+    monkeypatch.setenv("HVD_TPU_RANK", "7")
+    monkeypatch.setenv("HVD_TPU_SIZE", "8")
+    # No native runtime attached → rank/size come from the env contract.
+    assert int(hvd.local_rank_op()) == 3
+    assert int(hvd.local_size_op()) == 4
+    assert int(hvd.size_op()) == 8
+    assert int(hvd.rank_op()) == 7
+    # Usable inside tf.function (graph mode).
+
+    @tf.function
+    def f():
+        return hvd.size_op() + hvd.rank_op()
+
+    assert int(f()) == 15
 
 
 TF_GRAPH_WORKER = textwrap.dedent("""
