@@ -81,6 +81,16 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
     import jax.numpy as jnp
     from jax import lax
 
+    # Contract (both paths): out.dtype == in.dtype.  Integer tensors that
+    # need fractional math (scaling, Average) compute in float32 and
+    # truncate once at the end — casting 0.5 to int32 would silently zero
+    # the result.
+    in_dtype = tensor.dtype
+    needs_float = (prescale_factor != 1.0 or postscale_factor != 1.0 or
+                   op == Average) and \
+        not jnp.issubdtype(in_dtype, jnp.inexact)
+    if needs_float:
+        tensor = tensor.astype(jnp.float32)
     if prescale_factor != 1.0:
         tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
     if op == Sum:
@@ -99,6 +109,8 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
         raise ValueError(f"unknown reduce op {op}")
     if postscale_factor != 1.0:
         out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    if out.dtype != in_dtype:
+        out = out.astype(in_dtype)
     return out
 
 
@@ -110,8 +122,14 @@ def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
     def fn(stack):
         import jax.numpy as jnp
         x = stack
+        # Fractional math on integer inputs runs in float32, truncated
+        # once by the final astype (same contract as the compiled path).
+        if (prescale_factor != 1.0 or postscale_factor != 1.0 or
+                op == Average) and \
+                not jnp.issubdtype(stack.dtype, jnp.inexact):
+            x = x.astype(jnp.float32)
         if prescale_factor != 1.0:
-            x = x * jnp.asarray(prescale_factor, dtype=stack.dtype)
+            x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
         if op == Sum:
             out = x.sum(axis=0)
         elif op == Average:
@@ -128,6 +146,11 @@ def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
             raise ValueError(f"unknown reduce op {op}")
         if postscale_factor != 1.0:
             out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+        # Dtype fidelity: integer reductions promote (numpy sums uint8/
+        # int32 to the platform int) — the contract is out.dtype ==
+        # in.dtype, like the wire backends.
+        if out.dtype != stack.dtype:
+            out = out.astype(stack.dtype)
         return out
     return fn
 
