@@ -82,15 +82,18 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
     from jax import lax
 
     # Contract (both paths): out.dtype == in.dtype.  Integer tensors that
-    # need fractional math (scaling, Average) compute in float32 and
+    # need fractional math (scaling, Average) compute in float and
     # truncate once at the end — casting 0.5 to int32 would silently zero
-    # the result.
+    # the result.  float64 (53-bit mantissa) keeps 32/64-bit integers
+    # exact where float32's 24 bits would corrupt values above 2^24.
     in_dtype = tensor.dtype
     needs_float = (prescale_factor != 1.0 or postscale_factor != 1.0 or
                    op == Average) and \
         not jnp.issubdtype(in_dtype, jnp.inexact)
     if needs_float:
-        tensor = tensor.astype(jnp.float32)
+        wide = jnp.float64 if jnp.dtype(in_dtype).itemsize >= 4 \
+            else jnp.float32
+        tensor = tensor.astype(wide)
     if prescale_factor != 1.0:
         tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
     if op == Sum:
@@ -122,12 +125,15 @@ def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
     def fn(stack):
         import jax.numpy as jnp
         x = stack
-        # Fractional math on integer inputs runs in float32, truncated
-        # once by the final astype (same contract as the compiled path).
+        # Fractional math on integer inputs runs in float (float64 for
+        # >=32-bit ints: exactness past 2^24), truncated once by the
+        # final astype (same contract as the compiled path).
         if (prescale_factor != 1.0 or postscale_factor != 1.0 or
                 op == Average) and \
                 not jnp.issubdtype(stack.dtype, jnp.inexact):
-            x = x.astype(jnp.float32)
+            x = x.astype(jnp.float64
+                         if jnp.dtype(stack.dtype).itemsize >= 4
+                         else jnp.float32)
         if prescale_factor != 1.0:
             x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
         if op == Sum:

@@ -67,11 +67,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    default=None)
     p.add_argument("--log-level", default=None)
     p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="print available frameworks/features and exit "
+                        "(reference horovodrun --check-build)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command (e.g. python train.py)")
     args = p.parse_args(argv)
     if args.config_file:
         _apply_config_file(args, p, args.config_file)
+    if args.check_build:
+        return args
     if not args.command:
         p.error("no worker command given")
     if args.command and args.command[0] == "--":
@@ -191,8 +196,58 @@ def run_elastic(args: argparse.Namespace) -> int:
     return run_elastic(args)
 
 
+def check_build() -> int:
+    """Available frameworks/features (reference horovodrun --check-build):
+    each probed live, not baked at build time."""
+    def probe(fn):
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001
+            return False
+
+    import importlib.util as iu
+
+    def has(mod):
+        return iu.find_spec(mod) is not None
+
+    def native_ok():
+        # Report built-ness only — a diagnostic must not trigger a build.
+        from ..native.controller import _lib_path
+        import os
+        return os.path.exists(_lib_path())
+
+    def tf_ops_ok():
+        import importlib
+        tfmod = importlib.import_module("horovod_tpu.tensorflow")
+        return tfmod._load_custom_ops() is not None
+
+    from .. import version
+    print(f"horovod_tpu v{version.__version__}\n")
+    print("Available frameworks:")
+    for label, mod in [("JAX", "jax"), ("TensorFlow", "tensorflow"),
+                       ("Keras", "keras"), ("PyTorch", "torch"),
+                       ("MXNet", "mxnet")]:
+        mark = "X" if probe(lambda m=mod: has(m)) else " "
+        print(f"    [{mark}] {label}")
+    print("\nAvailable runtime features:")
+    for label, fn in [
+            ("native eager runtime (TCP controller)", native_ok),
+            ("compiled TF op bridge (hvd_tf_ops.so)", tf_ops_ok),
+            ("XLA/ICI compiled collectives", lambda: has("jax")),
+            ("Pallas flash attention", lambda: has("jax")),
+            ("elastic training", lambda: True),
+            ("Adasum", lambda: True),
+            ("Spark integration", lambda: has("pyspark")),
+            ("Ray integration", lambda: has("ray"))]:
+        mark = "X" if probe(fn) else " "
+        print(f"    [{mark}] {label}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.check_build:
+        return check_build()
     if args.host_discovery_script or args.min_np or args.max_np:
         return run_elastic(args)
     return run_static(args)

@@ -392,7 +392,11 @@ def DistributedOptimizer(optimizer, op: int = Average, compression=None,
             raise ValueError(
                 "Adasum does not compose with backward_passes_per_step > 1 "
                 "(reference restriction)")
-        return _make_adasum_delta_optimizer(optimizer, compression)
+        if compression is not None and compression is not Compression.none:
+            raise ValueError(
+                "Adasum requires fp32/fp64 deltas (native runtime "
+                "restriction); wire compression is not supported")
+        return _make_adasum_delta_optimizer(optimizer, None)
 
     class _Wrapped(optimizer.__class__):
         _hvd_agg = (_LocalGradientAggregationHelper(backward_passes_per_step)

@@ -285,9 +285,102 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
         return self._opt.zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer(_torch.optim.Optimizer):
+    """Adasum delta-model optimizer (reference torch/optimizer.py:335-503):
+    stateful optimizers (momentum, Adam) emit update vectors that are not
+    plain gradients, so Adasum must combine the per-rank *weight deltas*.
+    Each step(): snapshot weights → local optimizer step → delta = new -
+    start → Adasum-allreduce deltas (submitted async for overlap on the
+    native path) → weights = start + combined delta.  Subclasses
+    torch.optim.Optimizer (delegation only) so LR schedulers' isinstance
+    checks pass, like _DistributedOptimizer."""
+
+    def __init__(self, optimizer, named_parameters=None):
+        self._opt = optimizer
+        all_params = [(i, j, p)
+                      for i, group in enumerate(optimizer.param_groups)
+                      for j, p in enumerate(group["params"])]
+        self._names = {p: f"param.{i}.{j}" for i, j, p in all_params}
+        if named_parameters is not None:
+            named = list(named_parameters)
+            names = [n for n, _p in named]
+            dups = {n for n in names if names.count(n) > 1}
+            if dups:
+                raise ValueError(f"duplicate parameter names: {dups}")
+            # Override the positional fallback; params outside the mapping
+            # keep their unique param.{group}.{index} name.
+            self._names.update({p: n for n, p in named})
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def step(self, closure=None):
+        params = [p for group in self._opt.param_groups
+                  for p in group["params"] if p.grad is not None]
+        starts = {p: p.data.clone() for p in params}
+        result = self._opt.step(closure)
+
+        ctl = global_state.controller
+        pending = []
+        for p in params:
+            name = "adasum.delta." + self._names[p]
+            # Deltas travel fp32/fp64 — the Adasum dot/norm math requires
+            # it (native restriction matches the reference's fp16 ban for
+            # CPU Adasum).
+            delta = p.data - starts[p]
+            if delta.dtype not in (_torch.float32, _torch.float64):
+                delta = delta.float()
+            d_np = np.ascontiguousarray(delta.detach().numpy())
+            if ctl is not None:
+                h = ctl.allreduce_async_(d_np, d_np, op=int(Adasum),
+                                         name=name)
+                pending.append((p, h, d_np))
+            else:
+                out = _C.allreduce(d_np, op=Adasum, name=name)
+                d_np[...] = np.asarray(out)
+                pending.append((p, None, d_np))
+        for p, h, d_np in pending:
+            if h is not None:
+                from ..ops.eager import _ctl
+                _ctl(ctl.wait, h)
+            reduced = _torch.from_numpy(d_np)
+            p.data.copy_(starts[p] + reduced.to(p.dtype))
+        return result
+
+    def synchronize(self):
+        """API parity with _DistributedOptimizer: deltas are synchronized
+        inside step(), so nothing is in flight between steps."""
+
+    def zero_grad(self, *args, **kwargs):
+        return self._opt.zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
                          prescale_factor=1.0, postscale_factor=1.0):
+    if op == Adasum:
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "Adasum does not compose with backward_passes_per_step > 1 "
+                "(reference restriction)")
+        if compression is not None and compression is not Compression.none:
+            raise ValueError(
+                "Adasum requires fp32/fp64 deltas (native runtime "
+                "restriction); wire compression is not supported")
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "prescale/postscale factors are not supported with Adasum "
+                "(deltas are combined, not summed)")
+        return _DistributedAdasumOptimizer(
+            optimizer, named_parameters=named_parameters)
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
         compression=compression,

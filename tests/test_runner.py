@@ -193,3 +193,13 @@ def test_run_api_propagates_failure():
     from horovod_tpu.runner import run
     with pytest.raises(RuntimeError, match="failed"):
         run(_failing_fn, np=1, controller_port=28733)
+
+
+def test_check_build_flag(capsys):
+    from horovod_tpu.runner.launch import main
+    rc = main(["--check-build"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Available frameworks" in out
+    assert "[X] JAX" in out
+    assert "native eager runtime" in out

@@ -1,0 +1,102 @@
+"""Torch Adasum delta-model optimizer (reference torch/optimizer.py:
+335-503): per-rank weight deltas combined with Adasum, correct with
+stateful optimizers."""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_adasum_delta_single_rank_matches_plain():
+    """At size 1 the combined delta equals the local delta: the wrapped
+    optimizer must match the unwrapped one exactly, momentum included."""
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    torch.manual_seed(3)
+    x = torch.randn(8, 3)
+    y = torch.randn(8, 2)
+    w0 = torch.randn(2, 3)
+
+    def train(wrap):
+        m = torch.nn.Linear(3, 2, bias=False)
+        with torch.no_grad():
+            m.weight.copy_(w0)
+        opt = torch.optim.SGD(m.parameters(), lr=0.1, momentum=0.9)
+        if wrap:
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=m.named_parameters(), op=hvd.Adasum)
+        for _ in range(3):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            opt.step()
+        return m.weight.detach().clone()
+
+    plain = train(False)
+    wrapped = train(True)
+    assert torch.allclose(plain, wrapped, rtol=1e-5, atol=1e-6), \
+        (plain, wrapped)
+
+
+def test_adasum_rejects_backward_passes():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    m = torch.nn.Linear(2, 1)
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1), op=hvd.Adasum,
+            backward_passes_per_step=2)
+
+
+ADASUM_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    m = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        m.weight.fill_(1.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=1.0),
+        named_parameters=m.named_parameters(), op=hvd.Adasum)
+
+    # Craft per-rank gradients: rank r's delta = -(r+1) * [1, 1].
+    # Parallel deltas -> Adasum averages them (reference semantics).
+    x = torch.full((1, 2), float(rank + 1))
+    opt.zero_grad()
+    m(x).sum().backward()
+    opt.step()
+    # local delta_r = -lr * grad = -(r+1)*[1,1]; adasum of parallel
+    # vectors ~ their average = -(mean r+1)*[1,1].
+    w = m.weight.detach().numpy().ravel()
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"w": w.tolist(), "size": size}}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(240)
+def test_adasum_2proc_combines_deltas(tmp_path):
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "res")
+    script = tmp_path / "worker.py"
+    script.write_text(ADASUM_WORKER.format(repo=REPO, outfile=outfile))
+    rc = main(["-np", "2", "--controller-port", "28941",
+               sys.executable, str(script)])
+    assert rc == 0
+    results = [json.load(open(f"{outfile}.{r}")) for r in range(2)]
+    # Parallel per-rank deltas -(1)*[1,1] and -(2)*[1,1] adasum-combine to
+    # their average -1.5*[1,1]: w = 1 - 1.5 = -0.5 on both ranks.
+    for res in results:
+        np.testing.assert_allclose(res["w"], [-0.5, -0.5], rtol=1e-4)
