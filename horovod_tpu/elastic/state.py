@@ -8,7 +8,8 @@ Capability parity with the reference (horovod/common/elastic.py:26-175):
 * ``State.sync()`` — broadcast state from rank 0 to (re)joining workers.
 * ``run(train_fn)`` — wraps a training function so collective failures
   restore state and re-rendezvous, and host-set changes re-rendezvous
-  without restore (HostsUpdatedInterrupt, skip_sync honored).
+  without restore (HostsUpdatedInterrupt).  ``sync()`` runs after every
+  reset regardless of the interrupt's skip hint — see run()'s docstring.
 
 TPU-native reset: instead of the reference's cheap ``shutdown(); init()``
 (tensorflow/elastic.py:64-66), the TPU backend re-creates the mesh (and, when
@@ -173,27 +174,33 @@ def _reset():
 
 def run(func: Callable) -> Callable:
     """Decorator running ``func(state, ...)`` under the elastic retry loop
-    (reference common/elastic.py:151-175)."""
+    (reference common/elastic.py:151-175).
+
+    Deviation from the reference: ``sync()`` runs after EVERY reset,
+    regardless of the interrupt's ``skip_sync`` hint.  Sync is a
+    collective — participation must be all-or-none per rendezvous round —
+    but different workers can reach the same round through different
+    paths (commit-time interrupt vs collective failure vs fresh spawn),
+    each carrying a different hint: honoring it deadlocks the round, with
+    newly-added workers waiting in sync while survivors proceed to the
+    next named collective.  One broadcast per round change is cheap
+    insurance."""
 
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
         notification_manager.init()
         notification_manager.register_listener(state)
-        skip_sync = False
         try:
             while True:
-                if not skip_sync:
-                    state.sync()
+                state.sync()
                 try:
                     return func(state, *args, **kwargs)
                 except HorovodInternalError:
                     log.warning("collective failure; restoring last "
                                 "committed state and re-initializing")
                     state.restore()
-                    skip_sync = False
-                except HostsUpdatedInterrupt as e:
+                except HostsUpdatedInterrupt:
                     log.info("host set updated; re-initializing")
-                    skip_sync = e.skip_sync
                 _reset()
                 state.on_reset()
         finally:

@@ -81,25 +81,28 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
     import jax.numpy as jnp
     from jax import lax
 
-    # Contract (both paths): out.dtype == in.dtype.  Integer tensors that
-    # need fractional math (scaling, Average) compute in float and
-    # truncate once at the end — casting 0.5 to int32 would silently zero
-    # the result.  float64 (53-bit mantissa) keeps 32/64-bit integers
-    # exact where float32's 24 bits would corrupt values above 2^24.
+    # Contract (both paths): out.dtype == in.dtype.  Integer Average is
+    # computed exactly in the integer domain (psum + floor-div) — float
+    # widening cannot promise exactness under jit, where float64
+    # canonicalizes to float32 unless x64 is enabled.  Fractional scale
+    # factors on integers still go through float (casting 0.5 into an int
+    # dtype would zero the reduction); values beyond the float mantissa
+    # are the caller's precision trade-off there.
     in_dtype = tensor.dtype
-    needs_float = (prescale_factor != 1.0 or postscale_factor != 1.0 or
-                   op == Average) and \
-        not jnp.issubdtype(in_dtype, jnp.inexact)
+    is_int = not jnp.issubdtype(in_dtype, jnp.inexact)
+    needs_float = (prescale_factor != 1.0 or postscale_factor != 1.0) \
+        and is_int
     if needs_float:
-        wide = jnp.float64 if jnp.dtype(in_dtype).itemsize >= 4 \
-            else jnp.float32
-        tensor = tensor.astype(wide)
+        tensor = tensor.astype(jnp.float32)
     if prescale_factor != 1.0:
         tensor = tensor * jnp.asarray(prescale_factor, dtype=tensor.dtype)
     if op == Sum:
         out = lax.psum(tensor, axis_name)
     elif op == Average:
-        out = lax.pmean(tensor, axis_name)
+        if is_int and not needs_float:
+            out = lax.psum(tensor, axis_name) // _axis_size(axis_name)
+        else:
+            out = lax.pmean(tensor, axis_name)
     elif op == Min:
         out = lax.pmin(tensor, axis_name)
     elif op == Max:
@@ -125,21 +128,24 @@ def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
     def fn(stack):
         import jax.numpy as jnp
         x = stack
-        # Fractional math on integer inputs runs in float (float64 for
-        # >=32-bit ints: exactness past 2^24), truncated once by the
-        # final astype (same contract as the compiled path).
-        if (prescale_factor != 1.0 or postscale_factor != 1.0 or
-                op == Average) and \
-                not jnp.issubdtype(stack.dtype, jnp.inexact):
-            x = x.astype(jnp.float64
-                         if jnp.dtype(stack.dtype).itemsize >= 4
-                         else jnp.float32)
+        # Same contract as the compiled path: integer Average stays exact
+        # in the integer domain (sum + floor-div); fractional scale
+        # factors on integers go through float32 with one trailing
+        # truncation.
+        is_int = not jnp.issubdtype(stack.dtype, jnp.inexact)
+        needs_float = (prescale_factor != 1.0 or
+                       postscale_factor != 1.0) and is_int
+        if needs_float:
+            x = x.astype(jnp.float32)
         if prescale_factor != 1.0:
             x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
         if op == Sum:
             out = x.sum(axis=0)
         elif op == Average:
-            out = x.mean(axis=0)
+            if is_int and not needs_float:
+                out = x.sum(axis=0) // x.shape[0]
+            else:
+                out = x.mean(axis=0)
         elif op == Min:
             out = x.min(axis=0)
         elif op == Max:

@@ -217,9 +217,14 @@ def check_build() -> int:
         return os.path.exists(_lib_path())
 
     def tf_ops_ok():
-        import importlib
-        tfmod = importlib.import_module("horovod_tpu.tensorflow")
-        return tfmod._load_custom_ops() is not None
+        # Existence only — the loader would build on a miss, and a
+        # diagnostic must not trigger a build.
+        import horovod_tpu.tensorflow as _unused  # noqa: F401  has TF?
+        import os
+        import horovod_tpu
+        return os.path.exists(os.path.join(
+            os.path.dirname(os.path.abspath(horovod_tpu.__file__)),
+            "tensorflow", "hvd_tf_ops.so"))
 
     from .. import version
     print(f"horovod_tpu v{version.__version__}\n")

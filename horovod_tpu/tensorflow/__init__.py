@@ -132,6 +132,12 @@ def _graph_bridge(np_fn, tensor, out_shape=None):
 
 _warned_trace_before_init = False
 
+# The compiled ops' registered T attr (hvd_tf_ops.cc); anything else
+# (e.g. bool) stays on the py_function bridge.
+_CUSTOM_OP_DTYPES = frozenset({
+    _tf.uint8, _tf.int8, _tf.int32, _tf.int64, _tf.half, _tf.float32,
+    _tf.float64, _tf.bfloat16})
+
 
 def _native_graph_ready() -> bool:
     """Whether graph-mode collectives can lower to the compiled custom op.
@@ -170,7 +176,7 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
     comp = compression or Compression.none
     t, ctx = comp.compress(tensor)
     if _is_symbolic(t):
-        if _native_graph_ready():
+        if _native_graph_ready() and t.dtype in _CUSTOM_OP_DTYPES:
             out = _load_custom_ops().hvd_tpu_allreduce(
                 t, op_code=int(op), prescale=prescale_factor,
                 postscale=postscale_factor, tensor_name=name or "")
@@ -188,6 +194,9 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
 
 def allgather(tensor, name: Optional[str] = None):
     if _is_symbolic(tensor):
+        if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
+            return _load_custom_ops().hvd_tpu_allgather(
+                tensor, tensor_name=name or "")
         return _graph_bridge(
             lambda x: np.asarray(_C.allgather(x, name=name)),
             tensor, out_shape=_tf.TensorShape(
@@ -197,7 +206,7 @@ def allgather(tensor, name: Optional[str] = None):
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     if _is_symbolic(tensor):
-        if _native_graph_ready():
+        if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
             return _load_custom_ops().hvd_tpu_broadcast(
                 tensor, root_rank=root_rank, tensor_name=name or "")
         return _graph_bridge(
@@ -207,6 +216,23 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
+    if _is_symbolic(tensor):
+        if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
+            splits_t = _tf.constant([], dtype=_tf.int64) if splits is None \
+                else _tf.cast(_tf.convert_to_tensor(splits), _tf.int64)
+            return _load_custom_ops().hvd_tpu_alltoall(
+                tensor, splits_t, tensor_name=name or "")
+
+        # py_function fallback (two outputs), like the sibling collectives.
+        def np_fn(x):
+            out, rs = _C.alltoall(x.numpy(), splits=splits, name=name)
+            return np.asarray(out), np.asarray(rs, dtype=np.int32)
+
+        out, recv = _tf.py_function(np_fn, [tensor],
+                                    [tensor.dtype, _tf.int32])
+        out.set_shape(_tf.TensorShape([None] + list(tensor.shape)[1:]))
+        recv.set_shape(_tf.TensorShape([None]))
+        return out, recv
     out, recv_splits = _C.alltoall(_np(tensor), splits=splits, name=name)
     return _to_tf(out), _to_tf(recv_splits)
 

@@ -35,6 +35,13 @@ struct NativeApi {
                        const int64_t*, int, int, double, double) = nullptr;
   int64_t (*broadcast)(const char*, const void*, void*, int,
                        const int64_t*, int, int) = nullptr;
+  int64_t (*allgather)(const char*, const void*, int, const int64_t*,
+                       int) = nullptr;
+  int64_t (*alltoall)(const char*, const void*, int, const int64_t*, int,
+                      const int64_t*, int) = nullptr;
+  int64_t (*result_bytes)(int64_t) = nullptr;
+  int (*result_dims)(int64_t, int64_t*, int) = nullptr;
+  int (*result_copy)(int64_t, void*, int64_t) = nullptr;
   int (*wait)(int64_t) = nullptr;
   void (*release)(int64_t) = nullptr;
   const char* (*last_error)() = nullptr;
@@ -67,6 +74,16 @@ const NativeApi& Api() {
         resolve("hvd_native_allreduce"));
     a.broadcast = reinterpret_cast<decltype(a.broadcast)>(
         resolve("hvd_native_broadcast"));
+    a.allgather = reinterpret_cast<decltype(a.allgather)>(
+        resolve("hvd_native_allgather"));
+    a.alltoall = reinterpret_cast<decltype(a.alltoall)>(
+        resolve("hvd_native_alltoall"));
+    a.result_bytes = reinterpret_cast<decltype(a.result_bytes)>(
+        resolve("hvd_native_result_bytes"));
+    a.result_dims = reinterpret_cast<decltype(a.result_dims)>(
+        resolve("hvd_native_result_dims"));
+    a.result_copy = reinterpret_cast<decltype(a.result_copy)>(
+        resolve("hvd_native_result_copy"));
     a.wait = reinterpret_cast<decltype(a.wait)>(resolve("hvd_native_wait"));
     a.release = reinterpret_cast<decltype(a.release)>(
         resolve("hvd_native_release"));
@@ -214,6 +231,156 @@ class HvdTpuBroadcastOp : public AsyncOpKernel {
   std::string tensor_name_;
 };
 
+class HvdTpuAllgatherOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAllgatherOp(OpKernelConstruction* ctx)
+      : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+    if (tensor_name_.empty()) tensor_name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const NativeApi& api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok,
+                      Internal("hvd native runtime: ", LastError()), done);
+    OP_REQUIRES_ASYNC(ctx, api.initialized(),
+                      Internal("hvd native runtime not initialized"), done);
+    const Tensor& input = ctx->input(0);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      Internal("unsupported dtype for hvd allgather"),
+                      done);
+    int ndim = input.dims();
+    std::vector<int64_t> dims(std::max(ndim, 1), 1);
+    for (int i = 0; i < ndim; ++i) dims[i] = input.dim_size(i);
+    int64_t h = api.allgather(tensor_name_.c_str(),
+                              input.tensor_data().data(), ndim, dims.data(),
+                              code);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("allgather enqueue: ", LastError()), done);
+    // The variable-size output is allocated after completion, from the
+    // negotiated per-rank first dims.
+    tensorflow::TensorShape trailing = input.shape();
+    if (trailing.dims() > 0) trailing.RemoveDim(0);
+    tensorflow::Env::Default()->SchedClosure(
+        [ctx, done = std::move(done), h, &api, trailing]() {
+          if (api.wait(h) != 0) {
+            api.release(h);
+            ctx->SetStatus(Internal("allgather: ", LastError()));
+            done();
+            return;
+          }
+          std::vector<int64_t> first(api.size(), 0);
+          api.result_dims(h, first.data(), api.size());
+          int64_t rows = 0;
+          for (int64_t f : first) rows += f;
+          tensorflow::TensorShape out_shape;
+          out_shape.AddDim(rows);
+          out_shape.AppendShape(trailing);
+          Tensor* output = nullptr;
+          auto st = ctx->allocate_output(0, out_shape, &output);
+          if (!st.ok()) {
+            api.release(h);
+            ctx->SetStatus(st);
+            done();
+            return;
+          }
+          int rc = api.result_copy(
+              h, const_cast<char*>(output->tensor_data().data()),
+              static_cast<int64_t>(output->tensor_data().size()));
+          api.release(h);
+          if (rc != 0) ctx->SetStatus(Internal("allgather result copy"));
+          done();
+        });
+  }
+
+ private:
+  std::string tensor_name_;
+};
+
+class HvdTpuAlltoallOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAlltoallOp(OpKernelConstruction* ctx)
+      : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+    if (tensor_name_.empty()) tensor_name_ = name();
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const NativeApi& api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok,
+                      Internal("hvd native runtime: ", LastError()), done);
+    OP_REQUIRES_ASYNC(ctx, api.initialized(),
+                      Internal("hvd native runtime not initialized"), done);
+    const Tensor& input = ctx->input(0);
+    const Tensor& splits_in = ctx->input(1);
+    int code = DtypeCode(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, code >= 0,
+                      Internal("unsupported dtype for hvd alltoall"), done);
+    int world = api.size();
+    std::vector<int64_t> splits;
+    if (splits_in.NumElements() == 0) {
+      OP_REQUIRES_ASYNC(
+          ctx, input.dim_size(0) % world == 0,
+          Internal("alltoall dim0 not divisible by world size"), done);
+      splits.assign(world, input.dim_size(0) / world);
+    } else {
+      auto flat = splits_in.flat<int64_t>();
+      for (int i = 0; i < flat.size(); ++i) splits.push_back(flat(i));
+    }
+    int ndim = input.dims();
+    std::vector<int64_t> dims(std::max(ndim, 1), 1);
+    for (int i = 0; i < ndim; ++i) dims[i] = input.dim_size(i);
+    int64_t h = api.alltoall(tensor_name_.c_str(),
+                             input.tensor_data().data(), ndim, dims.data(),
+                             code, splits.data(),
+                             static_cast<int>(splits.size()));
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("alltoall enqueue: ", LastError()), done);
+    tensorflow::TensorShape trailing = input.shape();
+    if (trailing.dims() > 0) trailing.RemoveDim(0);
+    tensorflow::Env::Default()->SchedClosure(
+        [ctx, done = std::move(done), h, &api, trailing, world]() {
+          if (api.wait(h) != 0) {
+            api.release(h);
+            ctx->SetStatus(Internal("alltoall: ", LastError()));
+            done();
+            return;
+          }
+          std::vector<int64_t> recv(world, 0);
+          api.result_dims(h, recv.data(), world);
+          int64_t rows = 0;
+          for (int64_t r : recv) rows += r;
+          tensorflow::TensorShape out_shape;
+          out_shape.AddDim(rows);
+          out_shape.AppendShape(trailing);
+          Tensor* output = nullptr;
+          Tensor* recv_splits = nullptr;
+          auto st = ctx->allocate_output(0, out_shape, &output);
+          if (st.ok())
+            st = ctx->allocate_output(
+                1, tensorflow::TensorShape({world}), &recv_splits);
+          if (!st.ok()) {
+            api.release(h);
+            ctx->SetStatus(st);
+            done();
+            return;
+          }
+          int rc = api.result_copy(
+              h, const_cast<char*>(output->tensor_data().data()),
+              static_cast<int64_t>(output->tensor_data().size()));
+          api.release(h);
+          for (int i = 0; i < world; ++i)
+            recv_splits->flat<int64_t>()(i) = recv[i];
+          if (rc != 0) ctx->SetStatus(Internal("alltoall result copy"));
+          done();
+        });
+  }
+
+ private:
+  std::string tensor_name_;
+};
+
 // Scalar topology query ops (reference HorovodSize/Rank/LocalRank/
 // LocalSize, tensorflow/mpi_ops.cc:787-867): graph-time constants would
 // bake a world size into elastic graphs; these read the live runtime
@@ -321,9 +488,53 @@ REGISTER_OP("HvdTpuBroadcast")
     .Attr("tensor_name: string = ''")
     .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
 
+REGISTER_OP("HvdTpuAllgather")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, int32, int64, half, float, double, bfloat16}")
+    .Attr("tensor_name: string = ''")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      tensorflow::shape_inference::ShapeHandle trailing;
+      TF_RETURN_IF_ERROR(c->Subshape(c->input(0), 1, &trailing));
+      tensorflow::shape_inference::ShapeHandle first =
+          c->Vector(tensorflow::shape_inference::InferenceContext::
+                        kUnknownDim);
+      tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->Concatenate(first, trailing, &out));
+      c->set_output(0, out);
+      return absl::OkStatus();
+    });
+
+REGISTER_OP("HvdTpuAlltoall")
+    .Input("tensor: T")
+    .Input("splits: int64")
+    .Output("output: T")
+    .Output("received_splits: int64")
+    .Attr("T: {uint8, int8, int32, int64, half, float, double, bfloat16}")
+    .Attr("tensor_name: string = ''")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      tensorflow::shape_inference::ShapeHandle trailing;
+      TF_RETURN_IF_ERROR(c->Subshape(c->input(0), 1, &trailing));
+      tensorflow::shape_inference::ShapeHandle first =
+          c->Vector(tensorflow::shape_inference::InferenceContext::
+                        kUnknownDim);
+      tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->Concatenate(first, trailing, &out));
+      c->set_output(0, out);
+      c->set_output(1, c->Vector(
+          tensorflow::shape_inference::InferenceContext::kUnknownDim));
+      return absl::OkStatus();
+    });
+
 REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce")
                             .Device(tensorflow::DEVICE_CPU),
                         HvdTpuAllreduceOp);
 REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast")
                             .Device(tensorflow::DEVICE_CPU),
                         HvdTpuBroadcastOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllgather")
+                            .Device(tensorflow::DEVICE_CPU),
+                        HvdTpuAllgatherOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAlltoall")
+                            .Device(tensorflow::DEVICE_CPU),
+                        HvdTpuAlltoallOp);

@@ -322,9 +322,20 @@ class _DistributedAdasumOptimizer(_torch.optim.Optimizer):
     def state(self):
         return self._opt.state
 
+    def _ensure_names(self):
+        """Params added after construction (add_param_group) get
+        deterministic positional names — identical across ranks."""
+        for i, group in enumerate(self._opt.param_groups):
+            for j, p in enumerate(group["params"]):
+                self._names.setdefault(p, f"param.{i}.{j}")
+
     def step(self, closure=None):
+        self._ensure_names()
+        # Snapshot every param, not just those with grads: a closure may
+        # compute gradients inside self._opt.step() (LBFGS pattern), and
+        # every rank must reduce the same delta set for name matching.
         params = [p for group in self._opt.param_groups
-                  for p in group["params"] if p.grad is not None]
+                  for p in group["params"]]
         starts = {p: p.data.clone() for p in params}
         result = self._opt.step(closure)
 

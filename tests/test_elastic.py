@@ -116,3 +116,71 @@ def test_elastic_completes_without_failures(tmp_path):
     events = _read_logs(log, ["localhost:0", "localhost:1"])
     assert len([e for e in events if e["epoch"] == 2]) == 2
     assert all(e["size"] == 2 and e["sum"] == 3.0 for e in events)
+
+
+SCALEUP_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    LOG = {log!r}
+
+    hvd.init()
+    state = elastic.ObjectState(epoch=0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < {epochs}:
+            time.sleep(0.4)  # give the driver time to grow the host set
+            x = np.full((4,), float(hvd.rank() + 1), dtype=np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"ep.{{state.epoch}}")
+            with open(LOG + f".{{os.environ['HVD_TPU_ELASTIC_SLOT']}}",
+                      "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size()}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+    train(state)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(300)
+def test_elastic_scale_up_adds_worker(tmp_path):
+    """Host capacity grows mid-run: survivors take the
+    HostsUpdatedInterrupt at commit, re-rendezvous, and later epochs run
+    with the larger world (reference discovery/driver.py host-add path)."""
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(SCALEUP_WORKER.format(repo=REPO, log=log, epochs=8))
+    discovery = FixedHosts([HostInfo("localhost", 2)])
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    driver = ElasticDriver(
+        discovery, [sys.executable, str(script)],
+        min_np=2, max_np=3, controller_base_port=28500, verbose=True)
+
+    def grow():
+        import time as _t
+        # Grow only after at least one epoch logged at the initial size,
+        # so both world sizes demonstrably ran.
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            if _read_logs(log, ["localhost:0", "localhost:1"]):
+                break
+            _t.sleep(0.2)
+        discovery.set([HostInfo("localhost", 3)])
+
+    t = threading.Thread(target=grow, daemon=True)
+    t.start()
+    rc = driver.run()
+    assert rc == 0
+    events = _read_logs(log, [f"localhost:{i}" for i in range(3)])
+    sizes = {e["size"] for e in events}
+    assert 2 in sizes, "never ran at the initial world size"
+    assert 3 in sizes, "the added worker never joined a round"
+    # Final epoch completed by all 3 ranks.
+    finals = [e for e in events if e["epoch"] == 7]
+    assert len(finals) == 3 and all(e["size"] == 3 for e in finals)

@@ -71,6 +71,28 @@ TF_GRAPH_WORKER = textwrap.dedent("""
     bout = bcasted(tf.fill((3,), float(rank * 10)))
     assert np.allclose(bout.numpy(), 10.0), bout.numpy()
 
+    # Allgather with unequal first dims through the compiled op: rank r
+    # contributes r+1 rows valued r.
+    @tf.function
+    def gathered(x):
+        return hvd.allgather(x, name="g.ag")
+
+    g = gathered(tf.fill((rank + 1, 2), float(rank)))
+    assert g.shape[0] == 3, g.shape  # 1 + 2 rows
+    assert np.allclose(g.numpy()[0], 0.0) and np.allclose(g.numpy()[1:], 1.0)
+
+    # Alltoall (equal splits) through the compiled op: rank r sends row d
+    # valued r*size+d to rank d.
+    @tf.function
+    def exchanged(x):
+        return hvd.alltoall(x, name="g.a2a")
+
+    vals = tf.constant([[float(rank * size + d)] for d in range(size)])
+    out_a2a, recv = exchanged(vals)
+    assert recv.numpy().tolist() == [1, 1]
+    assert np.allclose(out_a2a.numpy().ravel(),
+                       [float(s * size + rank) for s in range(size)])
+
     with open({outfile!r} + f".{{rank}}", "w") as f:
         json.dump({{"ok": True,
                     "custom_op": "HvdTpuAllreduce" in op_types,
