@@ -31,19 +31,32 @@ def _worker_main(fn, args, kwargs, env, q, rank):
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
-        np: int = 1, use_mpi: Optional[bool] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        use_mpi: Optional[bool] = None,
         use_gloo: Optional[bool] = None,
         controller_port: int = 28500,
-        env: Optional[dict] = None) -> List[Any]:
-    """Run ``fn`` as ``np`` distributed ranks on this host and return the
-    list of per-rank results (rank order).
+        env: Optional[dict] = None,
+        work_dir: Optional[str] = None,
+        worker_platform: str = "cpu") -> List[Any]:
+    """Run ``fn`` as ``np`` distributed ranks and return the list of
+    per-rank results (rank order).
+
+    Without ``hosts``: ``np`` local processes (multiprocessing spawn).
+    With ``hosts`` ("h1:2,h2:2" like hvdrun -H): ``fn`` is cloudpickled
+    into ``work_dir`` (must be visible on every host — defaults to a
+    local temp dir, correct for localhost slot lists) and executed
+    through the same launcher/ssh machinery as ``hvdrun``, the reference's
+    per-host fn semantics (runner/__init__.py:92).
 
     ``use_mpi``/``use_gloo`` are accepted for reference signature
-    compatibility (runner/__init__.py:92); the controller here is always
-    the TCP (gloo-analog) one — there is no MPI dependency on TPU VMs.
+    compatibility; the controller here is always the TCP (gloo-analog)
+    one — there is no MPI dependency on TPU VMs.
     """
     del use_mpi, use_gloo
     kwargs = kwargs or {}
+    if hosts is not None:
+        return _run_on_hosts(fn, args, kwargs, np, hosts, controller_port,
+                             env, work_dir, worker_platform)
     hostname = socket.gethostname()
     slots = get_host_assignments([HostInfo(hostname, np)], np)
     controller_addr = f"{hostname}:{controller_port}"
@@ -96,3 +109,62 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             if p.is_alive():
                 p.terminate()
     return [results[r] for r in sorted(results)]
+
+
+def _run_on_hosts(fn, args, kwargs, np_, hosts, controller_port, env,
+                  work_dir, worker_platform):
+    """Spawn fn-workers across a host list through the launcher machinery
+    (rendezvous + slot env + ssh/local exec), collecting per-rank result
+    pickles from the shared work dir.  ``worker_platform`` defaults to
+    "cpu": the calling process may already hold the local accelerator
+    (the same guard the local multiprocessing path applies); pass "auto"
+    to let workers partition/inherit chips."""
+    import shutil
+    import sys
+    import tempfile
+
+    from . import exec as exec_mod
+    from .fnpickle import collect_results, dump_payload
+    from .hosts import parse_hosts
+    from .launch import _controller_addr
+    from .probe import advertised_host
+    from .rendezvous import RendezvousServer, generate_secret
+
+    host_infos = parse_hosts(hosts)
+    slots = get_host_assignments(host_infos, np_)
+    controller_addr = _controller_addr(host_infos, controller_port)
+
+    own_tmp = work_dir is None
+    work_dir = work_dir or tempfile.mkdtemp(prefix="hvd_run_")
+    payload_path, results_dir = dump_payload(work_dir, fn, args, kwargs)
+
+    secret = generate_secret()
+    rendezvous = RendezvousServer(secret=secret)
+    rdv_port = rendezvous.start()
+    rdv_host = advertised_host(
+        [h.hostname for h in host_infos
+         if not exec_mod._is_local(h.hostname)])
+    extra_env = {
+        "HVD_TPU_RENDEZVOUS_ADDR": f"{rdv_host}:{rdv_port}",
+        "HVD_TPU_RENDEZVOUS_SECRET": secret,
+    }
+    extra_env.update(env or {})
+    command = [sys.executable, "-m", "horovod_tpu.runner.fn_exec",
+               payload_path, results_dir]
+    try:
+        workers = exec_mod.launch_workers(slots, command, controller_addr,
+                                          extra_env=extra_env,
+                                          platform_policy=worker_platform)
+        rc = exec_mod.wait_all(workers)
+        if rc != 0:
+            raise RuntimeError(f"run(fn) workers failed (exit {rc})")
+        results = collect_results(results_dir)
+        if len(results) != len(slots):
+            raise RuntimeError(
+                f"collected {len(results)} results for {len(slots)} ranks "
+                f"(work_dir {work_dir} must be visible on every host)")
+        return results
+    finally:
+        rendezvous.stop()
+        if own_tmp:
+            shutil.rmtree(work_dir, ignore_errors=True)

@@ -18,7 +18,6 @@ Capability parity with the reference horovod.spark (spark/runner.py):
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import sys
 import tempfile
@@ -132,9 +131,8 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
 
     ``hosts`` overrides executor discovery (test seam / static clusters).
     """
-    import cloudpickle
-
     from ..runner.elastic_driver import ElasticDriver, FixedHosts
+    from ..runner.fnpickle import collect_results, dump_payload
 
     kwargs = kwargs or {}
     num_proc = num_proc or (sum(h.slots for h in hosts) if hosts else 1)
@@ -144,12 +142,7 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
 
     own_tmp = work_dir is None
     work_dir = work_dir or tempfile.mkdtemp(prefix="hvd_spark_elastic_")
-    payload_path = os.path.join(work_dir, "payload.pkl")
-    results_dir = os.path.join(work_dir, "results")
-    os.makedirs(results_dir, exist_ok=True)
-    with open(payload_path, "wb") as f:
-        cloudpickle.dump({"fn": fn, "args": tuple(args),
-                          "kwargs": dict(kwargs)}, f)
+    payload_path, results_dir = dump_payload(work_dir, fn, args, kwargs)
 
     command = [sys.executable, "-m", "horovod_tpu.spark.elastic_exec",
                payload_path, results_dir]
@@ -160,16 +153,7 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
     if rc != 0:
         raise RuntimeError(f"elastic spark job failed (exit {rc})")
 
-    results = []
-    # Only finalized results: a worker killed mid-write (the failure mode
-    # elastic exists for) leaves an orphaned .rank_N.tmp behind.
-    for name in sorted(os.listdir(results_dir)):
-        if not (name.startswith("rank_") and name.endswith(".pkl")):
-            continue
-        with open(os.path.join(results_dir, name), "rb") as f:
-            results.append(pickle.load(f))
-    results.sort(key=lambda rv: rv[0])
-    out = [v for _r, v in results]
+    out = collect_results(results_dir)
     if own_tmp:
         import shutil
         shutil.rmtree(work_dir, ignore_errors=True)

@@ -1,31 +1,23 @@
 """Elastic Spark worker main (spawned by spark.run_elastic through the
-elastic driver): loads the pickled user function, runs it as this rank, and
+elastic driver): runs the cloudpickled user function as this rank and
 drops the (rank, result) pickle into the shared results directory."""
 
 from __future__ import annotations
 
-import os
-import pickle
 import sys
+
+from ..runner.fnpickle import load_payload, write_result
 
 
 def main(payload_path: str, results_dir: str) -> int:
-    import cloudpickle
-
-    with open(payload_path, "rb") as f:
-        payload = cloudpickle.load(f)
-
+    payload = load_payload(payload_path)
     result = payload["fn"](*payload["args"], **payload["kwargs"])
 
     # global_state keeps the last assignment's topology across the user
     # fn's own shutdown() (reset() clears only mesh/controller/initialized)
     # — hvd.rank() itself refuses to answer post-shutdown.
     from horovod_tpu.core.state import global_state
-    rank = global_state.rank
-    tmp = os.path.join(results_dir, f".rank_{rank}.tmp")
-    with open(tmp, "wb") as f:
-        pickle.dump((rank, result), f)
-    os.replace(tmp, os.path.join(results_dir, f"rank_{rank}.pkl"))
+    write_result(results_dir, global_state.rank, result)
     return 0
 
 

@@ -27,7 +27,7 @@ setup(
     packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
     package_data={"horovod_tpu.native": ["libhvdtpu_core.so"]},
     python_requires=">=3.10",
-    install_requires=["numpy", "jax", "optax"],
+    install_requires=["numpy", "jax", "optax", "cloudpickle"],
     entry_points={
         "console_scripts": [
             "hvdrun = horovod_tpu.runner.launch:main",

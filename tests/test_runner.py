@@ -203,3 +203,26 @@ def test_check_build_flag(capsys):
     assert "Available frameworks" in out
     assert "[X] JAX" in out
     assert "native eager runtime" in out
+
+
+@pytest.mark.timeout(240)
+def test_run_api_with_hosts(tmp_path):
+    """run(fn, hosts=...) spawns through the launcher machinery (the
+    reference's per-host fn semantics) and returns rank-ordered results."""
+    from horovod_tpu import runner
+
+    def fn(mult):
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        out = hvd.allreduce(np.full((2,), float(hvd.rank() + 1),
+                                    dtype=np.float32), op=hvd.Sum)
+        r = hvd.rank()
+        hvd.shutdown()
+        return (r, float(np.asarray(out)[0]) * mult)
+
+    results = runner.run(fn, args=(10.0,), np=2, hosts="localhost:2",
+                         controller_port=28640,
+                         work_dir=str(tmp_path / "wd"))
+    assert [r for r, _v in results] == [0, 1]
+    assert all(v == 30.0 for _r, v in results)
