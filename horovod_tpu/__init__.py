@@ -19,6 +19,8 @@ from .core.basics import (
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, process_rank, process_count, mesh,
     is_homogeneous, mpi_threads_supported, start_timeline, stop_timeline,
+    mpi_built, gloo_built, nccl_built, ddl_built, ccl_built, cuda_built,
+    rocm_built,
 )
 from .core.exceptions import (
     HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
@@ -44,8 +46,10 @@ __all__ = [
     "__version__",
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "process_rank",
-    "process_count", "mesh", "is_homogeneous", "mpi_threads_supported", "start_timeline",
-    "stop_timeline",
+    "process_count", "mesh", "is_homogeneous", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
+    "mpi_built", "gloo_built", "nccl_built", "ddl_built", "ccl_built",
+    "cuda_built", "rocm_built",
     "HorovodTpuError", "HorovodInternalError", "HostsUpdatedInterrupt",
     "NotInitializedError", "DuplicateNameError",
     "Average", "Sum", "Adasum", "Min", "Max", "Product",

@@ -236,6 +236,39 @@ def mpi_threads_supported() -> bool:
     return False
 
 
+# Build-capability queries (reference common/util.py:137-220): scripts
+# branch on these to pick a controller/ops stack.  On TPU the answers are
+# static: the TCP controller is the gloo-analog control plane; there is no
+# MPI/NCCL/CUDA/ROCm/oneCCL/DDL in the loop.
+
+def mpi_built(verbose: bool = False) -> bool:
+    return False
+
+
+def gloo_built(verbose: bool = False) -> bool:
+    return True  # the TCP controller + rendezvous fills the Gloo role
+
+
+def nccl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def ddl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def ccl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def cuda_built(verbose: bool = False) -> bool:
+    return False
+
+
+def rocm_built(verbose: bool = False) -> bool:
+    return False
+
+
 def start_timeline(filename: str, mark_cycles: bool = False) -> None:
     """Start Chrome-trace timeline recording at runtime (reference
     horovod_start_timeline, operations.cc:740-769).  Requires the native

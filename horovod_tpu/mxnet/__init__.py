@@ -23,7 +23,11 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..core.basics import (init, shutdown, is_initialized, rank, size,
-                           local_rank, local_size, cross_rank, cross_size)
+                           local_rank, local_size, cross_rank,
+                           cross_size, mpi_built, gloo_built,
+                           nccl_built, ddl_built, ccl_built,
+                           cuda_built, rocm_built,
+                           mpi_threads_supported)  # noqa: F401
 from ..ops.collective import Average, Sum, Adasum, Min, Max, Product
 from ..ops import collective as _C
 from ..optimizers import broadcast_object, allgather_object  # noqa: F401

@@ -126,9 +126,7 @@ def _run_on_hosts(fn, args, kwargs, np_, hosts, controller_port, env,
     from . import exec as exec_mod
     from .fnpickle import collect_results, dump_payload
     from .hosts import parse_hosts
-    from .launch import _controller_addr
-    from .probe import advertised_host
-    from .rendezvous import RendezvousServer, generate_secret
+    from .launch import _controller_addr, start_rendezvous
 
     host_infos = parse_hosts(hosts)
     slots = get_host_assignments(host_infos, np_)
@@ -138,16 +136,7 @@ def _run_on_hosts(fn, args, kwargs, np_, hosts, controller_port, env,
     work_dir = work_dir or tempfile.mkdtemp(prefix="hvd_run_")
     payload_path, results_dir = dump_payload(work_dir, fn, args, kwargs)
 
-    secret = generate_secret()
-    rendezvous = RendezvousServer(secret=secret)
-    rdv_port = rendezvous.start()
-    rdv_host = advertised_host(
-        [h.hostname for h in host_infos
-         if not exec_mod._is_local(h.hostname)])
-    extra_env = {
-        "HVD_TPU_RENDEZVOUS_ADDR": f"{rdv_host}:{rdv_port}",
-        "HVD_TPU_RENDEZVOUS_SECRET": secret,
-    }
+    rendezvous, extra_env = start_rendezvous(host_infos)
     extra_env.update(env or {})
     command = [sys.executable, "-m", "horovod_tpu.runner.fn_exec",
                payload_path, results_dir]

@@ -18,6 +18,14 @@ def dump_payload(work_dir: str, fn: Callable, args: tuple,
     payload_path = os.path.join(work_dir, "payload.pkl")
     results_dir = os.path.join(work_dir, "results")
     os.makedirs(results_dir, exist_ok=True)
+    # Purge leftovers from a reused work_dir: stale rank_N.pkl files from
+    # a previous (larger) run would be collected as this run's results.
+    for name in os.listdir(results_dir):
+        if name.endswith(".pkl") or name.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(results_dir, name))
+            except OSError:
+                pass
     with open(payload_path, "wb") as f:
         cloudpickle.dump({"fn": fn, "args": tuple(args),
                           "kwargs": dict(kwargs)}, f)

@@ -155,26 +155,36 @@ def _controller_addr(hosts: List[HostInfo], port: int) -> str:
     return f"{first}:{port}"
 
 
+def start_rendezvous(hosts: List[HostInfo],
+                     ssh_port: Optional[int] = None):
+    """Per-launch rendezvous bring-up shared by every launch path: HMAC
+    secret, KV server, and a driver address NIC-probed so every remote
+    host can route to it (reference driver_service.py:49-218 —
+    gethostname() may resolve to an unreachable interface on multi-NIC
+    machines).  Returns (server, worker_env_fragment)."""
+    from .probe import advertised_host
+    from .rendezvous import generate_secret
+    secret = generate_secret()
+    rendezvous = RendezvousServer(secret=secret)
+    rdv_port = rendezvous.start()
+    rdv_host = advertised_host(
+        [h.hostname for h in hosts if not exec_mod._is_local(h.hostname)],
+        ssh_port=ssh_port)
+    return rendezvous, {
+        "HVD_TPU_RENDEZVOUS_ADDR": f"{rdv_host}:{rdv_port}",
+        "HVD_TPU_RENDEZVOUS_SECRET": secret,
+    }
+
+
 def run_static(args: argparse.Namespace) -> int:
     hosts = resolve_hosts(args)
     np_ = args.num_proc or sum(h.slots for h in hosts)
     slots = get_host_assignments(hosts, np_)
     controller_addr = _controller_addr(hosts, args.controller_port)
 
-    from .rendezvous import generate_secret
-    secret = generate_secret()
-    rendezvous = RendezvousServer(secret=secret)
-    rdv_port = rendezvous.start()
+    rendezvous, rdv_env = start_rendezvous(hosts, ssh_port=args.ssh_port)
     extra_env = knob_env(args)
-    # Advertise a driver address every remote host can actually route to
-    # (NIC matching; reference driver_service.py:49-218) — gethostname()
-    # may resolve to an unreachable interface on multi-NIC machines.
-    from .probe import advertised_host
-    rdv_host = advertised_host(
-        [h.hostname for h in hosts if not exec_mod._is_local(h.hostname)],
-        ssh_port=args.ssh_port)
-    extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{rdv_port}"
-    extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = secret
+    extra_env.update(rdv_env)
     rendezvous.put("global", "controller", controller_addr.encode())
 
     if args.verbose:

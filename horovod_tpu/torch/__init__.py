@@ -22,7 +22,11 @@ import numpy as np
 import torch as _torch
 
 from ..core.basics import (init, shutdown, is_initialized, rank, size,
-                           local_rank, local_size, cross_rank, cross_size)
+                           local_rank, local_size, cross_rank,
+                           cross_size, mpi_built, gloo_built,
+                           nccl_built, ddl_built, ccl_built,
+                           cuda_built, rocm_built,
+                           mpi_threads_supported)  # noqa: F401
 from ..core.state import global_state
 from ..ops.collective import (Average, Sum, Adasum, Min, Max, Product)
 from ..ops import collective as _C
@@ -176,13 +180,15 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
 
     def __init__(self, optimizer, named_parameters=None, op=Average,
                  compression=None, backward_passes_per_step=1,
-                 prescale_factor=1.0, postscale_factor=1.0):
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 sparse_as_dense=False):
         self._opt = optimizer
         self.op = op
         self._compression = compression or Compression.none
         self._bpps = backward_passes_per_step
         self._prescale = prescale_factor
         self._postscale = postscale_factor
+        self._sparse_as_dense = sparse_as_dense
         if named_parameters is not None:
             named = list(named_parameters)
         else:
@@ -243,17 +249,41 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
         """Fire the wire-side allreduce for p.grad.  Compression (reference
         torch/compression.py) converts the payload to its wire dtype (e.g.
         fp16) before transport; synchronize() decompresses back into
-        p.grad."""
+        p.grad.  Sparse gradients densify under ``sparse_as_dense``
+        (reference optimizer.py:187) or take the sparse allgather path."""
         ctl = global_state.controller
         name = "grad." + self._names[p]
+        if p.grad.is_sparse:
+            if self._sparse_as_dense:
+                p.grad = p.grad.to_dense()
+            else:
+                out = sparse_allreduce(p.grad, name=name, op=self.op)
+                # The dense path's scale factors apply here too: scalar
+                # factors commute with the (sparse) sum, so pre*Σg*post
+                # == Σ(pre*g)*post — skipping them would leave sparse
+                # params mis-scaled vs their dense siblings under
+                # gradient_predivide_factor / backward_passes_per_step.
+                eff = self._prescale * \
+                    (1.0 / self._bpps if self._bpps > 1 else 1.0) * \
+                    self._postscale
+                if eff != 1.0:
+                    out = out * eff
+                return ("sparse", out, None)
         compressed, ctx = self._compression.compress(p.grad)
         grad_np = compressed.detach().numpy()  # shares memory w/ compressed
+        scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
         if ctl is None:
-            if not (self.op == Average and global_state.process_count == 1):
-                out = _C.allreduce(grad_np, op=self.op, name=name)
+            trivial = (self.op == Average and
+                       global_state.process_count == 1 and
+                       self._prescale * scale == 1.0 and
+                       self._postscale == 1.0)
+            if not trivial:
+                out = _C.allreduce(
+                    grad_np, op=self.op, name=name,
+                    prescale_factor=self._prescale * scale,
+                    postscale_factor=self._postscale)
                 grad_np[...] = np.asarray(out)
             return (None, compressed, ctx)
-        scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
         h = ctl.allreduce_async_(grad_np, grad_np, op=int(self.op),
                                  prescale=self._prescale * scale,
                                  postscale=self._postscale, name=name)
@@ -262,6 +292,9 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
     def synchronize(self):
         ctl = global_state.controller
         for p, (h, compressed, ctx) in list(self._handles.items()):
+            if h == "sparse":
+                p.grad = compressed  # reduced sparse tensor
+                continue
             if h is not None and ctl is not None:
                 from ..ops.eager import _ctl
                 _ctl(ctl.wait, h)
@@ -376,7 +409,29 @@ class _DistributedAdasumOptimizer(_torch.optim.Optimizer):
 
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
-                         prescale_factor=1.0, postscale_factor=1.0):
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         gradient_predivide_factor=1.0,
+                         sparse_as_dense=False):
+    if gradient_predivide_factor != 1.0:
+        # Reference contract (torch/optimizer.py:38-76): split the
+        # averaging division around the wire sum for overflow control —
+        # grads scale by 1/f before the sum and f/size after.
+        if op != Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average")
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError("gradient_predivide_factor and explicit "
+                             "prescale/postscale factors are exclusive")
+        if not is_initialized():
+            # The /size postscale is baked at construction; without init
+            # it would silently bake size 1 (the reference's size() call
+            # raises the same way).
+            from ..core.exceptions import NotInitializedError
+            raise NotInitializedError()
+        op = Sum
+        prescale_factor = 1.0 / gradient_predivide_factor
+        postscale_factor = gradient_predivide_factor / \
+            _C.communicator_size()
     if op == Adasum:
         if backward_passes_per_step != 1:
             raise ValueError(
@@ -396,4 +451,5 @@ def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
         optimizer, named_parameters=named_parameters, op=op,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        sparse_as_dense=sparse_as_dense)

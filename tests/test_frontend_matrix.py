@@ -217,3 +217,58 @@ def test_tf_join_and_barrier():
     hvd.init()
     hvd.barrier()
     assert hvd.join() == 0  # single member world
+
+
+def test_built_check_shims():
+    import horovod_tpu as hvd
+    assert hvd.gloo_built() and not hvd.mpi_built()
+    assert not hvd.nccl_built() and not hvd.cuda_built()
+    assert not hvd.rocm_built() and not hvd.ccl_built()
+    assert not hvd.ddl_built()
+    import horovod_tpu.torch as hvd_t
+    assert hvd_t.gloo_built() and not hvd_t.cuda_built()
+
+
+def test_torch_gradient_predivide_factor_preserves_average():
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    model = torch.nn.Linear(3, 1, bias=False)
+    w0 = model.weight.detach().clone()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        gradient_predivide_factor=2.0)
+    x = torch.ones(1, 3)
+    model(x).sum().backward()
+    opt.step()
+    # size 1: (g/2)*2/1 == g; update = w0 - g where g = x = ones.
+    assert torch.allclose(model.weight.detach(), w0 - 1.0, atol=1e-6)
+    with pytest.raises(ValueError, match="op=Average"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0), op=hvd.Sum,
+            gradient_predivide_factor=2.0)
+
+
+def test_torch_sparse_grads_in_optimizer():
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    emb = torch.nn.Embedding(6, 3, sparse=True)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SparseAdam(emb.parameters(), lr=0.1),
+        named_parameters=emb.named_parameters(), op=hvd.Sum)
+    emb(torch.tensor([1, 3])).sum().backward()
+    opt.step()  # sparse path: reduced sparse grad assigned at synchronize
+    opt.zero_grad()
+
+    # sparse_as_dense densifies before the wire.
+    emb2 = torch.nn.Embedding(6, 3, sparse=True)
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb2.parameters(), lr=0.1),
+        named_parameters=emb2.named_parameters(), op=hvd.Sum,
+        sparse_as_dense=True)
+    emb2(torch.tensor([0, 2])).sum().backward()
+    opt2.step()
+    assert not emb2.weight.grad.is_sparse
+    opt2.zero_grad()
