@@ -104,22 +104,37 @@ def alltoall(tensor: _torch.Tensor, splits=None, name: Optional[str] = None):
             _torch.from_numpy(np.asarray(recv_splits)))
 
 
-def sparse_allreduce(tensor: _torch.Tensor, name: Optional[str] = None,
-                     op: int = Average) -> _torch.Tensor:
-    """Allreduce a torch sparse COO tensor by allgathering indices/values
-    (the reference's sparse path, torch/mpi_ops.py:512): gathered slices are
-    summed by scatter-add, averaged for op=Average."""
-    if not tensor.is_sparse:
-        raise ValueError("sparse_allreduce expects a sparse tensor")
-    t = tensor.coalesce()
-    nm = name or "sparse"
-    indices = allgather(t.indices().t().contiguous(), name=nm + ".idx")
-    values = allgather(t.values(), name=nm + ".vals")
+def _sparse_submit(t: _torch.Tensor, name: str):
+    """Submit the two async allgathers of a coalesced sparse tensor's
+    indices/values (the reference's sparse path, torch/mpi_ops.py:512);
+    returns an opaque submission for ``_sparse_finish``."""
+    h_idx = _C.allgather_async(_to_numpy(t.indices().t().contiguous()),
+                               name=name + ".idx")
+    h_val = _C.allgather_async(_to_numpy(t.values()), name=name + ".vals")
+    return (h_idx, h_val, t.shape)
+
+
+def _sparse_finish(submitted, op: int) -> _torch.Tensor:
+    """Finish a ``_sparse_submit``: scatter-add the gathered slices via
+    sparse_coo_tensor + coalesce, divide for op=Average."""
+    h_idx, h_val, shape = submitted
+    indices = _out_to_torch(_C.synchronize(h_idx))
+    values = _out_to_torch(_C.synchronize(h_val))
     out = _torch.sparse_coo_tensor(indices.t(), values,
-                                   size=t.shape).coalesce()
+                                   size=shape).coalesce()
     if op == Average:
         out = out / _C.communicator_size()
     return out
+
+
+def sparse_allreduce(tensor: _torch.Tensor, name: Optional[str] = None,
+                     op: int = Average) -> _torch.Tensor:
+    """Allreduce a torch sparse COO tensor by allgathering indices/values:
+    gathered slices are summed by scatter-add, averaged for op=Average."""
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce expects a sparse tensor")
+    t = tensor.coalesce()
+    return _sparse_finish(_sparse_submit(t, name or "sparse"), op)
 
 
 def join() -> int:
@@ -134,8 +149,127 @@ def poll(handle) -> bool:
     return _C.poll(handle)
 
 
+def _out_to_torch(out):
+    if isinstance(out, tuple):
+        return tuple(_out_to_torch(o) for o in out)
+    if _torch.is_tensor(out):
+        return out
+    return _torch.from_numpy(np.asarray(out))
+
+
 def synchronize(handle):
-    return _C.synchronize(handle)
+    """Block on an async handle and return its result as torch tensor(s)
+    (reference torch/mpi_ops.py:859 synchronize)."""
+    return _out_to_torch(_C.synchronize(handle))
+
+
+def allreduce_async(tensor: _torch.Tensor, op: int = Average,
+                    name: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    """Out-of-place async allreduce; returns a handle for
+    poll/synchronize (reference torch/mpi_ops.py allreduce_async)."""
+    return _C.allreduce_async(_to_numpy(tensor), op=op, name=name,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor)
+
+
+def allgather_async(tensor: _torch.Tensor,
+                    name: Optional[str] = None) -> int:
+    return _C.allgather_async(_to_numpy(tensor), name=name)
+
+
+def broadcast_async(tensor: _torch.Tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    return _C.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                              name=name)
+
+
+def alltoall_async(tensor: _torch.Tensor, splits=None,
+                   name: Optional[str] = None) -> int:
+    return _C.alltoall_async(_to_numpy(tensor), splits=splits, name=name)
+
+
+def _inplace_async(tensor: _torch.Tensor, submit, sync_fallback,
+                   finish=None) -> int:
+    """In-place async: with the native controller attached and a CPU
+    contiguous tensor, the runtime streams directly from/into the
+    tensor's own buffer (zero-copy, true in-flight async — reference
+    torch/mpi_ops.py allreduce_async_); otherwise complete synchronously
+    and hand back a finished handle.
+
+    ``submit(ctl, buf)`` returns ``(handle, finish_ctx)``; the default
+    finish waits (which releases the native handle), a custom ``finish
+    (ctl, handle, finish_ctx, buf)`` handles ops whose result lands in a
+    separate native buffer (e.g. broadcast)."""
+    from ..core import handles as _handles
+    ctl = global_state.controller
+    if (ctl is not None and tensor.device.type == "cpu"
+            and tensor.is_contiguous()):
+        from ..ops.eager import _ctl
+        buf = tensor.detach().numpy()  # shares memory with the tensor
+        h, fctx = _ctl(submit, ctl, buf)
+
+        def _wait():
+            if finish is not None:
+                _ctl(finish, ctl, h, fctx, buf)
+            else:
+                _ctl(ctl.wait, h)  # wait() also releases the handle
+            return tensor
+        return _handles.handle_manager.allocate(_handles.Handle(
+            poll_fn=lambda: ctl.poll(h), wait_fn=_wait))
+    sync_fallback(tensor)
+    return _handles.handle_manager.allocate(_handles.Handle(result=tensor))
+
+
+def allreduce_async_(tensor: _torch.Tensor, op: int = Average,
+                     name: Optional[str] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    def _sync(t):
+        out = allreduce(t, op=op, name=name,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+        t.copy_(out)
+    return _inplace_async(
+        tensor,
+        lambda ctl, buf: (ctl.allreduce_async_(
+            buf, buf, op=int(op), prescale=prescale_factor,
+            postscale=postscale_factor, name=name), None),
+        _sync)
+
+
+def broadcast_async_(tensor: _torch.Tensor, root_rank: int = 0,
+                     name: Optional[str] = None) -> int:
+    def _submit(ctl, buf):
+        h, _in, out = ctl.broadcast_submit(buf, root_rank=root_rank,
+                                           name=name)
+        return h, out
+
+    def _finish(ctl, h, out, buf):
+        buf[...] = ctl.broadcast_finish(h, out)
+
+    return _inplace_async(
+        tensor, _submit,
+        lambda t: broadcast_(t, root_rank=root_rank, name=name),
+        finish=_finish)
+
+
+def grouped_allreduce(tensors: List[_torch.Tensor], op: int = Average,
+                      name: Optional[str] = None) -> List[_torch.Tensor]:
+    """Allreduce a group atomically — members negotiate and fuse together
+    (reference torch/mpi_ops.py grouped_allreduce / GroupTable)."""
+    outs = _C.grouped_allreduce([_to_numpy(t) for t in tensors], op=op,
+                                name=name)
+    return [_torch.from_numpy(np.asarray(o)).to(t.dtype)
+            for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors: List[_torch.Tensor], op: int = Average,
+                       name: Optional[str] = None) -> List[_torch.Tensor]:
+    for t, o in zip(tensors, grouped_allreduce(tensors, op=op, name=name)):
+        t.copy_(o)
+    return tensors
 
 
 def broadcast_parameters(params, root_rank: int = 0):
@@ -257,7 +391,6 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
             if self._sparse_as_dense:
                 p.grad = p.grad.to_dense()
             else:
-                out = sparse_allreduce(p.grad, name=name, op=self.op)
                 # The dense path's scale factors apply here too: scalar
                 # factors commute with the (sparse) sum, so pre*Σg*post
                 # == Σ(pre*g)*post — skipping them would leave sparse
@@ -266,9 +399,16 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
                 eff = self._prescale * \
                     (1.0 / self._bpps if self._bpps > 1 else 1.0) * \
                     self._postscale
-                if eff != 1.0:
-                    out = out * eff
-                return ("sparse", out, None)
+                t = p.grad.coalesce()
+                if (ctl is None and _C.communicator_size() == 1
+                        and self.op == Average and eff == 1.0):
+                    # Identity gather — skip the wire round-trip.
+                    return ("sparse", ("trivial", t, eff), None)
+                # Async like the dense path: submit both allgathers from
+                # the hook so they overlap the rest of backward; the
+                # scatter-add happens in synchronize().
+                sub = _sparse_submit(t, name)
+                return ("sparse", ("async", sub, eff), None)
         compressed, ctx = self._compression.compress(p.grad)
         grad_np = compressed.detach().numpy()  # shares memory w/ compressed
         scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
@@ -293,7 +433,12 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
         ctl = global_state.controller
         for p, (h, compressed, ctx) in list(self._handles.items()):
             if h == "sparse":
-                p.grad = compressed  # reduced sparse tensor
+                kind, payload, eff = compressed
+                out = payload if kind == "trivial" \
+                    else _sparse_finish(payload, self.op)
+                if eff != 1.0:
+                    out = out * eff
+                p.grad = out
                 continue
             if h is not None and ctl is not None:
                 from ..ops.eager import _ctl

@@ -50,26 +50,56 @@ import threading  # noqa: E402
 _DEFAULT_TEST_TIMEOUT = int(os.environ.get("HVD_TPU_TEST_TIMEOUT", "180"))
 
 
-@pytest.hookimpl(hookwrapper=True)
-def pytest_runtest_call(item):
+def _alarm_guard(item, phase, default_seconds=None):
     marker = item.get_closest_marker("timeout")
     seconds = int(marker.args[0]) if marker and marker.args \
-        else _DEFAULT_TEST_TIMEOUT
+        else (default_seconds or _DEFAULT_TEST_TIMEOUT)
 
     def _on_alarm(signum, frame):
         raise TimeoutError(
-            f"test exceeded {seconds}s timeout (conftest SIGALRM enforcer)")
+            f"{phase} exceeded {seconds}s timeout "
+            "(conftest SIGALRM enforcer)")
 
     use_alarm = threading.current_thread() is threading.main_thread()
     if use_alarm:
         old = signal.signal(signal.SIGALRM, _on_alarm)
         signal.alarm(seconds)
+    return use_alarm, (old if use_alarm else None)
+
+
+def _alarm_clear(use_alarm, old):
+    if use_alarm:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    use_alarm, old = _alarm_guard(item, "test")
     try:
         yield
     finally:
-        if use_alarm:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
+        _alarm_clear(use_alarm, old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    use_alarm, old = _alarm_guard(item, "setup")
+    try:
+        yield
+    finally:
+        _alarm_clear(use_alarm, old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    # Teardown (e.g. the _fresh_runtime shutdown) must not wedge the suite
+    # either; a stuck controller shutdown fails the test instead.
+    use_alarm, old = _alarm_guard(item, "teardown", default_seconds=120)
+    try:
+        yield
+    finally:
+        _alarm_clear(use_alarm, old)
 
 
 def pytest_configure(config):

@@ -1,0 +1,121 @@
+"""Torch public async-handle + grouped collective API (reference
+torch/mpi_ops.py: allreduce_async/_, broadcast_async/_, allgather_async,
+alltoall_async, grouped_allreduce/_ — handles resolved by
+poll/synchronize)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    return hvd
+
+
+def test_allreduce_async_returns_torch_tensor(hvd):
+    x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    h = hvd.allreduce_async(x, op=hvd.Sum)
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    assert torch.is_tensor(out)
+    torch.testing.assert_close(out, x)
+
+
+def test_allreduce_async_inplace_updates_tensor(hvd):
+    x = torch.full((4,), 2.0)
+    h = hvd.allreduce_async_(x, op=hvd.Sum)
+    out = hvd.synchronize(h)
+    assert out is x  # in-place contract: the same tensor comes back
+    torch.testing.assert_close(x, torch.full((4,), 2.0))
+
+
+def test_broadcast_async_inplace(hvd):
+    x = torch.randn(3, 3)
+    want = x.clone()
+    h = hvd.broadcast_async_(x, root_rank=0)
+    assert hvd.synchronize(h) is x
+    torch.testing.assert_close(x, want)
+
+
+def test_allgather_and_alltoall_async(hvd):
+    hg = hvd.allgather_async(torch.ones(2, 2))
+    g = hvd.synchronize(hg)
+    assert torch.is_tensor(g) and g.shape == (2, 2)
+
+    ha = hvd.alltoall_async(torch.arange(4, dtype=torch.float32))
+    out, splits = hvd.synchronize(ha)
+    assert torch.is_tensor(out) and torch.is_tensor(splits)
+    torch.testing.assert_close(out, torch.arange(4, dtype=torch.float32))
+
+
+def test_grouped_allreduce_numerics(hvd):
+    ts = [torch.full((3,), float(i)) for i in range(4)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Sum)
+    assert len(outs) == 4
+    for i, o in enumerate(outs):
+        torch.testing.assert_close(o, torch.full((3,), float(i)))
+    # In-place variant writes back into the inputs.
+    ins = [torch.full((2,), 5.0), torch.full((2,), 7.0)]
+    res = hvd.grouped_allreduce_(ins, op=hvd.Average)
+    assert res[0] is ins[0]
+    torch.testing.assert_close(ins[0], torch.full((2,), 5.0))
+    torch.testing.assert_close(ins[1], torch.full((2,), 7.0))
+
+
+TORCH_ASYNC_WORKER = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    # In-place async allreduce across ranks: rank r contributes r+1.
+    x = torch.full((8,), float(rank + 1))
+    h = hvd.allreduce_async_(x, op=hvd.Sum)
+    got = hvd.synchronize(h)
+    assert got is x
+    torch.testing.assert_close(x, torch.full((8,), 3.0))
+
+    # Grouped allreduce fuses atomically; every member averages.
+    ts = [torch.full((4,), float(rank + i)) for i in range(3)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Average)
+    for i, o in enumerate(outs):
+        torch.testing.assert_close(o, torch.full((4,), 0.5 + i))
+
+    # In-place async broadcast from rank 1.
+    b = torch.full((5,), float(rank))
+    hb = hvd.broadcast_async_(b, root_rank=1)
+    hvd.synchronize(hb)
+    torch.testing.assert_close(b, torch.full((5,), 1.0))
+
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"ok": True}}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(240)
+def test_torch_async_grouped_2proc(tmp_path):
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "res")
+    script = tmp_path / "worker.py"
+    script.write_text(TORCH_ASYNC_WORKER.format(repo=REPO, outfile=outfile))
+    rc = main(["-np", "2", "--controller-port", "28741",
+               sys.executable, str(script)])
+    assert rc == 0
+    for r in (0, 1):
+        assert json.load(open(f"{outfile}.{r}"))["ok"]
