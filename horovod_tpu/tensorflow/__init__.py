@@ -26,6 +26,17 @@ from ..ops import collective as _C
 from ..optimizers import broadcast_object, allgather_object
 
 
+def broadcast_object_fn(root_rank: int = 0, session=None,
+                        name: Optional[str] = None):
+    """Returns a reusable object-broadcast callable (reference
+    tensorflow/functions.py:103 broadcast_object_fn; the graph-session
+    argument is accepted for drop-in signature parity and unused on the
+    eager path)."""
+    def _bcast(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+    return _bcast
+
+
 class Compression:
     class none:
         @staticmethod

@@ -1,0 +1,96 @@
+"""Stall-inspector behavior (reference test/integration/test_stall.py +
+stall_inspector.h:31-100): the coordinator warns when a tensor was
+submitted by some-but-not-all ranks, and optionally shuts the job down
+after the shutdown window."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WARN_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.native.controller import NativeController
+
+    rank = int(sys.argv[1])
+    ctl = NativeController(rank, 2, "127.0.0.1:" + sys.argv[2])
+    if rank == 1:
+        time.sleep(3.0)  # past the 1s warning window
+    out = ctl.allreduce(np.ones(4, np.float32), op=1, name="late")
+    assert float(out[0]) == 2.0
+    ctl.shutdown()
+    print("DONE", rank)
+""")
+
+
+SHUTDOWN_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.native.controller import NativeController, NativeError
+
+    rank = int(sys.argv[1])
+    ctl = NativeController(rank, 2, "127.0.0.1:" + sys.argv[2])
+    if rank == 0:
+        try:
+            ctl.allreduce(np.ones(4, np.float32), op=1, name="never")
+            print("UNEXPECTED-SUCCESS")
+        except NativeError as e:
+            assert "stall" in str(e).lower(), str(e)
+            print("STALL-ERROR", rank)
+    else:
+        time.sleep(4.0)  # never submit; let the coordinator give up
+        print("SAT-OUT", rank)
+    ctl.shutdown()
+""")
+
+
+def _spawn(script, rank, port, env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               HVD_TPU_CYCLE_TIME="1", **env_extra)
+    return subprocess.Popen(
+        [sys.executable, "-c", script, str(rank), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+
+
+@pytest.mark.timeout(120)
+def test_stall_warning_emitted_then_recovers():
+    port = _free_port()
+    script = WARN_WORKER.format(repo=REPO)
+    env = {"HOROVOD_STALL_CHECK_TIME_SECONDS": "1"}
+    procs = [_spawn(script, r, port, env) for r in range(2)]
+    outs = [p.communicate(timeout=90) for p in procs]
+    for p in procs:
+        assert p.returncode == 0
+    assert "DONE 0" in outs[0][0] and "DONE 1" in outs[1][0]
+    # Coordinator (rank 0) warned about the straggler, naming the tensor.
+    assert "stall" in outs[0][1].lower(), outs[0][1]
+    assert "late" in outs[0][1]
+
+
+@pytest.mark.timeout(120)
+def test_stall_shutdown_errors_pending_op():
+    port = _free_port()
+    script = SHUTDOWN_WORKER.format(repo=REPO)
+    env = {"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+           "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "2"}
+    procs = [_spawn(script, r, port, env) for r in range(2)]
+    outs = [p.communicate(timeout=90) for p in procs]
+    assert "STALL-ERROR 0" in outs[0][0], (outs[0][0], outs[0][1])
+    assert "SAT-OUT 1" in outs[1][0], (outs[1][0], outs[1][1])

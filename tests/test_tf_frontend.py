@@ -87,3 +87,13 @@ def test_sync_batch_norm_single():
     x = tf.random.normal((4, 3))
     out = layer(x, training=True)
     assert out.shape == (4, 3)
+
+
+def test_tf_object_collectives_and_fn():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    obj = {"epoch": 3, "names": ["a", "b"]}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+    assert hvd.allgather_object(obj) == [obj]
+    bcast = hvd.broadcast_object_fn(root_rank=0)
+    assert bcast(obj) == obj

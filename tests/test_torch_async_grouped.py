@@ -108,6 +108,47 @@ TORCH_ASYNC_WORKER = textwrap.dedent("""
 """)
 
 
+TORCH_JOIN_WORKER = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+
+    # Both ranks process batch 0; only rank 0 has a batch 1 — rank 1
+    # joins instead and participates with zero proxies (reference
+    # test_torch.py test_horovod_join_allreduce).
+    out0 = hvd.allreduce(torch.full((4,), float(rank + 1)), op=hvd.Sum,
+                         name="join.b0")
+    torch.testing.assert_close(out0, torch.full((4,), 3.0))
+    if rank == 0:
+        out1 = hvd.allreduce(torch.full((4,), 7.0), op=hvd.Sum,
+                             name="join.b1")
+        torch.testing.assert_close(out1, torch.full((4,), 7.0))
+    last = hvd.join()
+    assert last == 1, last
+
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"ok": True}}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(240)
+def test_torch_join_uneven_batches_2proc(tmp_path):
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "res")
+    script = tmp_path / "worker.py"
+    script.write_text(TORCH_JOIN_WORKER.format(repo=REPO, outfile=outfile))
+    rc = main(["-np", "2", "--controller-port", "28747",
+               sys.executable, str(script)])
+    assert rc == 0
+    for r in (0, 1):
+        assert json.load(open(f"{outfile}.{r}"))["ok"]
+
+
 @pytest.mark.timeout(240)
 def test_torch_async_grouped_2proc(tmp_path):
     from horovod_tpu.runner.launch import main
