@@ -78,11 +78,16 @@ class BayesianOptimizer:
     """Maximize an unknown function over a box via GP + EI."""
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
-                 seed: int = 0, n_candidates: int = 512):
+                 seed: int = 0, n_candidates: int = 512,
+                 noise: float = 0.8):
         self.bounds = np.asarray(bounds, dtype=np.float64)
         self.rng = np.random.RandomState(seed)
         self.n_candidates = n_candidates
-        self.gp = GaussianProcess(length_scale=0.3)
+        # The GP standardizes scores to zero-mean/unit-std internally, so
+        # this noise level acts on unit-scale observations — directly
+        # comparable to the reference's alpha knob
+        # (--autotune-gaussian-process-noise, default 0.8).
+        self.gp = GaussianProcess(length_scale=0.3, noise=noise)
         self.xs: List[np.ndarray] = []
         self.ys: List[float] = []
 
@@ -125,13 +130,24 @@ class ParameterManager:
 
     def __init__(self, apply_fn, max_samples: int = 20,
                  window_seconds: float = 2.0,
-                 log_file: Optional[str] = None, seed: int = 0):
+                 log_file: Optional[str] = None, seed: int = 0,
+                 warmup_samples: int = 3, steps_per_sample: int = 0,
+                 gp_noise: float = 0.8):
         """apply_fn(fusion_bytes: int, cycle_ms: float) applies parameters
-        to the runtime (native SetParams)."""
+        to the runtime (native SetParams).
+
+        ``warmup_samples`` windows are discarded (not fed to the GP) to
+        skip compile/cache-cold noise; ``steps_per_sample > 0`` closes a
+        window every N traffic reports instead of by wall-clock — the
+        reference's step-counted sampling (--autotune-steps-per-sample)."""
         self._apply = apply_fn
-        self._opt = BayesianOptimizer(self.BOUNDS, seed=seed)
+        self._opt = BayesianOptimizer(self.BOUNDS, seed=seed,
+                                      noise=gp_noise)
         self._max_samples = max_samples
         self._window = window_seconds
+        self._warmup_left = max(0, warmup_samples)
+        self._steps_per_sample = max(0, steps_per_sample)
+        self._steps_in_window = 0
         self._log_file = log_file
         self._samples = 0
         self._frozen = False
@@ -154,20 +170,33 @@ class ParameterManager:
         self._apply(*self._current)
 
     def record_bytes(self, nbytes: int):
-        """Feed data-plane traffic; closes a window when enough time passed."""
+        """Feed data-plane traffic; closes a window when enough time passed
+        (or, in step-counted mode, after steps_per_sample reports)."""
         if self._frozen:
             return
         self._bytes += int(nbytes)
         now = time.perf_counter()
         elapsed = now - self._window_start
-        if elapsed < self._window:
+        if self._steps_per_sample > 0:
+            self._steps_in_window += 1
+            if self._steps_in_window < self._steps_per_sample:
+                return
+        elif elapsed < self._window:
             return
-        score = self._bytes / elapsed
+        score = self._bytes / max(elapsed, 1e-9)
         self._observe(score)
         self._bytes = 0
+        self._steps_in_window = 0
         self._window_start = now
 
     def _observe(self, score: float):
+        if self._warmup_left > 0:
+            # Warmup windows (compile/cold-cache noise) are logged but not
+            # fed to the GP and do not count toward max_samples.
+            self._warmup_left -= 1
+            self._log(score, tag="warmup")
+            self._propose()
+            return
         x = np.array([math.log2(self._current[0]), self._current[1]])
         self._opt.observe(x, score)
         self._log(score)

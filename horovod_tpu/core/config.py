@@ -20,6 +20,10 @@ TIMELINE = "TIMELINE"                          # filename
 TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
 AUTOTUNE = "AUTOTUNE"
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
+AUTOTUNE_WARMUP_SAMPLES = "AUTOTUNE_WARMUP_SAMPLES"
+AUTOTUNE_STEPS_PER_SAMPLE = "AUTOTUNE_STEPS_PER_SAMPLE"
+AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 LOG_LEVEL = "LOG_LEVEL"
 LOG_HIDE_TIME = "LOG_HIDE_TIME"
 STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
@@ -86,6 +90,11 @@ class Config:
     timeline_mark_cycles: bool = False
     autotune: bool = False
     autotune_log: str = ""
+    # Reference autotune defaults (parameter_manager.h / launch.py flags).
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 0   # 0 = time-windowed sampling
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
@@ -105,6 +114,16 @@ class Config:
         cfg.timeline_mark_cycles = get_bool(TIMELINE_MARK_CYCLES)
         cfg.autotune = get_bool(AUTOTUNE)
         cfg.autotune_log = get_env(AUTOTUNE_LOG, "") or ""
+        cfg.autotune_warmup_samples = get_int(
+            AUTOTUNE_WARMUP_SAMPLES, cfg.autotune_warmup_samples)
+        cfg.autotune_steps_per_sample = get_int(
+            AUTOTUNE_STEPS_PER_SAMPLE, cfg.autotune_steps_per_sample)
+        cfg.autotune_bayes_opt_max_samples = get_int(
+            AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+            cfg.autotune_bayes_opt_max_samples)
+        cfg.autotune_gaussian_process_noise = get_float(
+            AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
+            cfg.autotune_gaussian_process_noise)
         cfg.stall_check_disable = get_bool(STALL_CHECK_DISABLE)
         cfg.stall_warning_time_seconds = get_float(
             STALL_CHECK_TIME_SECONDS, cfg.stall_warning_time_seconds)

@@ -151,7 +151,11 @@ class NativeController:
                 apply_fn=lambda fusion, cycle:
                     self._lib.hvd_native_set_params(int(fusion),
                                                     float(cycle)),
-                log_file=cfg.autotune_log or None)
+                log_file=cfg.autotune_log or None,
+                max_samples=cfg.autotune_bayes_opt_max_samples,
+                warmup_samples=cfg.autotune_warmup_samples,
+                steps_per_sample=cfg.autotune_steps_per_sample,
+                gp_noise=cfg.autotune_gaussian_process_noise)
 
     @classmethod
     def from_env(cls) -> "NativeController":

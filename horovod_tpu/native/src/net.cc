@@ -1,14 +1,21 @@
 #include "net.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
+#include <net/if.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/ioctl.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <cerrno>
 #include <cstdio>
+#include <algorithm>
+#include <chrono>
+#include <cstdlib>
 #include <cstring>
 
 namespace hvdtpu {
@@ -88,8 +95,50 @@ int Listen(uint16_t port, uint16_t* bound_port) {
   return fd;
 }
 
+// Non-blocking connect bounded by timeout_s; on success the socket is
+// returned in blocking mode.  Bounding connect() itself matters: against a
+// black-holed address a blocking connect sits in the kernel SYN retry for
+// minutes, which would blow any caller-side deadline.
+static int ConnectTimeout(const addrinfo* res, double timeout_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  if (rc != 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return -1;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int ms = static_cast<int>(timeout_s * 1000);
+    if (poll(&pfd, 1, ms > 0 ? ms : 1) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  fcntl(fd, F_SETFL, flags);  // back to blocking for the frame protocol
+  return fd;
+}
+
 int DialRetry(const std::string& host, uint16_t port, int attempts = 600) {
-  for (int i = 0; i < attempts; ++i) {
+  // --start-timeout: bound how long workers wait for the coordinator (and
+  // for peer-mesh dials during startup) — reference horovodrun
+  // --start-timeout; default stays ~60 s.  Deadline-based: retries plus
+  // DNS/connect time all count against the budget.
+  double timeout_s = attempts * 0.1;
+  const char* st = getenv("HVD_TPU_START_TIMEOUT");
+  if (!st) st = getenv("HOROVOD_START_TIMEOUT");
+  if (st && atof(st) > 0) timeout_s = atof(st);
+  auto deadline = std::chrono::steady_clock::now() +
+      std::chrono::duration<double>(timeout_s);
+  while (std::chrono::steady_clock::now() < deadline) {
     addrinfo hints{}, *res = nullptr;
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -99,16 +148,15 @@ int DialRetry(const std::string& host, uint16_t port, int attempts = 600) {
       usleep(100000);
       continue;
     }
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd >= 0 &&
-        ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
-      freeaddrinfo(res);
+    double remaining = std::chrono::duration<double>(
+        deadline - std::chrono::steady_clock::now()).count();
+    int fd = ConnectTimeout(res, std::min(remaining, 2.0));
+    freeaddrinfo(res);
+    if (fd >= 0) {
       SetNoDelay(fd);
       return fd;
     }
-    if (fd >= 0) ::close(fd);
-    freeaddrinfo(res);
-    usleep(100000);  // coordinator may not be up yet; retry for ~60 s
+    usleep(100000);  // coordinator may not be up yet; retry until deadline
   }
   return -1;
 }
@@ -122,6 +170,28 @@ bool ParseAddr(const std::string& addr, std::string* host, uint16_t* port) {
 }
 
 std::string LocalHostname() {
+  // HVD_TPU_IFACE / HOROVOD_GLOO_IFACE: advertise this interface's IPv4
+  // to peers instead of the hostname (reference --network-interface /
+  // HOROVOD_GLOO_IFACE semantics — on multi-NIC hosts gethostname() may
+  // resolve to an address peers cannot route to).
+  const char* ifn = getenv("HVD_TPU_IFACE");
+  if (!ifn || !*ifn) ifn = getenv("HOROVOD_GLOO_IFACE");
+  if (ifn && *ifn) {
+    int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd >= 0) {
+      ifreq ifr{};
+      strncpy(ifr.ifr_name, ifn, IFNAMSIZ - 1);
+      bool ok = ioctl(fd, SIOCGIFADDR, &ifr) == 0;
+      ::close(fd);
+      if (ok) {
+        auto* sin = reinterpret_cast<sockaddr_in*>(&ifr.ifr_addr);
+        char abuf[INET_ADDRSTRLEN];
+        if (inet_ntop(AF_INET, &sin->sin_addr, abuf, sizeof(abuf))) {
+          return abuf;
+        }
+      }
+    }
+  }
   char buf[256];
   if (gethostname(buf, sizeof(buf)) == 0) return buf;
   return "127.0.0.1";

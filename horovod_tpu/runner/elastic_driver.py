@@ -84,7 +84,11 @@ class ElasticDriver:
                  reset_limit: Optional[int] = None,
                  extra_env: Optional[Dict[str, str]] = None,
                  verbose: bool = False,
-                 platform_policy: str = "auto"):
+                 platform_policy: str = "auto",
+                 iface: Optional[str] = None,
+                 ssh_identity_file: Optional[str] = None,
+                 output_dir: Optional[str] = None,
+                 prefix_timestamp: bool = False):
         self._discovery = discovery
         self._command = command
         self._platform_policy = platform_policy
@@ -96,6 +100,10 @@ class ElasticDriver:
         self._reset_limit = reset_limit
         self._extra_env = dict(extra_env or {})
         self._verbose = verbose
+        self._iface = iface
+        self._ssh_identity_file = ssh_identity_file
+        self._output_dir = output_dir
+        self._prefix_timestamp = prefix_timestamp
 
         from .rendezvous import generate_secret
         self._rdv_secret = generate_secret()
@@ -122,14 +130,28 @@ class ElasticDriver:
             # address is fixed for the job: later-joining hosts must be
             # able to route to an address probed against the initial set
             # (the practical assumption: elastic pools share a network).
+            # --elastic-timeout (reference default 600 s): wait for the
+            # pool to offer min_np slots before giving up — discovery may
+            # be provisioning hosts.
+            deadline = time.time() + float(os.environ.get(
+                "HVD_TPU_ELASTIC_TIMEOUT", "600"))
             hosts = self._discover_filtered()
+            while (sum(h.slots for h in hosts) < self._min_np
+                   and time.time() < deadline
+                   and not self._shutdown.is_set()):
+                time.sleep(self._interval)
+                hosts = self._discover_filtered()
+            if self._shutdown.is_set():
+                return 1  # interrupted while waiting for capacity
             if sum(h.slots for h in hosts) < self._min_np:
                 raise RuntimeError(
-                    f"not enough slots to reach --min-np {self._min_np}")
+                    f"not enough slots to reach --min-np {self._min_np} "
+                    f"within the elastic timeout")
             from .probe import advertised_host
             rdv_host = advertised_host(
                 [h.hostname for h in hosts
-                 if not exec_mod._is_local(h.hostname)])
+                 if not exec_mod._is_local(h.hostname)],
+                iface=self._iface)
             self._extra_env["HVD_TPU_RENDEZVOUS_ADDR"] = f"{rdv_host}:{port}"
             self._extra_env["HVD_TPU_RENDEZVOUS_SECRET"] = self._rdv_secret
             self._extra_env["HVD_TPU_ELASTIC"] = "1"
@@ -212,7 +234,10 @@ class ElasticDriver:
             extra_env=env,
             on_exit=lambda slot, code, sid=self._slot_id(s):
                 self._on_worker_exit(sid, slot, code),
-            platform_policy=self._platform_policy)
+            platform_policy=self._platform_policy,
+            ssh_identity_file=self._ssh_identity_file,
+            output_dir=self._output_dir,
+            prefix_timestamp=self._prefix_timestamp)
         self._workers[self._slot_id(s)] = ws[0]
 
     def _on_worker_exit(self, sid: str, slot: SlotInfo, code: int):
@@ -319,11 +344,18 @@ def run_elastic(args) -> int:
         raise SystemExit("--host-discovery-script is required for elastic "
                          "mode (with --min-np/--max-np)")
     slots = args.slots or 1
+    if getattr(args, "elastic_timeout", None) is not None:
+        os.environ["HVD_TPU_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
     discovery = HostDiscoveryScript(args.host_discovery_script, slots)
     min_np = args.min_np or args.num_proc or 1
     driver = ElasticDriver(
         discovery, args.command, min_np=min_np, max_np=args.max_np,
         reset_limit=args.reset_limit, extra_env=knob_env(args),
         verbose=args.verbose,
-        platform_policy=getattr(args, "worker_platform", "auto"))
+        platform_policy=getattr(args, "worker_platform", "auto"),
+        iface=getattr(args, "network_interface", None),
+        ssh_identity_file=getattr(args, "ssh_identity_file", None),
+        output_dir=getattr(args, "output_filename", None),
+        prefix_timestamp=getattr(args, "prefix_output_with_timestamp",
+                                 False))
     return driver.run()

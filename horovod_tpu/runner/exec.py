@@ -26,7 +26,8 @@ def _is_local(hostname: str) -> bool:
 
 
 def build_command(slot: SlotInfo, command: List[str], env: Dict[str, str],
-                  ssh_port: Optional[int] = None
+                  ssh_port: Optional[int] = None,
+                  ssh_identity_file: Optional[str] = None
                   ) -> Tuple[List[str], Optional[str]]:
     """Returns (argv, stdin_payload).  Secrets never travel in the remote
     argv — /proc/*/cmdline is world-readable on both machines, which would
@@ -50,6 +51,8 @@ def build_command(slot: SlotInfo, command: List[str], env: Dict[str, str],
     ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         ssh_cmd += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh_cmd += ["-i", ssh_identity_file]
     return ssh_cmd + [slot.hostname, remote], payload
 
 
@@ -66,7 +69,10 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                    on_exit: Optional[Callable[[SlotInfo, int], None]] = None,
                    prefix_output: bool = True,
                    platform_policy: str = "auto",
-                   ssh_port: Optional[int] = None) -> List[WorkerProcess]:
+                   ssh_port: Optional[int] = None,
+                   ssh_identity_file: Optional[str] = None,
+                   output_dir: Optional[str] = None,
+                   prefix_timestamp: bool = False) -> List[WorkerProcess]:
     """Start one process per slot; returns immediately with handles.
 
     ``platform_policy`` decides how each host's workers share its TPU chips
@@ -98,7 +104,7 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
             slot, slot_command,
             {**slot_env(slot, controller_addr),
              **platform, **(extra_env or {})},
-            ssh_port=ssh_port)
+            ssh_port=ssh_port, ssh_identity_file=ssh_identity_file)
         proc = subprocess.Popen(
             cmd, env=env,
             stdin=subprocess.PIPE if stdin_payload else subprocess.DEVNULL,
@@ -113,19 +119,43 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
         w = WorkerProcess(slot, proc)
         workers.append(w)
         if prefix_output:
-            threading.Thread(target=_forward_output, args=(w,),
-                             daemon=True).start()
+            threading.Thread(
+                target=_forward_output,
+                args=(w, output_dir, prefix_timestamp),
+                daemon=True).start()
         if on_exit is not None:
             threading.Thread(target=_watch_exit, args=(w, on_exit),
                              daemon=True).start()
     return workers
 
 
-def _forward_output(w: WorkerProcess):
+def _forward_output(w: WorkerProcess, output_dir: Optional[str] = None,
+                    prefix_timestamp: bool = False):
     assert w.proc.stdout is not None
-    for line in w.proc.stdout:
-        sys.stdout.write(f"[{w.slot.rank}]<stdout> {line}")
-        sys.stdout.flush()
+    sink = None
+    if output_dir:
+        # Per-rank capture files (reference --output-filename layout:
+        # <dir>/<rank>/stdout; stderr is merged into stdout here).
+        rank_dir = os.path.join(output_dir, str(w.slot.rank))
+        os.makedirs(rank_dir, exist_ok=True)
+        # Append: elastic respawns of the same rank must not truncate the
+        # earlier rounds' capture.
+        sink = open(os.path.join(rank_dir, "stdout"), "a")
+    try:
+        for line in w.proc.stdout:
+            stamp = ""
+            if prefix_timestamp:
+                import datetime
+                stamp = datetime.datetime.now().isoformat(
+                    timespec="milliseconds") + " "
+            sys.stdout.write(f"{stamp}[{w.slot.rank}]<stdout> {line}")
+            sys.stdout.flush()
+            if sink is not None:
+                sink.write(line)
+                sink.flush()
+    finally:
+        if sink is not None:
+            sink.close()
 
 
 def _watch_exit(w: WorkerProcess, on_exit: Callable[[SlotInfo, int], None]):

@@ -38,6 +38,33 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="hostfile path (mpirun-style slots=N supported)")
     p.add_argument("--controller-port", type=int, default=26000)
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None,
+                   help="ssh -i identity file for remote hosts")
+    p.add_argument("--network-interface", default=None,
+                   help="restrict the advertised driver/rendezvous address "
+                        "to this interface (reference --network-interface)")
+    p.add_argument("--output-filename", default=None,
+                   help="directory for per-rank output capture "
+                        "(<dir>/<rank>/stdout; streams still forwarded)")
+    p.add_argument("--prefix-output-with-timestamp", action="store_true")
+    p.add_argument("--start-timeout", type=float, default=None,
+                   help="seconds workers may wait for the controller/"
+                        "rendezvous to come up before giving up")
+    p.add_argument("--elastic-timeout", type=float, default=None,
+                   help="seconds an elastic rendezvous round may wait for "
+                        "min-np workers")
+    p.add_argument("--version", action="store_true",
+                   help="print the version and exit")
+    # Controller selection (reference --gloo/--mpi/--jsrun/--tcp): the TPU
+    # control plane is always the TCP controller (the gloo analog; SURVEY
+    # §5.8 — no MPI on TPU VMs), so --tcp/--gloo are accepted no-ops and
+    # --mpi/--jsrun fail with an explanation instead of a silent fallback.
+    p.add_argument("--tcp", action="store_true",
+                   help="use the TCP controller (always on; compat flag)")
+    p.add_argument("--gloo", action="store_true",
+                   help="compat alias for the TCP controller (gloo analog)")
+    p.add_argument("--mpi", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--jsrun", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--worker-platform", choices=("auto", "cpu", "tpu"),
                    default="auto",
                    help="how workers share each host's TPU chips: auto = "
@@ -56,10 +83,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--disable-cache", action="store_true")
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--hierarchical-allgather", action="store_true")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
     p.add_argument("--no-stall-check", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=None)
@@ -75,8 +111,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if args.config_file:
         _apply_config_file(args, p, args.config_file)
-    if args.check_build:
+    if args.check_build or args.version:
         return args
+    if args.mpi or args.jsrun:
+        p.error("MPI/jsrun control planes are not available on TPU VMs; "
+                "the TCP controller (the gloo analog) is the only control "
+                "plane — drop --mpi/--jsrun (or pass --tcp/--gloo, which "
+                "are accepted aliases)")
     if not args.command:
         p.error("no worker command given")
     if args.command and args.command[0] == "--":
@@ -111,6 +152,18 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_CYCLE_TIME"] = str(args.cycle_time_ms)
     if args.cache_capacity is not None:
         env["HVD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.disable_cache:
+        env["HVD_TPU_CACHE_CAPACITY"] = "0"
+    if args.hierarchical_allreduce:
+        env["HVD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.hierarchical_allgather:
+        env["HVD_TPU_HIERARCHICAL_ALLGATHER"] = "1"
+    if args.start_timeout is not None:
+        env["HVD_TPU_START_TIMEOUT"] = str(args.start_timeout)
+    if args.elastic_timeout is not None:
+        env["HVD_TPU_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
+    if args.network_interface:
+        env["HVD_TPU_IFACE"] = args.network_interface
     if args.timeline_filename:
         env["HVD_TPU_TIMELINE"] = args.timeline_filename
     if args.timeline_mark_cycles:
@@ -119,6 +172,18 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HVD_TPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.autotune_warmup_samples is not None:
+        env["HVD_TPU_AUTOTUNE_WARMUP_SAMPLES"] = str(
+            args.autotune_warmup_samples)
+    if args.autotune_steps_per_sample is not None:
+        env["HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE"] = str(
+            args.autotune_steps_per_sample)
+    if args.autotune_bayes_opt_max_samples is not None:
+        env["HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = str(
+            args.autotune_bayes_opt_max_samples)
+    if args.autotune_gaussian_process_noise is not None:
+        env["HVD_TPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] = str(
+            args.autotune_gaussian_process_noise)
     if args.no_stall_check:
         env["HVD_TPU_STALL_CHECK_DISABLE"] = "1"
     if args.stall_check_warning_time_seconds is not None:
@@ -156,7 +221,8 @@ def _controller_addr(hosts: List[HostInfo], port: int) -> str:
 
 
 def start_rendezvous(hosts: List[HostInfo],
-                     ssh_port: Optional[int] = None):
+                     ssh_port: Optional[int] = None,
+                     iface: Optional[str] = None):
     """Per-launch rendezvous bring-up shared by every launch path: HMAC
     secret, KV server, and a driver address NIC-probed so every remote
     host can route to it (reference driver_service.py:49-218 —
@@ -169,7 +235,7 @@ def start_rendezvous(hosts: List[HostInfo],
     rdv_port = rendezvous.start()
     rdv_host = advertised_host(
         [h.hostname for h in hosts if not exec_mod._is_local(h.hostname)],
-        ssh_port=ssh_port)
+        ssh_port=ssh_port, iface=iface)
     return rendezvous, {
         "HVD_TPU_RENDEZVOUS_ADDR": f"{rdv_host}:{rdv_port}",
         "HVD_TPU_RENDEZVOUS_SECRET": secret,
@@ -182,7 +248,8 @@ def run_static(args: argparse.Namespace) -> int:
     slots = get_host_assignments(hosts, np_)
     controller_addr = _controller_addr(hosts, args.controller_port)
 
-    rendezvous, rdv_env = start_rendezvous(hosts, ssh_port=args.ssh_port)
+    rendezvous, rdv_env = start_rendezvous(hosts, ssh_port=args.ssh_port,
+                                           iface=args.network_interface)
     extra_env = knob_env(args)
     extra_env.update(rdv_env)
     rendezvous.put("global", "controller", controller_addr.encode())
@@ -191,10 +258,14 @@ def run_static(args: argparse.Namespace) -> int:
         for s in slots:
             print(f"rank {s.rank} -> {s.hostname} (local {s.local_rank}/"
                   f"{s.local_size}, cross {s.cross_rank}/{s.cross_size})")
-    workers = exec_mod.launch_workers(slots, args.command, controller_addr,
-                                      extra_env=extra_env,
-                                      platform_policy=args.worker_platform,
-                                      ssh_port=args.ssh_port)
+    workers = exec_mod.launch_workers(
+        slots, args.command, controller_addr,
+        extra_env=extra_env,
+        platform_policy=args.worker_platform,
+        ssh_port=args.ssh_port,
+        ssh_identity_file=args.ssh_identity_file,
+        output_dir=args.output_filename,
+        prefix_timestamp=args.prefix_output_with_timestamp)
     try:
         return exec_mod.wait_all(workers)
     finally:
@@ -261,6 +332,10 @@ def check_build() -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.version:
+        from .. import version
+        print(version.__version__)
+        return 0
     if args.check_build:
         return check_build()
     if args.host_discovery_script or args.min_np or args.max_np:

@@ -25,15 +25,39 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 
-def local_addresses() -> List[str]:
+def _iface_address(ifname: str) -> Optional[str]:
+    """IPv4 address of one named interface via SIOCGIFADDR (Linux)."""
+    try:
+        import fcntl
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            packed = fcntl.ioctl(
+                s.fileno(), 0x8915,  # SIOCGIFADDR
+                struct.pack("256s", ifname.encode()[:15]))
+            return socket.inet_ntoa(packed[20:24])
+        finally:
+            s.close()
+    except (ImportError, OSError):
+        return None
+
+
+def local_addresses(iface: Optional[str] = None) -> List[str]:
     """All usable local IPv4 addresses, most-routable first (non-loopback
-    interface addresses, then the hostname's resolution, then loopback)."""
+    interface addresses, then the hostname's resolution, then loopback).
+    With ``iface`` (reference --network-interface / HOROVOD_GLOO_IFACE),
+    only that interface's address is advertised."""
     addrs: List[str] = []
 
     def _add(a: Optional[str]):
         if a and a not in addrs:
             addrs.append(a)
 
+    if iface:
+        _add(_iface_address(iface))
+        if not addrs:
+            raise ValueError(
+                f"--network-interface {iface!r} has no usable IPv4 address")
+        return addrs
     # The UDP-connect trick: the OS picks the egress interface for a
     # public destination without sending a packet.
     try:
@@ -45,18 +69,9 @@ def local_addresses() -> List[str]:
         pass
     # Per-interface addresses via SIOCGIFADDR (Linux).
     try:
-        import fcntl
         for _idx, ifname in socket.if_nameindex():
-            try:
-                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                packed = fcntl.ioctl(
-                    s.fileno(), 0x8915,  # SIOCGIFADDR
-                    struct.pack("256s", ifname.encode()[:15]))
-                _add(socket.inet_ntoa(packed[20:24]))
-                s.close()
-            except OSError:
-                continue
-    except ImportError:
+            _add(_iface_address(ifname))
+    except OSError:
         pass
     try:
         _add(socket.gethostbyname(socket.gethostname()))
@@ -170,7 +185,8 @@ def _run_remote_probe(hostname: str, script: str,
 def match_driver_address(remote_hosts: List[str],
                          ssh_port: Optional[int] = None,
                          token: Optional[str] = None,
-                         remote_probe=_run_remote_probe
+                         remote_probe=_run_remote_probe,
+                         iface: Optional[str] = None
                          ) -> Tuple[Optional[str], Dict[str, List[str]]]:
     """Find a driver address every remote host can route to.
 
@@ -184,7 +200,7 @@ def match_driver_address(remote_hosts: List[str],
     if not remote_hosts:
         return None, {}
     token = token or secrets.token_hex(8)
-    candidates = local_addresses()
+    candidates = local_addresses(iface=iface)
     listener = ProbeListener(token)
     per_host: Dict[str, List[str]] = {}
     try:
@@ -209,14 +225,21 @@ def match_driver_address(remote_hosts: List[str],
 
 
 def advertised_host(remote_hostnames: List[str],
-                    ssh_port: Optional[int] = None) -> str:
+                    ssh_port: Optional[int] = None,
+                    iface: Optional[str] = None) -> str:
     """The address the driver should advertise for rendezvous: a probed
     mutually-routable address when there are remote hosts, else
     gethostname().  Shared by the static and elastic launch paths."""
     if not remote_hostnames:
+        if iface:
+            addr = _iface_address(iface)
+            if addr is None:
+                raise ValueError(f"--network-interface {iface!r} has no "
+                                 "usable IPv4 address")
+            return addr
         return socket.gethostname()
     chosen, per_host = match_driver_address(remote_hostnames,
-                                            ssh_port=ssh_port)
+                                            ssh_port=ssh_port, iface=iface)
     if chosen is not None:
         return chosen
     print(f"[hvdrun] WARNING: no driver address reachable from all of "

@@ -48,7 +48,7 @@ def test_parameter_manager_applies_and_freezes():
 
     pm = ParameterManager(
         apply_fn=lambda fusion, cycle: applied.append((fusion, cycle)),
-        max_samples=4, window_seconds=0.0)
+        max_samples=4, window_seconds=0.0, warmup_samples=0)
     assert len(applied) == 1  # initial proposal applied
     for _ in range(4):
         pm.record_bytes(1000)
@@ -63,7 +63,8 @@ def test_parameter_manager_applies_and_freezes():
 def test_parameter_manager_logs(tmp_path):
     log = tmp_path / "autotune.csv"
     pm = ParameterManager(apply_fn=lambda f, c: None, max_samples=2,
-                          window_seconds=0.0, log_file=str(log))
+                          window_seconds=0.0, log_file=str(log),
+                          warmup_samples=0)
     pm.record_bytes(100)
     pm.record_bytes(100)
     lines = log.read_text().strip().splitlines()

@@ -1,0 +1,124 @@
+"""Launcher CLI parity flags (reference runner/launch.py:300-520):
+--version, controller-selection compat, cache/hierarchical/autotune env
+mapping, --network-interface, --output-filename per-rank capture,
+--start-timeout/--elastic-timeout plumbing, autotune sampling knobs."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner.launch import knob_env, main, parse_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_version_flag(capsys):
+    from horovod_tpu.version import __version__
+    assert main(["--version"]) == 0
+    assert __version__ in capsys.readouterr().out
+
+
+def test_mpi_and_jsrun_rejected():
+    with pytest.raises(SystemExit):
+        parse_args(["--mpi", "-np", "1", "python", "x.py"])
+    with pytest.raises(SystemExit):
+        parse_args(["--jsrun", "-np", "1", "python", "x.py"])
+
+
+def test_tcp_and_gloo_accepted_aliases():
+    args = parse_args(["--gloo", "--tcp", "-np", "1", "python", "x.py"])
+    assert args.command == ["python", "x.py"]
+
+
+def test_knob_env_new_flags():
+    args = parse_args([
+        "-np", "1", "--disable-cache", "--hierarchical-allreduce",
+        "--hierarchical-allgather", "--start-timeout", "30",
+        "--elastic-timeout", "120", "--network-interface", "lo",
+        "--autotune", "--autotune-warmup-samples", "5",
+        "--autotune-steps-per-sample", "10",
+        "--autotune-bayes-opt-max-samples", "40",
+        "--autotune-gaussian-process-noise", "0.5",
+        "python", "x.py"])
+    env = knob_env(args)
+    assert env["HVD_TPU_CACHE_CAPACITY"] == "0"
+    assert env["HVD_TPU_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HVD_TPU_HIERARCHICAL_ALLGATHER"] == "1"
+    assert env["HVD_TPU_START_TIMEOUT"] == "30.0"
+    assert env["HVD_TPU_ELASTIC_TIMEOUT"] == "120.0"
+    assert env["HVD_TPU_IFACE"] == "lo"
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+    assert env["HVD_TPU_AUTOTUNE_WARMUP_SAMPLES"] == "5"
+    assert env["HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE"] == "10"
+    assert env["HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "40"
+    assert env["HVD_TPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.5"
+
+
+def test_local_addresses_iface_restriction():
+    from horovod_tpu.runner.probe import local_addresses
+    assert local_addresses(iface="lo") == ["127.0.0.1"]
+    with pytest.raises(ValueError):
+        local_addresses(iface="definitely-not-a-nic0")
+
+
+@pytest.mark.timeout(240)
+def test_output_filename_per_rank_capture(tmp_path):
+    outdir = tmp_path / "logs"
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "print(f'hello from rank {hvd.rank()}')\n"
+        "hvd.shutdown()\n")
+    rc = main(["-np", "2", "--controller-port", "28753",
+               "--output-filename", str(outdir),
+               sys.executable, str(script)])
+    assert rc == 0
+    for r in (0, 1):
+        text = (outdir / str(r) / "stdout").read_text()
+        assert f"hello from rank {r}" in text
+
+
+def test_parameter_manager_warmup_and_steps():
+    from horovod_tpu.autotune import ParameterManager
+    applied = []
+    pm = ParameterManager(lambda f, c: applied.append((f, c)),
+                          max_samples=2, warmup_samples=1,
+                          steps_per_sample=3)
+    # Step-counted windows: 3 reports close one window.
+    for _ in range(3):
+        pm.record_bytes(1000)
+    assert pm._samples == 0          # warmup window discarded
+    for _ in range(3):
+        pm.record_bytes(1000)
+    assert pm._samples == 1          # first real sample
+    for _ in range(3):
+        pm.record_bytes(1000)
+    assert pm.frozen                 # max_samples=2 reached → frozen
+    assert len(applied) >= 3         # proposals + final best applied
+
+
+def test_elastic_timeout_waits_for_capacity(monkeypatch):
+    import time as _time
+    from horovod_tpu.runner.elastic_driver import ElasticDriver, FixedHosts
+    from horovod_tpu.runner.hosts import HostInfo
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_TIMEOUT", "5")
+    fixed = FixedHosts([])  # nothing available yet
+
+    def add_later():
+        _time.sleep(1.0)
+        fixed.set([HostInfo("localhost", 2)])
+
+    import threading
+    driver = ElasticDriver(
+        fixed, [sys.executable, "-c", "import sys; sys.exit(0)"],
+        min_np=2, max_np=2, controller_base_port=28760,
+        discovery_interval=0.1)
+    t = threading.Thread(target=add_later, daemon=True)
+    t.start()
+    rc = driver.run()
+    assert rc == 0
