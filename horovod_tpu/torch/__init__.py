@@ -16,6 +16,7 @@ structure as the reference.
 
 from __future__ import annotations
 
+from contextlib import contextmanager as _contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -340,6 +341,8 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
                             Tuple[Any, _torch.Tensor, Any]] = {}
         self._grad_accs = []
         self._pass_counts: Dict[_torch.nn.Parameter, int] = {}
+        self._synchronized = False
+        self._should_synchronize = True
         self._register_hooks()
 
     # Delegate the torch optimizer surface.
@@ -447,11 +450,37 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
                 # Wire dtype differed: restore into the model-dtype grad.
                 p.grad.copy_(self._compression.decompress(compressed, ctx))
         self._handles.clear()
+        self._synchronized = True
+
+    @_contextmanager
+    def skip_synchronize(self):
+        """Make the next step() skip synchronization — for the
+        synchronize-then-clip-then-step pattern (reference
+        torch/optimizer.py:295):
+
+            optimizer.synchronize()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            with optimizer.skip_synchronize():
+                optimizer.step()
+        """
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
 
     def step(self, closure=None):
         # Any params whose hooks did not fire (e.g. frozen this pass) are
         # skipped; synchronize all fired handles first.
-        self.synchronize()
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+                warnings.warn(
+                    "optimizer.step() called without skip_synchronize() "
+                    "after optimizer.synchronize(); gradients are reduced "
+                    "twice — wrap step() in optimizer.skip_synchronize()")
+            self.synchronize()
+        self._synchronized = False
         return self._opt.step(closure)
 
     def zero_grad(self, *args, **kwargs):

@@ -108,6 +108,39 @@ TORCH_ASYNC_WORKER = textwrap.dedent("""
 """)
 
 
+def test_gradient_clipping_pattern(hvd):
+    # synchronize → clip → step-with-skip (reference
+    # test_torch.py test_gradient_clipping): the clipped gradient must be
+    # what step() applies.
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(), op=hvd.Sum)
+    out = model(torch.full((1, 2), 10.0))
+    out.sum().backward()
+    opt.synchronize()
+    prev_grad = model.weight.grad.clone()
+    torch.nn.utils.clip_grad_norm_(model.parameters(), 0.1)
+    clipped = model.weight.grad.clone()
+    assert clipped.norm() < prev_grad.norm()
+    with opt.skip_synchronize():
+        opt.step()
+    torch.testing.assert_close(model.weight.data, 1.0 - clipped)
+
+
+def test_step_after_synchronize_warns(hvd):
+    model = torch.nn.Linear(2, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(), op=hvd.Sum)
+    model(torch.randn(3, 2)).sum().backward()
+    opt.synchronize()
+    with pytest.warns(UserWarning, match="skip_synchronize"):
+        opt.step()
+
+
 TORCH_JOIN_WORKER = textwrap.dedent("""
     import os, sys, json
     sys.path.insert(0, {repo!r})
