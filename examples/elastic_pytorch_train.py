@@ -1,0 +1,61 @@
+"""Elastic torch training (role parity with the reference's
+examples/elastic/pytorch/pytorch_mnist_elastic.py): state commits every
+batch; on worker failure or host change the run loop restores the last
+committed state and re-rendezvouses.
+
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/elastic_pytorch_train.py
+"""
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = nn.Sequential(nn.Linear(28 * 28, 128), nn.ReLU(),
+                          nn.Linear(128, 10))
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+
+    data = torch.randn(512, 28 * 28)
+    target = torch.randint(0, 10, (512,))
+    batch = 32
+
+    state = hvd.elastic.TorchState(model=model, optimizer=optimizer,
+                                   batch=0, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        for epoch in range(state.epoch, 3):
+            shard = list(range(hvd.rank(), 512 // batch, hvd.size()))
+            for i, b in enumerate(shard[state.batch:]):
+                x = data[b * batch:(b + 1) * batch]
+                y = target[b * batch:(b + 1) * batch]
+                optimizer.zero_grad()
+                F.cross_entropy(model(x), y).backward()
+                optimizer.step()
+                state.batch = state.batch + i + 1
+                state.commit()
+            state.batch = 0
+            state.epoch = epoch + 1
+            state.commit()
+            if hvd.rank() == 0:
+                with torch.no_grad():
+                    loss = F.cross_entropy(model(data), target)
+                print(f"epoch {epoch}: loss {loss:.4f} "
+                      f"(world size {hvd.size()})")
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

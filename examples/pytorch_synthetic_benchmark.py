@@ -1,0 +1,87 @@
+"""Synthetic data-parallel training benchmark for the torch front-end.
+
+Role parity with the reference's examples/pytorch/pytorch_synthetic_benchmark.py
+(warmup + timed batches → img/sec, allreduce via DistributedOptimizer) on
+the TPU-native stack's CPU eager path.  Launch:
+
+    hvdrun -np 2 python examples/pytorch_synthetic_benchmark.py --num-iters 3
+"""
+
+import argparse
+import time
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallConvNet(nn.Module):
+    """Stand-in for torchvision models (not bundled in this image)."""
+
+    def __init__(self, num_classes=1000, width=32):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, width, 3, stride=2, padding=1)
+        self.conv2 = nn.Conv2d(width, width * 2, 3, stride=2, padding=1)
+        self.pool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(width * 2, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234 + hvd.rank())
+
+    model = SmallConvNet()
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        rate = args.batch_size * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"iter {i}: {rate:.1f} img/sec per worker")
+
+    if hvd.rank() == 0:
+        avg = sum(img_secs) / len(img_secs)
+        print(f"img/sec per worker: {avg:.1f}")
+        print(f"total img/sec on {hvd.size()} worker(s): "
+              f"{avg * hvd.size():.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

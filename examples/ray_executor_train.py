@@ -1,0 +1,37 @@
+"""RayExecutor training example (role parity with the reference's
+examples/ray/tensorflow2_mnist_ray.py shape): the executor allocates Ray
+workers, assigns ranks, and runs the training function on each as a
+distributed member.
+
+    python examples/ray_executor_train.py   # needs a ray cluster/local ray
+"""
+
+
+def train_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    # Every rank contributes its rank+1; the average is the same on all.
+    out = hvd.allreduce(np.full((4,), float(hvd.rank() + 1),
+                                dtype=np.float32))
+    print(f"rank {hvd.rank()}/{hvd.size()}: allreduce -> {out[0]:.2f}")
+    hvd.shutdown()
+    return float(out[0])
+
+
+def main():
+    from horovod_tpu.ray import RayExecutor
+
+    executor = RayExecutor(num_workers=2)
+    executor.start()
+    try:
+        results = executor.run(train_fn)
+        print("results:", results)
+    finally:
+        executor.shutdown()
+
+
+if __name__ == "__main__":
+    main()
