@@ -175,11 +175,29 @@ def _native_graph_ready() -> bool:
     return ready
 
 
+def _allreduce_impl(t, op: int, name: Optional[str],
+                    prescale_factor: float, postscale_factor: float):
+    if _is_symbolic(t):
+        if _native_graph_ready() and t.dtype in _CUSTOM_OP_DTYPES:
+            return _load_custom_ops().hvd_tpu_allreduce(
+                t, op_code=int(op), prescale=prescale_factor,
+                postscale=postscale_factor, tensor_name=name or "")
+        return _graph_bridge(
+            lambda x: np.asarray(_C.allreduce(
+                x, op=op, name=name, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)), t)
+    return _to_tf(_C.allreduce(_np(t), op=op, name=name,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor))
+
+
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=None):
-    """Allreduce; IndexedSlices (sparse gradients) go through the allgather
-    path like the reference (tensorflow/__init__.py:92-108)."""
+    """Allreduce; differentiable (the gradient is the same allreduce of
+    the upstream gradient — reference mpi_ops.py _allreduce_grad).
+    IndexedSlices (sparse gradients) go through the allgather path like
+    the reference (tensorflow/__init__.py:92-108)."""
     if isinstance(tensor, _tf.IndexedSlices):
         nm = name or "slices"
         values = allgather(tensor.values, name=nm + ".values")
@@ -190,24 +208,20 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
                                  dense_shape=tensor.dense_shape)
     comp = compression or Compression.none
     t, ctx = comp.compress(tensor)
-    if _is_symbolic(t):
-        if _native_graph_ready() and t.dtype in _CUSTOM_OP_DTYPES:
-            out = _load_custom_ops().hvd_tpu_allreduce(
-                t, op_code=int(op), prescale=prescale_factor,
-                postscale=postscale_factor, tensor_name=name or "")
-        else:
-            out = _graph_bridge(
-                lambda x: np.asarray(_C.allreduce(
-                    x, op=op, name=name, prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor)), t)
-        return comp.decompress(out, ctx)
-    out = _C.allreduce(_np(t), op=op, name=name,
-                       prescale_factor=prescale_factor,
-                       postscale_factor=postscale_factor)
-    return comp.decompress(_to_tf(out), ctx)
+
+    @_tf.custom_gradient
+    def _fn(x):
+        y = _allreduce_impl(x, op, name, prescale_factor,
+                            postscale_factor)
+
+        def grad(dy):
+            return _allreduce_impl(dy, op, None, prescale_factor,
+                                   postscale_factor)
+        return y, grad
+    return comp.decompress(_fn(_tf.convert_to_tensor(t)), ctx)
 
 
-def allgather(tensor, name: Optional[str] = None):
+def _allgather_impl(tensor, name: Optional[str]):
     if _is_symbolic(tensor):
         if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
             return _load_custom_ops().hvd_tpu_allgather(
@@ -219,7 +233,31 @@ def allgather(tensor, name: Optional[str] = None):
     return _to_tf(_C.allgather(_np(tensor), name=name))
 
 
-def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+def allgather(tensor, name: Optional[str] = None):
+    """Allgather along dim 0; differentiable (gradient = average the
+    upstream gradient across ranks, slice out this rank's rows —
+    reference mpi_ops.py _allgather_grad)."""
+
+    @_tf.custom_gradient
+    def _fn(x):
+        y = _allgather_impl(x, name)
+
+        def grad(dy):
+            g = _allreduce_impl(dy, Average, None, 1.0, 1.0)
+            r = rank()
+            if x.shape.rank == 0:
+                # Each rank contributed one element; ours back as scalar.
+                return _tf.reshape(_tf.reshape(g, [-1])[r], [])
+            d = _tf.reshape(_tf.shape(x, out_type=_tf.int64)[0], [1])
+            dims = _allgather_impl(d, None)
+            offset = _tf.reduce_sum(dims[:r]) if r > 0 \
+                else _tf.constant(0, _tf.int64)
+            return g[offset:offset + d[0]]
+        return y, grad
+    return _fn(_tf.convert_to_tensor(tensor))
+
+
+def _broadcast_impl(tensor, root_rank: int, name: Optional[str]):
     if _is_symbolic(tensor):
         if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
             return _load_custom_ops().hvd_tpu_broadcast(
@@ -227,29 +265,76 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
         return _graph_bridge(
             lambda x: np.asarray(
                 _C.broadcast(x, root_rank=root_rank, name=name)), tensor)
-    return _to_tf(_C.broadcast(_np(tensor), root_rank=root_rank, name=name))
+    return _to_tf(_C.broadcast(_np(tensor), root_rank=root_rank,
+                               name=name))
 
 
-def alltoall(tensor, splits=None, name: Optional[str] = None):
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast from root; differentiable (gradient: averaged upstream
+    gradient on the root, zero elsewhere — reference _broadcast_grad)."""
+
+    @_tf.custom_gradient
+    def _fn(x):
+        y = _broadcast_impl(x, root_rank, name)
+
+        def grad(dy):
+            g = _allreduce_impl(dy, Average, None, 1.0, 1.0)
+            if rank() != root_rank:
+                g = g * 0
+            return g
+        return y, grad
+    return _fn(_tf.convert_to_tensor(tensor))
+
+
+def _alltoall_impl(tensor, splits, name: Optional[str]):
     if _is_symbolic(tensor):
         if _native_graph_ready() and tensor.dtype in _CUSTOM_OP_DTYPES:
-            splits_t = _tf.constant([], dtype=_tf.int64) if splits is None \
-                else _tf.cast(_tf.convert_to_tensor(splits), _tf.int64)
+            if splits is None:
+                splits_t = _tf.constant([], dtype=_tf.int64)
+            else:
+                splits_t = _tf.cast(_tf.convert_to_tensor(splits),
+                                    _tf.int64)
             return _load_custom_ops().hvd_tpu_alltoall(
                 tensor, splits_t, tensor_name=name or "")
 
-        # py_function fallback (two outputs), like the sibling collectives.
-        def np_fn(x):
-            out, rs = _C.alltoall(x.numpy(), splits=splits, name=name)
+        # py_function fallback (two outputs), like the sibling
+        # collectives.  Splits travel as a py_function INPUT (an empty
+        # tensor means None): a closure-captured symbolic splits tensor
+        # (the gradient path feeds recv_splits back in) could not be
+        # iterated at execution time.
+        def np_fn(x, s):
+            sp = None if s.shape[0] == 0 else s.numpy().tolist()
+            out, rs = _C.alltoall(x.numpy(), splits=sp, name=name)
             return np.asarray(out), np.asarray(rs, dtype=np.int32)
 
-        out, recv = _tf.py_function(np_fn, [tensor],
+        if splits is None:
+            splits_in = _tf.constant([], dtype=_tf.int64)
+        else:
+            splits_in = _tf.cast(_tf.convert_to_tensor(splits), _tf.int64)
+        out, recv = _tf.py_function(np_fn, [tensor, splits_in],
                                     [tensor.dtype, _tf.int32])
         out.set_shape(_tf.TensorShape([None] + list(tensor.shape)[1:]))
         recv.set_shape(_tf.TensorShape([None]))
         return out, recv
     out, recv_splits = _C.alltoall(_np(tensor), splits=splits, name=name)
     return _to_tf(out), _to_tf(recv_splits)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Alltoall with optional uneven splits; differentiable wrt the
+    tensor (gradient routes back with the received splits as the send
+    splits — reference mpi_ops.py _alltoall_grad)."""
+
+    @_tf.custom_gradient
+    def _fn(x):
+        out, recv = _alltoall_impl(x, splits, name)
+
+        def grad(dy, _dy_recv):
+            back_splits = recv if _is_symbolic(recv) else np.asarray(recv)
+            g, _ = _alltoall_impl(dy, back_splits, None)
+            return g
+        return (out, recv), grad
+    return _fn(_tf.convert_to_tensor(tensor))
 
 
 def join() -> int:

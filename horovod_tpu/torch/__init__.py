@@ -64,45 +64,157 @@ def _to_numpy(tensor: _torch.Tensor) -> np.ndarray:
     return tensor.detach().contiguous().cpu().numpy()
 
 
-def allreduce(tensor: _torch.Tensor, op: int = Average,
-              name: Optional[str] = None,
-              prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0) -> _torch.Tensor:
+def _allreduce_nograd(tensor: _torch.Tensor, op: int,
+                      name: Optional[str],
+                      prescale_factor: float,
+                      postscale_factor: float) -> _torch.Tensor:
     out = _C.allreduce(_to_numpy(tensor), op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
     return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
 
 
+class _AllreduceFn(_torch.autograd.Function):
+    """Differentiable allreduce (reference torch/mpi_ops.py
+    HorovodAllreduce): the gradient of an allreduce is the same allreduce
+    of the upstream gradient."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, prescale_factor, postscale_factor):
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        return _allreduce_nograd(tensor, op, name, prescale_factor,
+                                 postscale_factor)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (_allreduce_nograd(grad_output, ctx.op, None,
+                                  ctx.prescale_factor,
+                                  ctx.postscale_factor),
+                None, None, None, None)
+
+
+def allreduce(tensor: _torch.Tensor, op: int = Average,
+              name: Optional[str] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              compression=None) -> _torch.Tensor:
+    """Out-of-place allreduce; differentiable (gradients allreduce with
+    the same op).  ``compression`` applies wire compression around the
+    transport (reference torch/mpi_ops.py allreduce)."""
+    comp = compression or Compression.none
+    compressed, cctx = comp.compress(tensor)
+    out = _AllreduceFn.apply(compressed, op, name, prescale_factor,
+                             postscale_factor)
+    return comp.decompress(out, cctx)
+
+
 def allreduce_(tensor: _torch.Tensor, op: int = Average,
                name: Optional[str] = None) -> _torch.Tensor:
-    tensor.copy_(allreduce(tensor, op=op, name=name))
+    with _torch.no_grad():
+        tensor.copy_(_allreduce_nograd(tensor, op, name, 1.0, 1.0))
     return tensor
 
 
-def allgather(tensor: _torch.Tensor,
-              name: Optional[str] = None) -> _torch.Tensor:
+def _allgather_nograd(tensor: _torch.Tensor,
+                      name: Optional[str]) -> _torch.Tensor:
     out = _C.allgather(_to_numpy(tensor), name=name)
     return _torch.from_numpy(np.asarray(out))
 
 
-def broadcast(tensor: _torch.Tensor, root_rank: int = 0,
+class _AllgatherFn(_torch.autograd.Function):
+    """Differentiable allgather: the gradient averages the upstream
+    gradient across ranks, then slices out this rank's own rows
+    (reference HorovodAllgather.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.scalar = tensor.dim() == 0
+        ctx.dim0 = 1 if ctx.scalar else tensor.shape[0]
+        return _allgather_nograd(tensor, name)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = _allreduce_nograd(grad_output, Average, None, 1.0, 1.0)
+        r = rank()
+        if ctx.scalar:
+            # Each rank contributed one element; take ours back as 0-d.
+            return g.reshape(-1)[r:r + 1].reshape(()), None
+        dims = _allgather_nograd(
+            _torch.tensor([ctx.dim0], dtype=_torch.int64), None)
+        offset = int(dims[:r].sum()) if r > 0 else 0
+        return g.narrow(0, offset, ctx.dim0), None
+
+
+def allgather(tensor: _torch.Tensor,
               name: Optional[str] = None) -> _torch.Tensor:
+    return _AllgatherFn.apply(tensor, name)
+
+
+def _broadcast_nograd(tensor: _torch.Tensor, root_rank: int,
+                      name: Optional[str]) -> _torch.Tensor:
     out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
     return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
 
 
+class _BroadcastFn(_torch.autograd.Function):
+    """Differentiable broadcast: gradients flow back to the root — the
+    averaged upstream gradient on the root, zero elsewhere (reference
+    HorovodBroadcast.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return _broadcast_nograd(tensor, root_rank, name)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = _allreduce_nograd(grad_output, Average, None, 1.0, 1.0)
+        if rank() != ctx.root_rank:
+            g = g * 0
+        return g, None, None
+
+
+def broadcast(tensor: _torch.Tensor, root_rank: int = 0,
+              name: Optional[str] = None) -> _torch.Tensor:
+    return _BroadcastFn.apply(tensor, root_rank, name)
+
+
 def broadcast_(tensor: _torch.Tensor, root_rank: int = 0,
                name: Optional[str] = None) -> _torch.Tensor:
-    tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name))
+    with _torch.no_grad():
+        tensor.copy_(_broadcast_nograd(tensor, root_rank, name))
     return tensor
 
 
-def alltoall(tensor: _torch.Tensor, splits=None, name: Optional[str] = None):
+def _alltoall_nograd(tensor: _torch.Tensor, splits,
+                     name: Optional[str]):
     out, recv_splits = _C.alltoall(_to_numpy(tensor), splits=splits,
                                    name=name)
     return (_torch.from_numpy(np.asarray(out)),
             _torch.from_numpy(np.asarray(recv_splits)))
+
+
+class _AlltoallFn(_torch.autograd.Function):
+    """Differentiable alltoall: gradients route back with the received
+    splits as the send splits (reference HorovodAlltoall.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, splits, name):
+        out, recv_splits = _alltoall_nograd(tensor, splits, name)
+        ctx.recv_splits = recv_splits.tolist()
+        ctx.mark_non_differentiable(recv_splits)
+        return out, recv_splits
+
+    @staticmethod
+    def backward(ctx, grad_output, _grad_splits):
+        g, _ = _alltoall_nograd(grad_output, ctx.recv_splits, None)
+        return g, None, None
+
+
+def alltoall(tensor: _torch.Tensor, splits=None, name: Optional[str] = None):
+    return _AlltoallFn.apply(tensor, splits, name)
 
 
 def _sparse_submit(t: _torch.Tensor, name: str):

@@ -97,3 +97,69 @@ def test_tf_object_collectives_and_fn():
     assert hvd.allgather_object(obj) == [obj]
     bcast = hvd.broadcast_object_fn(root_rank=0)
     assert bcast(obj) == obj
+
+
+def test_tf_collectives_are_differentiable():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+
+    x = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum))
+    g = tape.gradient(y, x)
+    np.testing.assert_allclose(g.numpy(), np.ones((2, 2)))
+
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allgather(x) ** 2)
+    g = tape.gradient(y, x)
+    np.testing.assert_allclose(g.numpy(), 2 * x.numpy())
+
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.broadcast(x, root_rank=0))
+    g = tape.gradient(y, x)
+    np.testing.assert_allclose(g.numpy(), np.ones((2, 2)))  # rank==root
+
+    v = tf.Variable([1.0, 2.0, 3.0, 4.0])
+    with tf.GradientTape() as tape:
+        out, _splits = hvd.alltoall(v)
+        y = tf.reduce_sum(3.0 * out)
+    g = tape.gradient(y, v)
+    np.testing.assert_allclose(g.numpy(), np.full(4, 3.0))
+
+
+def test_tf_allreduce_grad_inside_tf_function():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+
+    @tf.function
+    def fn(x):
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum) ** 2)
+        return tape.gradient(y, x)
+
+    x = tf.constant([1.0, -2.0])
+    np.testing.assert_allclose(fn(x).numpy(), 2 * x.numpy())
+
+
+def test_tf_scalar_allgather_grad_and_graph_alltoall_grad():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+
+    x = tf.Variable(3.0)
+    with tf.GradientTape() as tape:
+        y = 2.0 * tf.reduce_sum(hvd.allgather(x))
+    g = tape.gradient(y, x)
+    assert g.shape == ()
+    np.testing.assert_allclose(g.numpy(), 2.0)
+
+    @tf.function
+    def fn(v):
+        with tf.GradientTape() as tape:
+            tape.watch(v)
+            out, _ = hvd.alltoall(v)
+            y = tf.reduce_sum(5.0 * out)
+        return tape.gradient(y, v)
+
+    v = tf.constant([1.0, 2.0])
+    np.testing.assert_allclose(fn(v).numpy(), np.full(2, 5.0))

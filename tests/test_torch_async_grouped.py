@@ -108,6 +108,35 @@ TORCH_ASYNC_WORKER = textwrap.dedent("""
 """)
 
 
+def test_collectives_are_differentiable(hvd):
+    # Reference test_horovod_allreduce_grad / allgather_grad /
+    # broadcast_grad / alltoall_grad: gradients flow through the
+    # collective functions (size-1 world → identities).
+    x = torch.randn(3, 2, requires_grad=True)
+    hvd.allreduce(x, op=hvd.Sum).sum().backward()
+    torch.testing.assert_close(x.grad, torch.ones_like(x))
+
+    x = torch.randn(4, 2, requires_grad=True)
+    hvd.allgather(x).pow(2).sum().backward()
+    torch.testing.assert_close(x.grad, 2 * x.detach())
+
+    x = torch.randn(5, requires_grad=True)
+    hvd.broadcast(x, root_rank=0).sum().backward()
+    torch.testing.assert_close(x.grad, torch.ones_like(x))  # rank==root
+
+    x = torch.randn(6, requires_grad=True)
+    out, _splits = hvd.alltoall(x)
+    (3 * out).sum().backward()
+    torch.testing.assert_close(x.grad, torch.full((6,), 3.0))
+
+
+def test_allreduce_compression_arg(hvd):
+    x = torch.randn(8, dtype=torch.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, x.half().float())
+
+
 def test_gradient_clipping_pattern(hvd):
     # synchronize → clip → step-with-skip (reference
     # test_torch.py test_gradient_clipping): the clipped gradient must be
@@ -193,3 +222,10 @@ def test_torch_async_grouped_2proc(tmp_path):
     assert rc == 0
     for r in (0, 1):
         assert json.load(open(f"{outfile}.{r}"))["ok"]
+
+
+def test_scalar_allgather_grad(hvd):
+    x = torch.tensor(4.0, requires_grad=True)
+    (2.0 * hvd.allgather(x).sum()).backward()
+    assert x.grad.shape == ()
+    torch.testing.assert_close(x.grad, torch.tensor(2.0))
