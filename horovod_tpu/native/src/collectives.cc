@@ -6,6 +6,8 @@
 #include <algorithm>
 #include <cerrno>
 #include <cmath>
+#include <functional>
+#include <thread>
 #include <cstring>
 
 namespace hvdtpu {
@@ -150,9 +152,77 @@ void ReduceBuf(void* dst, const void* src, int64_t count, DataType dtype,
 
 // Full-duplex transfer: simultaneously stream nsend bytes to send_sock and
 // nrecv bytes from recv_sock, multiplexed with poll() — deadlock-free even
-// when both directions exceed kernel socket buffers.
+// when both directions exceed kernel socket buffers.  ``on_recv(total)``,
+// when set, is invoked as the received prefix grows so the caller can
+// overlap per-chunk work (reduction) with the remaining transfer.
+// Threaded variant for large transfers: the send stream runs on its own
+// thread so both directions (and the on_recv reduction) proceed in
+// parallel — a single-threaded poll loop serializes the kernel copies of
+// the two directions onto one core and halves duplex throughput.
+Status FullDuplexThreaded(Socket* send_sock, const uint8_t* send_buf,
+                          size_t nsend, Socket* recv_sock,
+                          uint8_t* recv_buf, size_t nrecv,
+                          const std::function<void(size_t)>& on_recv) {
+  // Each direction bounds its own progress with poll(60 s) +
+  // MSG_DONTWAIT — a dead peer fails the collective without relying on
+  // socket-level timeouts (which would also break long control-plane
+  // waits elsewhere).
+  Status send_st = Status::OK();
+  std::thread sender([&] {
+    size_t sent = 0;
+    while (sent < nsend) {
+      pollfd pfd{send_sock->fd(), POLLOUT, 0};
+      if (::poll(&pfd, 1, 60000) <= 0) {
+        send_st = Status::Error("collective send timeout");
+        return;
+      }
+      ssize_t k = ::send(send_sock->fd(), send_buf + sent,
+                         std::min<size_t>(nsend - sent, 4 << 20),
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (k < 0) {
+        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+          continue;
+        send_st = Status::Error("send failed in collective");
+        return;
+      }
+      sent += k;
+    }
+  });
+  Status st = Status::OK();
+  size_t received = 0;
+  while (received < nrecv) {
+    pollfd pfd{recv_sock->fd(), POLLIN, 0};
+    if (::poll(&pfd, 1, 60000) <= 0) {
+      st = Status::Error("collective recv timeout");
+      break;
+    }
+    ssize_t k = ::recv(recv_sock->fd(), recv_buf + received,
+                       std::min<size_t>(nrecv - received, 4 << 20),
+                       MSG_DONTWAIT);
+    if (k == 0) {
+      st = Status::Aborted("peer closed during collective");
+      break;
+    }
+    if (k < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+        continue;
+      st = Status::Error("recv failed in collective");
+      break;
+    }
+    received += k;
+    if (on_recv) on_recv(received);
+  }
+  sender.join();
+  return st.ok() ? send_st : st;
+}
+
 Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
-                  Socket* recv_sock, uint8_t* recv_buf, size_t nrecv) {
+                  Socket* recv_sock, uint8_t* recv_buf, size_t nrecv,
+                  const std::function<void(size_t)>& on_recv = nullptr) {
+  if (nsend + nrecv >= (4u << 20)) {
+    return FullDuplexThreaded(send_sock, send_buf, nsend, recv_sock,
+                              recv_buf, nrecv, on_recv);
+  }
   size_t sent = 0, received = 0;
   while (sent < nsend || received < nrecv) {
     struct pollfd fds[2];
@@ -170,7 +240,7 @@ Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
       return Status::Error("collective transfer timeout/poll error");
     if (send_i >= 0 && (fds[send_i].revents & (POLLOUT | POLLERR))) {
       ssize_t k = ::send(send_sock->fd(), send_buf + sent,
-                         std::min<size_t>(nsend - sent, 1 << 20),
+                         std::min<size_t>(nsend - sent, 4 << 20),
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
         return Status::Error("send failed in collective");
@@ -178,12 +248,15 @@ Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
     }
     if (recv_i >= 0 && (fds[recv_i].revents & (POLLIN | POLLERR | POLLHUP))) {
       ssize_t k = ::recv(recv_sock->fd(), recv_buf + received,
-                         std::min<size_t>(nrecv - received, 1 << 20),
+                         std::min<size_t>(nrecv - received, 4 << 20),
                          MSG_DONTWAIT);
       if (k == 0) return Status::Aborted("peer closed during collective");
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
         return Status::Error("recv failed in collective");
-      if (k > 0) received += k;
+      if (k > 0) {
+        received += k;
+        if (on_recv) on_recv(received);
+      }
     }
   }
   return Status::OK();
@@ -255,18 +328,34 @@ Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
 
   Socket* right = net.peer(members[(idx + 1) % m]);
   Socket* left = net.peer(members[(idx - 1 + m) % m]);
-  std::vector<uint8_t> scratch(seg * elem);
+  // Reused across calls: a fresh segment-sized allocation per op would
+  // pay tens of ms of page faults on large tensors.
+  static thread_local std::vector<uint8_t> scratch;
+  if (scratch.size() < static_cast<size_t>(seg * elem))
+    scratch.resize(seg * elem);
 
-  // Reduce-scatter then allgather (bandwidth-optimal ring).
+  // Reduce-scatter then allgather (bandwidth-optimal ring).  The
+  // reduction of each received chunk runs incrementally inside the
+  // transfer (on_recv), overlapping compute with the remaining wire time
+  // instead of serializing a full-segment reduce after each step.
   for (int t = 0; t < m - 1; ++t) {
     int send_s = ((idx - t) % m + m) % m;
     int recv_s = ((idx - t - 1) % m + m) % m;
+    uint8_t* recv_dst = buf + seg_start(recv_s) * elem;
+    size_t reduced = 0;  // elements of this segment already reduced
+    auto reduce_prefix = [&](size_t received_bytes) {
+      size_t avail = received_bytes / elem;
+      if (avail > reduced) {
+        ReduceBuf(recv_dst + reduced * elem,
+                  scratch.data() + reduced * elem,
+                  static_cast<int64_t>(avail - reduced), dtype, op);
+        reduced = avail;
+      }
+    };
     Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
                            seg_count(send_s) * elem, left, scratch.data(),
-                           seg_count(recv_s) * elem);
+                           seg_count(recv_s) * elem, reduce_prefix);
     if (!st.ok()) return st;
-    ReduceBuf(buf + seg_start(recv_s) * elem, scratch.data(),
-              seg_count(recv_s), dtype, op);
   }
   for (int t = 0; t < m - 1; ++t) {
     int send_s = ((idx + 1 - t) % m + m) % m;

@@ -73,6 +73,15 @@ namespace {
 void SetNoDelay(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Large kernel buffers keep the full-duplex ring streaming instead of
+  // stalling on flow control (both directions carry MBs per step).
+  // (No socket-level SO_SNDTIMEO/RCVTIMEO: control-plane waits — e.g. a
+  // worker blocking on the address table while slow peers start up — are
+  // legitimately longer than any collective timeout; the collective paths
+  // bound their own waits with poll().)
+  int bufsz = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
 }
 
 int Listen(uint16_t port, uint16_t* bound_port) {

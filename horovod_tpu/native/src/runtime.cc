@@ -353,22 +353,37 @@ void Runtime::ExecuteAllreduce(
   for (auto n : resp.sizes) total_elems += n;
   const size_t elem = DataTypeSize(resp.dtype);
   const size_t total_bytes = total_elems * elem;
-  if (fusion_buffer_.size() < total_bytes) fusion_buffer_.resize(total_bytes);
-  uint8_t* fb = fusion_buffer_.data();
 
-  // Pack (MemcpyInFusionBuffer, collective_operations.cc).
-  timeline_.Record(resp.names[0], "B", "MEMCPY_IN_FUSION_BUFFER");
-  int64_t off = 0;
-  for (size_t i = 0; i < resp.names.size(); ++i) {
-    int64_t nbytes = resp.sizes[i] * elem;
-    if (entries[i] && entries[i]->input) {
-      memcpy(fb + off, entries[i]->input, nbytes);
-    } else {
-      memset(fb + off, 0, nbytes);  // joined-rank zero proxy
+  // Single-tensor fast path: run the ring in place on the caller's output
+  // buffer — no fusion arena, at most one copy (zero when submitted
+  // in-place with input == output).  Fusion only ever pays for itself
+  // when it batches multiple tensors.
+  uint8_t* fb;
+  bool in_place = resp.names.size() == 1 && entries[0] &&
+                  entries[0]->input && entries[0]->output;
+  if (in_place) {
+    fb = static_cast<uint8_t*>(entries[0]->output);
+    if (entries[0]->output != entries[0]->input)
+      memcpy(fb, entries[0]->input, total_bytes);
+  } else {
+    if (fusion_buffer_.size() < total_bytes)
+      fusion_buffer_.resize(total_bytes);
+    fb = fusion_buffer_.data();
+
+    // Pack (MemcpyInFusionBuffer, collective_operations.cc).
+    timeline_.Record(resp.names[0], "B", "MEMCPY_IN_FUSION_BUFFER");
+    int64_t off = 0;
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      int64_t nbytes = resp.sizes[i] * elem;
+      if (entries[i] && entries[i]->input) {
+        memcpy(fb + off, entries[i]->input, nbytes);
+      } else {
+        memset(fb + off, 0, nbytes);  // joined-rank zero proxy
+      }
+      off += nbytes;
     }
-    off += nbytes;
+    timeline_.Record(resp.names[0], "E", "MEMCPY_IN_FUSION_BUFFER");
   }
-  timeline_.Record(resp.names[0], "E", "MEMCPY_IN_FUSION_BUFFER");
 
   if (resp.prescale != 1.0)
     ScaleBuffer(fb, total_elems, resp.dtype, resp.prescale);
@@ -391,13 +406,15 @@ void Runtime::ExecuteAllreduce(
       ScaleBuffer(fb, total_elems, resp.dtype, 1.0 / net_->size());
     if (resp.postscale != 1.0)
       ScaleBuffer(fb, total_elems, resp.dtype, resp.postscale);
-    // Unpack.
-    off = 0;
-    for (size_t i = 0; i < resp.names.size(); ++i) {
-      int64_t nbytes = resp.sizes[i] * elem;
-      if (entries[i] && entries[i]->output)
-        memcpy(entries[i]->output, fb + off, nbytes);
-      off += nbytes;
+    if (!in_place) {
+      // Unpack.
+      int64_t off = 0;
+      for (size_t i = 0; i < resp.names.size(); ++i) {
+        int64_t nbytes = resp.sizes[i] * elem;
+        if (entries[i] && entries[i]->output)
+          memcpy(entries[i]->output, fb + off, nbytes);
+        off += nbytes;
+      }
     }
   }
   for (auto& e : entries)

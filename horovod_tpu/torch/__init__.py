@@ -340,10 +340,9 @@ def allreduce_async_(tensor: _torch.Tensor, op: int = Average,
                      prescale_factor: float = 1.0,
                      postscale_factor: float = 1.0) -> int:
     def _sync(t):
-        out = allreduce(t, op=op, name=name,
-                        prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
-        t.copy_(out)
+        with _torch.no_grad():
+            t.copy_(_allreduce_nograd(t, op, name, prescale_factor,
+                                      postscale_factor))
     return _inplace_async(
         tensor,
         lambda ctl, buf: (ctl.allreduce_async_(
@@ -368,20 +367,44 @@ def broadcast_async_(tensor: _torch.Tensor, root_rank: int = 0,
         finish=_finish)
 
 
-def grouped_allreduce(tensors: List[_torch.Tensor], op: int = Average,
-                      name: Optional[str] = None) -> List[_torch.Tensor]:
-    """Allreduce a group atomically — members negotiate and fuse together
-    (reference torch/mpi_ops.py grouped_allreduce / GroupTable)."""
+def _grouped_allreduce_nograd(tensors, op: int,
+                              name: Optional[str]) -> List[_torch.Tensor]:
     outs = _C.grouped_allreduce([_to_numpy(t) for t in tensors], op=op,
                                 name=name)
     return [_torch.from_numpy(np.asarray(o)).to(t.dtype)
             for o, t in zip(outs, tensors)]
 
 
+class _GroupedAllreduceFn(_torch.autograd.Function):
+    """Differentiable grouped allreduce (reference torch/mpi_ops.py
+    grouped-allreduce backward): upstream gradients grouped-allreduce with
+    the same op."""
+
+    @staticmethod
+    def forward(ctx, op, name, *tensors):
+        ctx.op = op
+        return tuple(_grouped_allreduce_nograd(list(tensors), op, name))
+
+    @staticmethod
+    def backward(ctx, *grads):
+        gs = _grouped_allreduce_nograd(list(grads), ctx.op, None)
+        return (None, None, *gs)
+
+
+def grouped_allreduce(tensors: List[_torch.Tensor], op: int = Average,
+                      name: Optional[str] = None) -> List[_torch.Tensor]:
+    """Allreduce a group atomically — members negotiate and fuse together
+    (reference torch/mpi_ops.py grouped_allreduce / GroupTable);
+    differentiable."""
+    return list(_GroupedAllreduceFn.apply(op, name, *tensors))
+
+
 def grouped_allreduce_(tensors: List[_torch.Tensor], op: int = Average,
                        name: Optional[str] = None) -> List[_torch.Tensor]:
-    for t, o in zip(tensors, grouped_allreduce(tensors, op=op, name=name)):
-        t.copy_(o)
+    outs = _grouped_allreduce_nograd(tensors, op, name)
+    with _torch.no_grad():
+        for t, o in zip(tensors, outs):
+            t.copy_(o)
     return tensors
 
 

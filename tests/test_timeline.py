@@ -51,3 +51,44 @@ def test_timeline_chrome_trace(tmp_path):
         ranks = {e["args"]["rank"] for e in ready
                  if e["name"] == f"tl.{i}"}
         assert ranks == {0, 1}, f"tensor tl.{i} ready ranks {ranks}"
+
+
+RUNTIME_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    # Phase 1: no timeline.
+    hvd.allreduce(np.ones((4,), dtype=np.float32), op=hvd.Sum, name="pre")
+    # Phase 2: runtime-started timeline captures only what follows
+    # (reference horovod_start_timeline C API, operations.cc:740-769).
+    if hvd.rank() == 0:
+        hvd.start_timeline({tl!r}, mark_cycles=True)
+    hvd.barrier()
+    hvd.allreduce(np.ones((4,), dtype=np.float32), op=hvd.Sum, name="mid")
+    hvd.barrier()
+    if hvd.rank() == 0:
+        hvd.stop_timeline()
+    hvd.allreduce(np.ones((4,), dtype=np.float32), op=hvd.Sum, name="post")
+    hvd.shutdown()
+""")
+
+
+def test_timeline_runtime_start_stop_and_cycles(tmp_path):
+    from horovod_tpu.runner.launch import main
+    tl = str(tmp_path / "tl_runtime.json")
+    script = tmp_path / "worker.py"
+    script.write_text(RUNTIME_WORKER.format(repo=REPO, tl=tl))
+    rc = main(["-np", "2", "--controller-port", "28713",
+               sys.executable, str(script)])
+    assert rc == 0
+    events = json.load(open(tl))
+    names = {e["name"] for e in events}
+    assert "mid" in names, "runtime-started timeline missed the mid op"
+    assert "pre" not in names, "timeline captured ops before start"
+    assert "post" not in names, "timeline captured ops after stop"
+    # mark_cycles=True emits background-loop cycle markers
+    # (HOROVOD_TIMELINE_MARK_CYCLES, reference timeline.cc:623).
+    assert any(e.get("cat") == "CYCLE" or "CYCLE" in e["name"].upper()
+               for e in events), "no cycle markers"

@@ -229,3 +229,25 @@ def test_scalar_allgather_grad(hvd):
     (2.0 * hvd.allgather(x).sum()).backward()
     assert x.grad.shape == ()
     torch.testing.assert_close(x.grad, torch.tensor(2.0))
+
+
+def test_grouped_allreduce_differentiable(hvd):
+    ts = [torch.randn(3, requires_grad=True) for _ in range(3)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Sum)
+    sum(o.sum() for o in outs).backward()
+    for t in ts:
+        torch.testing.assert_close(t.grad, torch.ones(3))
+
+
+def test_inplace_ops_on_leaf_params(hvd):
+    # The whole in-place family must accept requires-grad leaves
+    # (reference semantics: in-place collectives are data ops).
+    p = torch.nn.Parameter(torch.ones(4))
+    hvd.allreduce_(p.data, op=hvd.Sum)
+    hvd.broadcast_(p.data, root_rank=0)
+    h = hvd.allreduce_async_(p.data, op=hvd.Sum)
+    hvd.synchronize(h)
+    hvd.grouped_allreduce_([p.data], op=hvd.Sum)
+    q = torch.ones(3, requires_grad=True)
+    h = hvd.allreduce_async_(q, op=hvd.Sum)  # leaf with requires_grad
+    hvd.synchronize(h)
