@@ -150,79 +150,111 @@ void ReduceBuf(void* dst, const void* src, int64_t count, DataType dtype,
   }
 }
 
-// Full-duplex transfer: simultaneously stream nsend bytes to send_sock and
-// nrecv bytes from recv_sock, multiplexed with poll() — deadlock-free even
-// when both directions exceed kernel socket buffers.  ``on_recv(total)``,
-// when set, is invoked as the received prefix grows so the caller can
-// overlap per-chunk work (reduction) with the remaining transfer.
-// Threaded variant for large transfers: the send stream runs on its own
-// thread so both directions (and the on_recv reduction) proceed in
-// parallel — a single-threaded poll loop serializes the kernel copies of
-// the two directions onto one core and halves duplex throughput.
-Status FullDuplexThreaded(Socket* send_sock, const uint8_t* send_buf,
-                          size_t nsend, Socket* recv_sock,
-                          uint8_t* recv_buf, size_t nrecv,
-                          const std::function<void(size_t)>& on_recv) {
-  // Each direction bounds its own progress with poll(60 s) +
-  // MSG_DONTWAIT — a dead peer fails the collective without relying on
-  // socket-level timeouts (which would also break long control-plane
-  // waits elsewhere).
-  Status send_st = Status::OK();
-  std::thread sender([&] {
-    size_t sent = 0;
-    while (sent < nsend) {
-      pollfd pfd{send_sock->fd(), POLLOUT, 0};
-      if (::poll(&pfd, 1, 60000) <= 0) {
-        send_st = Status::Error("collective send timeout");
-        return;
-      }
-      ssize_t k = ::send(send_sock->fd(), send_buf + sent,
-                         std::min<size_t>(nsend - sent, 4 << 20),
-                         MSG_NOSIGNAL | MSG_DONTWAIT);
-      if (k < 0) {
-        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
-          continue;
-        send_st = Status::Error("send failed in collective");
-        return;
-      }
-      sent += k;
+// One-directional streams: shared memory when the peer is on this host
+// (Network::shm_tx/shm_rx — two memcpys, no syscalls on the bulk path),
+// TCP otherwise.  Used standalone for chains/broadcasts and paired by
+// FullDuplex for ring steps.
+
+Status SendStream(Network& net, int peer, const uint8_t* buf, size_t n) {
+  if (n == 0) return Status::OK();
+  if (ShmChannel* ch = net.shm_tx(peer)) {
+    size_t off = 0;
+    while (off < n) {
+      size_t k = std::min(n - off, ShmChannel::kSlotBytes);
+      Status st = ch->Push(buf + off, k);
+      if (!st.ok()) return st;
+      off += k;
     }
-  });
-  Status st = Status::OK();
-  size_t received = 0;
-  while (received < nrecv) {
-    pollfd pfd{recv_sock->fd(), POLLIN, 0};
-    if (::poll(&pfd, 1, 60000) <= 0) {
-      st = Status::Error("collective recv timeout");
-      break;
-    }
-    ssize_t k = ::recv(recv_sock->fd(), recv_buf + received,
-                       std::min<size_t>(nrecv - received, 4 << 20),
-                       MSG_DONTWAIT);
-    if (k == 0) {
-      st = Status::Aborted("peer closed during collective");
-      break;
-    }
+    return Status::OK();
+  }
+  Socket* sock = net.peer(peer);
+  size_t sent = 0;
+  while (sent < n) {
+    pollfd pfd{sock->fd(), POLLOUT, 0};
+    int pr = ::poll(&pfd, 1, 60000);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr <= 0) return Status::Error("collective send timeout");
+    ssize_t k = ::send(sock->fd(), buf + sent,
+                       std::min<size_t>(n - sent, 4 << 20),
+                       MSG_NOSIGNAL | MSG_DONTWAIT);
     if (k < 0) {
       if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
         continue;
-      st = Status::Error("recv failed in collective");
-      break;
+      return Status::Error("send failed in collective");
+    }
+    sent += k;
+  }
+  return Status::OK();
+}
+
+Status RecvStream(Network& net, int peer, uint8_t* dst, size_t n,
+                  const std::function<void(size_t)>& on_recv = nullptr) {
+  if (n == 0) return Status::OK();
+  if (ShmChannel* ch = net.shm_rx(peer)) {
+    size_t off = 0;
+    while (off < n) {
+      Status st = ch->Pop([&](const uint8_t* p, size_t len) {
+        memcpy(dst + off, p, len);
+        off += len;
+        if (on_recv) on_recv(off);
+      });
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  Socket* sock = net.peer(peer);
+  size_t received = 0;
+  while (received < n) {
+    pollfd pfd{sock->fd(), POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 60000);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr <= 0) return Status::Error("collective recv timeout");
+    ssize_t k = ::recv(sock->fd(), dst + received,
+                       std::min<size_t>(n - received, 4 << 20),
+                       MSG_DONTWAIT);
+    if (k == 0) return Status::Aborted("peer closed during collective");
+    if (k < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+        continue;
+      return Status::Error("recv failed in collective");
     }
     received += k;
     if (on_recv) on_recv(received);
   }
+  return Status::OK();
+}
+
+// Full-duplex transfer: simultaneously stream nsend bytes toward
+// send_peer and nrecv bytes from recv_peer.  ``on_recv(total)``, when
+// set, is invoked as the received prefix grows so the caller can overlap
+// per-chunk work (reduction) with the remaining transfer.
+// Threaded variant for large or shm transfers: the send stream runs on
+// its own thread so both directions (and the on_recv reduction) proceed
+// in parallel — a single-threaded poll loop serializes the kernel copies
+// of the two directions onto one core and halves duplex throughput.
+Status FullDuplexThreaded(Network& net, int send_peer,
+                          const uint8_t* send_buf, size_t nsend,
+                          int recv_peer, uint8_t* recv_buf, size_t nrecv,
+                          const std::function<void(size_t)>& on_recv) {
+  Status send_st = Status::OK();
+  std::thread sender(
+      [&] { send_st = SendStream(net, send_peer, send_buf, nsend); });
+  Status st = RecvStream(net, recv_peer, recv_buf, nrecv, on_recv);
   sender.join();
   return st.ok() ? send_st : st;
 }
 
-Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
-                  Socket* recv_sock, uint8_t* recv_buf, size_t nrecv,
+Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
+                  size_t nsend, int recv_peer, uint8_t* recv_buf,
+                  size_t nrecv,
                   const std::function<void(size_t)>& on_recv = nullptr) {
-  if (nsend + nrecv >= (4u << 20)) {
-    return FullDuplexThreaded(send_sock, send_buf, nsend, recv_sock,
+  if (net.shm_tx(send_peer) != nullptr ||
+      net.shm_rx(recv_peer) != nullptr || nsend + nrecv >= (4u << 20)) {
+    return FullDuplexThreaded(net, send_peer, send_buf, nsend, recv_peer,
                               recv_buf, nrecv, on_recv);
   }
+  Socket* send_sock = net.peer(send_peer);
+  Socket* recv_sock = net.peer(recv_peer);
   size_t sent = 0, received = 0;
   while (sent < nsend || received < nrecv) {
     struct pollfd fds[2];
@@ -236,7 +268,9 @@ Status FullDuplex(Socket* send_sock, const uint8_t* send_buf, size_t nsend,
       fds[nf] = {recv_sock->fd(), POLLIN, 0};
       recv_i = nf++;
     }
-    if (::poll(fds, nf, 60000) <= 0)
+    int pr = ::poll(fds, nf, 60000);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr <= 0)
       return Status::Error("collective transfer timeout/poll error");
     if (send_i >= 0 && (fds[send_i].revents & (POLLOUT | POLLERR))) {
       ssize_t k = ::send(send_sock->fd(), send_buf + sent,
@@ -326,8 +360,8 @@ Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
     return std::min<int64_t>(seg, count - seg_start(s));
   };
 
-  Socket* right = net.peer(members[(idx + 1) % m]);
-  Socket* left = net.peer(members[(idx - 1 + m) % m]);
+  const int right = members[(idx + 1) % m];
+  const int left = members[(idx - 1 + m) % m];
   // Reused across calls: a fresh segment-sized allocation per op would
   // pay tens of ms of page faults on large tensors.
   static thread_local std::vector<uint8_t> scratch;
@@ -352,7 +386,7 @@ Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
         reduced = avail;
       }
     };
-    Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
+    Status st = FullDuplex(net, right, buf + seg_start(send_s) * elem,
                            seg_count(send_s) * elem, left, scratch.data(),
                            seg_count(recv_s) * elem, reduce_prefix);
     if (!st.ok()) return st;
@@ -360,7 +394,7 @@ Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
   for (int t = 0; t < m - 1; ++t) {
     int send_s = ((idx + 1 - t) % m + m) % m;
     int recv_s = ((idx - t) % m + m) % m;
-    Status st = FullDuplex(right, buf + seg_start(send_s) * elem,
+    Status st = FullDuplex(net, right, buf + seg_start(send_s) * elem,
                            seg_count(send_s) * elem, left,
                            buf + seg_start(recv_s) * elem,
                            seg_count(recv_s) * elem);
@@ -406,15 +440,22 @@ Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
   // Phase 3: leaders broadcast the global result within their node.
   const size_t nbytes = count * DataTypeSize(dtype);
   if (local_size > 1) {
-    // Chain within the node: leader → leader+1 → ... → leader+L-1.
+    // Chain within the node: leader → leader+1 → ... → leader+L-1,
+    // chunk-pipelined (intra-node hops ride shm when available).
     int pos = rank - leader;
-    if (pos > 0) {
-      st = net.peer(rank - 1)->RecvAll(vbuf, nbytes);
-      if (!st.ok()) return st;
-    }
-    if (pos < local_size - 1) {
-      st = net.peer(rank + 1)->SendAll(vbuf, nbytes);
-      if (!st.ok()) return st;
+    uint8_t* bbuf = static_cast<uint8_t*>(vbuf);
+    const int64_t kChunk = 4 << 20;
+    for (int64_t off = 0; off < static_cast<int64_t>(nbytes);
+         off += kChunk) {
+      int64_t k = std::min(kChunk, static_cast<int64_t>(nbytes) - off);
+      if (pos > 0) {
+        st = RecvStream(net, rank - 1, bbuf + off, k);
+        if (!st.ok()) return st;
+      }
+      if (pos < local_size - 1) {
+        st = SendStream(net, rank + 1, bbuf + off, k);
+        if (!st.ok()) return st;
+      }
     }
   }
   return Status::OK();
@@ -426,13 +467,14 @@ Status RingAllgatherv(Network& net, uint8_t* buf,
   const int size = net.size();
   const int rank = net.rank();
   if (size == 1) return Status::OK();
-  Socket* right = net.peer((rank + 1) % size);
-  Socket* left = net.peer((rank - 1 + size) % size);
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
   for (int t = 0; t < size - 1; ++t) {
     int send_b = ((rank - t) % size + size) % size;
     int recv_b = ((rank - t - 1) % size + size) % size;
-    Status st = FullDuplex(right, buf + offsets[send_b], bytes[send_b],
-                           left, buf + offsets[recv_b], bytes[recv_b]);
+    Status st = FullDuplex(net, right, buf + offsets[send_b],
+                           bytes[send_b], left, buf + offsets[recv_b],
+                           bytes[recv_b]);
     if (!st.ok()) return st;
   }
   return Status::OK();
@@ -443,19 +485,24 @@ Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
   const int rank = net.rank();
   if (size == 1 || nbytes == 0) return Status::OK();
   uint8_t* buf = static_cast<uint8_t*>(vbuf);
-  // Rotate so root is position 0 in the chain.
+  // Rotate so root is position 0 in the chain; forward chunk-by-chunk so
+  // the chain pipelines (downstream ranks start receiving while upstream
+  // bytes are still in flight) instead of store-and-forwarding the whole
+  // payload at each hop.
   int pos = ((rank - root) % size + size) % size;
-  if (pos > 0) {
-    Socket* prev = net.peer((rank - 1 + size) % size);
-    Status st = prev ? prev->RecvAll(buf, nbytes)
-                     : Status::Error("no peer");
-    if (!st.ok()) return st;
-  }
-  if (pos < size - 1) {
-    Socket* next = net.peer((rank + 1) % size);
-    Status st = next ? next->SendAll(buf, nbytes)
-                     : Status::Error("no peer");
-    if (!st.ok()) return st;
+  int prev = (rank - 1 + size) % size;
+  int next = (rank + 1) % size;
+  const int64_t kChunk = 4 << 20;
+  for (int64_t off = 0; off < nbytes; off += kChunk) {
+    int64_t k = std::min(kChunk, nbytes - off);
+    if (pos > 0) {
+      Status st = RecvStream(net, prev, buf + off, k);
+      if (!st.ok()) return st;
+    }
+    if (pos < size - 1) {
+      Status st = SendStream(net, next, buf + off, k);
+      if (!st.ok()) return st;
+    }
   }
   return Status::OK();
 }
@@ -476,9 +523,8 @@ Status PairwiseAlltoallv(Network& net, const uint8_t* send,
   for (int d = 1; d < size; ++d) {
     int to = (rank + d) % size;
     int from = (rank - d + size) % size;
-    Status st = FullDuplex(net.peer(to), send + soff[to], send_bytes[to],
-                           net.peer(from), recv + roff[from],
-                           recv_bytes[from]);
+    Status st = FullDuplex(net, to, send + soff[to], send_bytes[to],
+                           from, recv + roff[from], recv_bytes[from]);
     if (!st.ok()) return st;
   }
   return Status::OK();

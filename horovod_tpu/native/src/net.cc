@@ -258,6 +258,7 @@ std::unique_ptr<Network> Network::Connect(int rank, int size,
       blob.insert(blob.end(), table[i].begin(), table[i].end());
     }
     for (int i = 1; i < size; ++i) net->peers_[i]->SendFrame(blob);
+    net->SetupShm(table, coord_addr);
   } else {
     int fd = DialRetry(coord_host, coord_port);
     if (fd < 0) {
@@ -312,10 +313,74 @@ std::unique_ptr<Network> Network::Connect(int rank, int size,
       psock->RecvAll(&peer_rank, 4);
       net->peers_[peer_rank] = std::move(psock);
     }
+    net->SetupShm(table, coord_addr);
   }
   ::close(listen_fd);
   *status = Status::OK();
   return net;
+}
+
+void Network::SetupShm(const std::vector<std::string>& table,
+                       const std::string& tag) {
+  // A rank with HVD_TPU_DISABLE_SHM still runs the handshake bytes (as
+  // "not participating") — a unilateral early-return would desynchronize
+  // the shared data sockets for peers that do participate.
+  const bool disabled = getenv("HVD_TPU_DISABLE_SHM") != nullptr;
+  std::string my_host, host;
+  uint16_t port;
+  if (!ParseAddr(table[rank_], &my_host, &port)) return;
+  std::vector<int> local;
+  for (int r = 0; r < size_; ++r) {
+    if (r != rank_ && ParseAddr(table[r], &host, &port) &&
+        host == my_host) {
+      local.push_back(r);
+    }
+  }
+  if (local.empty()) return;
+
+  // Segment names are scoped to this job by the coordinator address
+  // (unique per launch/elastic round).
+  std::string base = "/hvt_";
+  for (char c : tag)
+    base += (isalnum(static_cast<unsigned char>(c)) ? c : '_');
+
+  // Phase 1: create all outgoing segments, then confirm creation with
+  // each peer BEFORE anyone opens — opening only after the peer's create
+  // is confirmed means a stale segment from a crashed job (which Create
+  // unlinks and replaces) can never be the object the consumer maps.
+  std::vector<std::unique_ptr<ShmChannel>> tx(size_);
+  if (!disabled) {
+    for (int r : local) {
+      tx[r] = ShmChannel::Create(base + "_" + std::to_string(rank_) +
+                                 "_" + std::to_string(r));
+    }
+  }
+  for (int r : local) {
+    uint8_t my_created = tx[r] != nullptr ? 1 : 0;
+    uint8_t peer_created = 0;
+    if (!peers_[r]->SendAll(&my_created, 1).ok() ||
+        !peers_[r]->RecvAll(&peer_created, 1).ok()) {
+      if (tx[r]) tx[r]->Unlink();
+      tx[r].reset();
+      continue;
+    }
+    // Phase 2: open the peer's (fresh) segment, report back.
+    std::unique_ptr<ShmChannel> rx;
+    if (!disabled && peer_created) {
+      rx = ShmChannel::Open(base + "_" + std::to_string(r) + "_" +
+                            std::to_string(rank_));
+    }
+    uint8_t my_rx_ok = rx != nullptr ? 1 : 0;
+    uint8_t peer_rx_ok = 0;
+    bool hs_ok = peers_[r]->SendAll(&my_rx_ok, 1).ok() &&
+                 peers_[r]->RecvAll(&peer_rx_ok, 1).ok();
+    if (tx[r]) {
+      tx[r]->Unlink();  // both ends mapped (or unused): never leak
+      if (hs_ok && peer_rx_ok) shm_tx_[r] = std::move(tx[r]);
+      else tx[r].reset();
+    }
+    if (hs_ok && my_rx_ok) shm_rx_[r] = std::move(rx);
+  }
 }
 
 }  // namespace hvdtpu

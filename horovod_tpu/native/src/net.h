@@ -12,6 +12,7 @@
 #include <vector>
 
 #include "common.h"
+#include "shm.h"
 
 namespace hvdtpu {
 
@@ -46,13 +47,24 @@ class Network {
   int rank() const { return rank_; }
   int size() const { return size_; }
 
+  // Same-host shared-memory channels (null when the peer is remote or
+  // shm setup failed — callers fall back to the TCP socket).
+  ShmChannel* shm_tx(int r) { return shm_tx_[r].get(); }  // me → r
+  ShmChannel* shm_rx(int r) { return shm_rx_[r].get(); }  // r → me
+
  private:
   Network(int rank, int size) : rank_(rank), size_(size) {
     peers_.resize(size);
+    shm_tx_.resize(size);
+    shm_rx_.resize(size);
   }
+  void SetupShm(const std::vector<std::string>& table,
+                const std::string& tag);
   int rank_;
   int size_;
   std::vector<std::unique_ptr<Socket>> peers_;
+  std::vector<std::unique_ptr<ShmChannel>> shm_tx_;
+  std::vector<std::unique_ptr<ShmChannel>> shm_rx_;
 };
 
 }  // namespace hvdtpu

@@ -33,6 +33,8 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
   if (!timeline_file.empty() && rank == 0)
     timeline_.Start(timeline_file, rank);
   stop_ = false;
+  shutdown_requested_ = false;
+  loop_exited_ = false;
   loop_dead_ = false;
   loop_error_ = Status::OK();
   counter_start_ = std::chrono::steady_clock::now();
@@ -44,6 +46,18 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
 
 void Runtime::Shutdown() {
   if (!initialized_) return;
+  // Phase 1: announce shutdown on the wire and wait for the global
+  // consensus exit (every rank requested it) — severs no straggler.
+  shutdown_requested_ = true;
+  enqueue_cv_.notify_all();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(30);
+  while (!loop_exited_ &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  // Phase 2 (fallback): peers that will never consent (hung or gone)
+  // cannot hold this process hostage.
   stop_ = true;
   enqueue_cv_.notify_all();
   if (background_.joinable()) background_.join();
@@ -218,7 +232,7 @@ void Runtime::BackgroundLoop() {
     }
     rl.join = join_requested_.load();
     rl.barrier = barrier_requested_.load();
-    rl.shutdown = stop_.load();
+    rl.shutdown = shutdown_requested_.load() || stop_.load();
 
     // 2. Controller round.
     ResponseList responses;
@@ -239,10 +253,11 @@ void Runtime::BackgroundLoop() {
         pending_order_.clear();
       }
       for (auto& e : all) Finish(e, st);
-      // Unblock join()/barrier() waiters too.
+      // Unblock join()/barrier() waiters too — without clobbering a
+      // release that was delivered but not yet consumed.
       {
         std::lock_guard<std::mutex> lk(sync_mu_);
-        last_joined_rank_ = -1;
+        if (last_joined_rank_ == -2) last_joined_rank_ = -1;
         barrier_released_ = true;
       }
       sync_cv_.notify_all();
@@ -284,6 +299,7 @@ void Runtime::BackgroundLoop() {
     if (responses.shutdown) break;
     (void)cycle_start;
   }
+  loop_exited_ = true;
 }
 
 void Runtime::ExecuteResponse(const Response& resp) {
@@ -509,7 +525,11 @@ int Runtime::JoinBlocking() {
   join_requested_ = true;
   enqueue_cv_.notify_one();
   std::unique_lock<std::mutex> lk(sync_mu_);
-  sync_cv_.wait(lk, [this] { return last_joined_rank_ >= 0 || stop_; });
+  // -2 = idle sentinel; >= 0 = released (last joined rank); -1 = the
+  // background loop died (loop_dead_ unblock) — waiting for >= 0 only
+  // would strand the caller forever on loop failure.
+  sync_cv_.wait(lk,
+                [this] { return last_joined_rank_ != -2 || stop_; });
   int r = last_joined_rank_;
   last_joined_rank_ = -2;
   return r;

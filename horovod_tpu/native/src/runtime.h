@@ -84,6 +84,13 @@ class Runtime {
 
   std::atomic<bool> initialized_{false};
   std::atomic<bool> stop_{false};
+  // Graceful teardown: Shutdown() requests, the loop announces it on the
+  // wire each round, and only global consensus (responses.shutdown)
+  // breaks the loop — so the coordinator keeps serving rounds until
+  // every rank is ready to leave (a hard stop would sever stragglers
+  // mid-negotiation).
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> loop_exited_{false};
   std::atomic<bool> loop_dead_{false};
   std::unique_ptr<Network> net_;
   std::unique_ptr<Controller> controller_;

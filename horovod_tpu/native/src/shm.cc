@@ -1,0 +1,147 @@
+#include "shm.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+
+namespace hvdtpu {
+
+namespace {
+
+constexpr size_t kMapBytes =
+    sizeof(ShmChannel::Hdr) + ShmChannel::kSlots * ShmChannel::kSlotBytes;
+
+// Bounded wait on a shm condition: brief spin for the streaming case,
+// then micro-sleeps; 60 s deadline like the socket paths.
+template <typename Cond>
+Status WaitFor(Cond cond, const char* what) {
+  for (int i = 0; i < 4096; ++i) {
+    if (cond()) return Status::OK();
+  }
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(60);
+  while (!cond()) {
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::Error(std::string("shm channel timeout: ") + what);
+    ::usleep(50);
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+std::unique_ptr<ShmChannel> ShmChannel::Create(const std::string& name) {
+  int fd = ::shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0 && errno == EEXIST) {
+    // Stale segment from a crashed prior job: replace it.
+    ::shm_unlink(name.c_str());
+    fd = ::shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  }
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, kMapBytes) != 0) {
+    ::close(fd);
+    ::shm_unlink(name.c_str());
+    return nullptr;
+  }
+  void* map = ::mmap(nullptr, kMapBytes, PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) {
+    ::shm_unlink(name.c_str());
+    return nullptr;
+  }
+  auto ch = std::unique_ptr<ShmChannel>(new ShmChannel());
+  ch->map_ = map;
+  ch->map_bytes_ = kMapBytes;
+  ch->name_ = name;
+  ch->hdr_ = new (map) Hdr();
+  ch->hdr_->head.store(0, std::memory_order_relaxed);
+  ch->hdr_->tail.store(0, std::memory_order_relaxed);
+  ch->slots_ = static_cast<uint8_t*>(map) + sizeof(Hdr);
+  return ch;
+}
+
+std::unique_ptr<ShmChannel> ShmChannel::Open(const std::string& name) {
+  int fd = -1;
+  // The creator may not have finished Create yet: retry briefly.
+  for (int i = 0; i < 200 && fd < 0; ++i) {
+    fd = ::shm_open(name.c_str(), O_RDWR, 0600);
+    if (fd < 0) ::usleep(10000);
+  }
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (::fstat(fd, &st) != 0 ||
+      static_cast<size_t>(st.st_size) < kMapBytes) {
+    // Racing the creator's ftruncate: wait for the full size.
+    for (int i = 0; i < 200; ++i) {
+      ::usleep(10000);
+      if (::fstat(fd, &st) == 0 &&
+          static_cast<size_t>(st.st_size) >= kMapBytes) {
+        break;
+      }
+    }
+    if (static_cast<size_t>(st.st_size) < kMapBytes) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  void* map = ::mmap(nullptr, kMapBytes, PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) return nullptr;
+  auto ch = std::unique_ptr<ShmChannel>(new ShmChannel());
+  ch->map_ = map;
+  ch->map_bytes_ = kMapBytes;
+  ch->name_ = name;
+  ch->hdr_ = static_cast<Hdr*>(map);
+  ch->slots_ = static_cast<uint8_t*>(map) + sizeof(Hdr);
+  return ch;
+}
+
+ShmChannel::~ShmChannel() {
+  if (map_ != nullptr) ::munmap(map_, map_bytes_);
+}
+
+void ShmChannel::Unlink() {
+  if (!name_.empty()) {
+    ::shm_unlink(name_.c_str());
+    name_.clear();
+  }
+}
+
+Status ShmChannel::Push(const uint8_t* data, size_t n) {
+  uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+  Status st = WaitFor(
+      [&] {
+        return head - hdr_->tail.load(std::memory_order_acquire) < kSlots;
+      },
+      "producer waiting for a free slot");
+  if (!st.ok()) return st;
+  size_t slot = head % kSlots;
+  memcpy(slots_ + slot * kSlotBytes, data, n);
+  hdr_->lens[slot] = n;
+  hdr_->head.store(head + 1, std::memory_order_release);
+  return Status::OK();
+}
+
+Status ShmChannel::Pop(
+    const std::function<void(const uint8_t*, size_t)>& consume) {
+  uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
+  Status st = WaitFor(
+      [&] {
+        return hdr_->head.load(std::memory_order_acquire) > tail;
+      },
+      "consumer waiting for a chunk");
+  if (!st.ok()) return st;
+  size_t slot = tail % kSlots;
+  consume(slots_ + slot * kSlotBytes, hdr_->lens[slot]);
+  hdr_->tail.store(tail + 1, std::memory_order_release);
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

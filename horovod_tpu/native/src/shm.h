@@ -1,0 +1,63 @@
+// Same-host bulk transport: one-directional POSIX-shm channels.
+//
+// TPU-native equivalent of the reference's shared-memory staging for
+// same-node ranks (MPIHierarchicalAllgather's POSIX shm window,
+// mpi_operations.cc MEMCPY_IN_SHARED_BUFFER): local peers move collective
+// payloads through a double-buffered shared segment (two memcpys, no
+// kernel socket copies, no syscalls on the bulk path) while remote peers
+// stay on TCP.  Synchronization is head/tail atomics in the segment —
+// no tokens on the sockets, so the control plane is untouched.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class ShmChannel {
+ public:
+  static constexpr size_t kSlots = 2;
+  static constexpr size_t kSlotBytes = 4 << 20;
+
+  struct Hdr {
+    std::atomic<uint64_t> head;  // chunks published by the producer
+    char pad0[64 - sizeof(std::atomic<uint64_t>)];
+    std::atomic<uint64_t> tail;  // chunks consumed by the consumer
+    char pad1[64 - sizeof(std::atomic<uint64_t>)];
+    uint64_t lens[kSlots];
+  };
+
+  // Producer side (the sending rank) creates; consumer opens.  Both
+  // return nullptr on failure (no /dev/shm, permission, size) — callers
+  // fall back to TCP.
+  static std::unique_ptr<ShmChannel> Create(const std::string& name);
+  static std::unique_ptr<ShmChannel> Open(const std::string& name);
+  ~ShmChannel();
+
+  // Remove the name (mapping stays valid); call once both ends mapped so
+  // a crash cannot leak the segment.
+  void Unlink();
+
+  // Producer: wait (bounded) for a free slot, copy n <= kSlotBytes in,
+  // publish.
+  Status Push(const uint8_t* data, size_t n);
+
+  // Consumer: wait (bounded) for a published chunk, hand the mapped bytes
+  // to consume(ptr, len), release the slot.
+  Status Pop(const std::function<void(const uint8_t*, size_t)>& consume);
+
+ private:
+  ShmChannel() = default;
+  Hdr* hdr_ = nullptr;
+  uint8_t* slots_ = nullptr;
+  void* map_ = nullptr;
+  size_t map_bytes_ = 0;
+  std::string name_;
+};
+
+}  // namespace hvdtpu
