@@ -332,12 +332,75 @@ def bench_resnet():
     })
 
 
+def _eager_bench_worker(rank, size, port, nbytes, n_iters, q):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    import numpy as np
+    from horovod_tpu.native.controller import NativeController
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    x = np.ones(nbytes // 4, dtype=np.float32)
+    h = ctl.allreduce_async_(x, x, op=1, name="warm")
+    ctl.wait(h)
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        h = ctl.allreduce_async_(x, x, op=1, name=f"b.{i % 4}")
+        ctl.wait(h)
+    dt = time.perf_counter() - t0
+    q.put((rank, nbytes * n_iters / dt / 1e9))
+    ctl.shutdown()
+
+
+def bench_eager():
+    """Native eager data-plane throughput: N local processes ring-allreduce
+    a BENCH_EAGER_MB buffer through the C++ runtime (shm same-host
+    channels + TCP) — the plane that carries torch/TF front-end traffic.
+    Baseline: the reference's published sample implies ~0.78 GB/s/GPU of
+    allreduce algorithm bandwidth (103.55 img/s x ~100MB ResNet-101 fp32
+    grads x 2(n-1)/n at n=16 — docs/benchmarks.rst:27-41)."""
+    import multiprocessing as mp
+    import socket as socket_mod
+
+    np_procs = int(os.environ.get("BENCH_EAGER_NP", "4"))
+    mb = int(os.environ.get("BENCH_EAGER_MB", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+
+    def _free_port():
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    worker = _eager_bench_worker
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=worker,
+                         args=(r, np_procs, port, mb << 20, iters, q))
+             for r in range(np_procs)]
+    for p in procs:
+        p.start()
+    rates = [q.get(timeout=300)[1] for _ in range(np_procs)]
+    for p in procs:
+        p.join(timeout=30)
+    gbps = sum(rates) / len(rates)
+    _emit({
+        "metric": "eager_allreduce_algorithm_bandwidth",
+        "value": round(gbps, 3),
+        "unit": f"GB/s/rank (np={np_procs}, {mb}MB fp32, in-place)",
+        "vs_baseline": round(gbps / 0.78, 3),
+        "ranks": np_procs,
+    })
+
+
 def main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     if mode == "bert":
         return bench_bert()
     if mode == "scaling":
         return bench_scaling()
+    if mode == "eager":
+        return bench_eager()
     return bench_resnet()
 
 

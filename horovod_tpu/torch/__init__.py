@@ -59,9 +59,29 @@ class Compression:
         def decompress(t, ctx):
             return t if ctx is None else t.to(ctx)
 
+    class bf16:
+        """bfloat16 wire compression — the TPU-native half format (fp32
+        exponent range: no loss scaling needed, unlike fp16)."""
+
+        @staticmethod
+        def compress(t):
+            if t.dtype in (_torch.float32, _torch.float64):
+                return t.to(_torch.bfloat16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t if ctx is None else t.to(ctx)
+
 
 def _to_numpy(tensor: _torch.Tensor) -> np.ndarray:
-    return tensor.detach().contiguous().cpu().numpy()
+    t = tensor.detach().contiguous().cpu()
+    if t.dtype == _torch.bfloat16:
+        # numpy has no native bfloat16: view the bits as int16 and retype
+        # with ml_dtypes (shares memory — the wire writes land in t).
+        import ml_dtypes
+        return t.view(_torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
 
 
 def _allreduce_nograd(tensor: _torch.Tensor, op: int,
@@ -71,7 +91,7 @@ def _allreduce_nograd(tensor: _torch.Tensor, op: int,
     out = _C.allreduce(_to_numpy(tensor), op=op, name=name,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
-    return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
+    return _out_to_torch(out).to(tensor.dtype)
 
 
 class _AllreduceFn(_torch.autograd.Function):
@@ -119,8 +139,7 @@ def allreduce_(tensor: _torch.Tensor, op: int = Average,
 
 def _allgather_nograd(tensor: _torch.Tensor,
                       name: Optional[str]) -> _torch.Tensor:
-    out = _C.allgather(_to_numpy(tensor), name=name)
-    return _torch.from_numpy(np.asarray(out))
+    return _out_to_torch(_C.allgather(_to_numpy(tensor), name=name))
 
 
 class _AllgatherFn(_torch.autograd.Function):
@@ -155,7 +174,7 @@ def allgather(tensor: _torch.Tensor,
 def _broadcast_nograd(tensor: _torch.Tensor, root_rank: int,
                       name: Optional[str]) -> _torch.Tensor:
     out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
-    return _torch.from_numpy(np.asarray(out)).to(tensor.dtype)
+    return _out_to_torch(out).to(tensor.dtype)
 
 
 class _BroadcastFn(_torch.autograd.Function):
@@ -267,7 +286,15 @@ def _out_to_torch(out):
         return tuple(_out_to_torch(o) for o in out)
     if _torch.is_tensor(out):
         return out
-    return _torch.from_numpy(np.asarray(out))
+    arr = np.asarray(out)
+    try:
+        import ml_dtypes
+        if arr.dtype == ml_dtypes.bfloat16:
+            return _torch.from_numpy(
+                arr.view(np.int16).copy()).view(_torch.bfloat16)
+    except ImportError:  # pragma: no cover
+        pass
+    return _torch.from_numpy(arr)
 
 
 def synchronize(handle):
@@ -371,8 +398,7 @@ def _grouped_allreduce_nograd(tensors, op: int,
                               name: Optional[str]) -> List[_torch.Tensor]:
     outs = _C.grouped_allreduce([_to_numpy(t) for t in tensors], op=op,
                                 name=name)
-    return [_torch.from_numpy(np.asarray(o)).to(t.dtype)
-            for o, t in zip(outs, tensors)]
+    return [_out_to_torch(o).to(t.dtype) for o, t in zip(outs, tensors)]
 
 
 class _GroupedAllreduceFn(_torch.autograd.Function):
@@ -548,7 +574,7 @@ class _DistributedOptimizer(_torch.optim.Optimizer):
                 sub = _sparse_submit(t, name)
                 return ("sparse", ("async", sub, eff), None)
         compressed, ctx = self._compression.compress(p.grad)
-        grad_np = compressed.detach().numpy()  # shares memory w/ compressed
+        grad_np = _to_numpy(compressed)  # shares memory w/ compressed
         scale = 1.0 / self._bpps if self._bpps > 1 else 1.0
         if ctl is None:
             trivial = (self.op == Average and

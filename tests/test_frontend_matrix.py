@@ -272,3 +272,50 @@ def test_torch_sparse_grads_in_optimizer():
     opt2.step()
     assert not emb2.weight.grad.is_sparse
     opt2.zero_grad()
+
+
+def test_torch_bf16_compression_roundtrip():
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    x = torch.randn(16, dtype=torch.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.bf16)
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, x.to(torch.bfloat16).float())
+
+
+def test_torch_bf16_compression_on_wire(monkeypatch):
+    torch = pytest.importorskip("torch")
+    import ml_dtypes
+    import horovod_tpu.torch as hvd
+    from horovod_tpu import torch as hvd_torch
+
+    hvd.init()
+    seen = {}
+
+    def fake_allreduce(arr, op=None, name=None, **kw):
+        seen["dtype"] = arr.dtype
+        return arr
+
+    monkeypatch.setattr(hvd_torch._C, "allreduce", fake_allreduce)
+    model = torch.nn.Linear(4, 2)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        compression=hvd.Compression.bf16, op=hvd.Sum)
+    model(torch.randn(8, 4)).sum().backward()
+    opt.step()
+    assert seen["dtype"] == np.dtype(ml_dtypes.bfloat16)
+    for p in model.parameters():
+        assert p.grad.dtype == torch.float32
+
+
+def test_tf_bf16_compression_roundtrip():
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    x = tf.random.normal((16,))
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.bf16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(
+        out.numpy(), tf.cast(tf.cast(x, tf.bfloat16), tf.float32).numpy())
