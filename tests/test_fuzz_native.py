@@ -1,0 +1,137 @@
+"""Property test: a long randomized (seeded, deterministic) sequence of
+mixed collectives through the native runtime must match the numpy oracle
+on every rank — stresses fusion batching, the response cache, the shm/TCP
+transports, and dtype paths together in one run (the reference's
+rank-seeded closed-form strategy, generalized)."""
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _plan(seed, n_ops, size):
+    """The shared op plan — identical on every rank (same seed)."""
+    rng = np.random.RandomState(seed)
+    ops = []
+    for i in range(n_ops):
+        kind = rng.choice(["allreduce", "allgather", "broadcast",
+                           "alltoall", "repeat"])
+        dtype = rng.choice(["f32", "f64", "i32", "i64"])
+        shape = tuple(int(d) for d in rng.randint(1, 9, rng.randint(1, 4)))
+        reduce_op = int(rng.choice([0, 1, 3, 4]))  # avg/sum/min/max
+        root = int(rng.randint(0, size))
+        ops.append((kind, dtype, shape, reduce_op, root, i))
+    return ops
+
+
+_DT = {"f32": np.float32, "f64": np.float64,
+       "i32": np.int32, "i64": np.int64}
+
+
+def _tensor(dtype, shape, rank, tag):
+    rng = np.random.RandomState(hash((tag, rank)) % (2 ** 31))
+    if dtype in ("f32", "f64"):
+        return rng.randn(*shape).astype(_DT[dtype])
+    return rng.randint(-20, 20, shape).astype(_DT[dtype])
+
+
+def _oracle(kind, dtype, shape, reduce_op, root, tag, size):
+    ts = [_tensor(dtype, shape, r, tag) for r in range(size)]
+    if kind == "allreduce":
+        if reduce_op == 0:
+            # Average runs in float domain; the cast back to integer
+            # truncates toward zero (C semantics, matching the runtime).
+            out = sum(t.astype(np.float64) for t in ts) / size
+            return out.astype(_DT[dtype])
+        if reduce_op == 1:
+            return sum(ts[1:], ts[0].copy())
+        stack = np.stack(ts)
+        return (stack.min(0) if reduce_op == 3 else stack.max(0))
+    if kind == "allgather":
+        return np.concatenate(ts, axis=0)
+    if kind == "broadcast":
+        return ts[root]
+    return None
+
+
+def _worker(rank, size, port, seed, n_ops, q):
+    sys.path.insert(0, REPO)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    from horovod_tpu.native.controller import NativeController
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    try:
+        for (kind, dtype, shape, reduce_op, root, i) in \
+                _plan(seed, n_ops, size):
+            # "repeat" re-runs an earlier tensor name: the cache fast path.
+            tag = i if kind != "repeat" else max(0, i - 5)
+            name = f"fz.{tag}" if kind != "repeat" else f"fz.{tag}"
+            if kind == "repeat":
+                kind = "allreduce"
+                reduce_op = 1
+            x = _tensor(dtype, shape, rank, tag)
+            if kind == "allreduce":
+                out = ctl.allreduce(x, op=reduce_op, name=f"ar.{name}")
+                want = _oracle("allreduce", dtype, shape, reduce_op, root,
+                               tag, size)
+                np.testing.assert_allclose(out, want, rtol=1e-5,
+                                           atol=1e-6)
+            elif kind == "allgather":
+                out = ctl.allgather(x, name=f"ag.{name}.{i}")
+                want = _oracle("allgather", dtype, shape, reduce_op, root,
+                               tag, size)
+                np.testing.assert_array_equal(out, want)
+            elif kind == "broadcast":
+                out = ctl.broadcast(x, root_rank=root,
+                                    name=f"bc.{name}.{i}")
+                want = _oracle("broadcast", dtype, shape, reduce_op, root,
+                               tag, size)
+                np.testing.assert_array_equal(out, want)
+            elif kind == "alltoall":
+                flat = np.ascontiguousarray(
+                    _tensor(dtype, (size * 3,), rank, tag))
+                out, splits = ctl.alltoall(flat, name=f"a2a.{name}.{i}")
+                # Each rank receives rank-r's segment [rank*3:(rank+1)*3].
+                want = np.concatenate([
+                    _tensor(dtype, (size * 3,), r, tag)
+                    [rank * 3:(rank + 1) * 3] for r in range(size)])
+                np.testing.assert_array_equal(out, want)
+                assert list(splits) == [3] * size
+        q.put((rank, "ok", None))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "error", repr(e)))
+    finally:
+        ctl.shutdown()
+
+
+@pytest.mark.timeout(240)
+@pytest.mark.parametrize("seed", [11, 29])
+def test_fuzz_mixed_collectives_4proc(seed):
+    size, n_ops = 4, 40
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker,
+                         args=(r, size, port, seed, n_ops, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=180)
+        assert status == "ok", f"rank {rank}: {payload}"
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
