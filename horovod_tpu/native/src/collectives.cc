@@ -158,6 +158,21 @@ void ReduceBuf(void* dst, const void* src, int64_t count, DataType dtype,
 Status SendStream(Network& net, int peer, const uint8_t* buf, size_t n) {
   if (n == 0) return Status::OK();
   if (ShmChannel* ch = net.shm_tx(peer)) {
+    if (ch->refs_enabled() && n >= (1u << 20)) {
+      // Cross-memory attach: publish slot-sized descriptors into this
+      // process's memory; the consumer pulls each directly (zero staging
+      // copies) while later chunks are being published — keeping the
+      // receiver's incremental reduction pipelined.  Must drain before
+      // returning: the ring reuses the region in later steps.
+      size_t off = 0;
+      while (off < n) {
+        size_t k = std::min(n - off, ShmChannel::kSlotBytes);
+        Status st = ch->PushRef(buf + off, k);
+        if (!st.ok()) return st;
+        off += k;
+      }
+      return ch->WaitDrained();
+    }
     size_t off = 0;
     while (off < n) {
       size_t k = std::min(n - off, ShmChannel::kSlotBytes);
@@ -193,12 +208,11 @@ Status RecvStream(Network& net, int peer, uint8_t* dst, size_t n,
   if (ShmChannel* ch = net.shm_rx(peer)) {
     size_t off = 0;
     while (off < n) {
-      Status st = ch->Pop([&](const uint8_t* p, size_t len) {
-        memcpy(dst + off, p, len);
-        off += len;
-        if (on_recv) on_recv(off);
-      });
+      size_t got = 0;
+      Status st = ch->PopInto(dst + off, n - off, &got);
       if (!st.ok()) return st;
+      off += got;
+      if (on_recv) on_recv(off);
     }
     return Status::OK();
   }

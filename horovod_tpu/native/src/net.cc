@@ -374,10 +374,23 @@ void Network::SetupShm(const std::vector<std::string>& table,
     uint8_t peer_rx_ok = 0;
     bool hs_ok = peers_[r]->SendAll(&my_rx_ok, 1).ok() &&
                  peers_[r]->RecvAll(&peer_rx_ok, 1).ok();
+    // Phase 3: cross-memory-attach capability — my consumer end probes a
+    // direct read of the producer's memory; the producer publishes
+    // descriptors (zero staging copies) only if my probe succeeded.
+    uint8_t my_cma = (hs_ok && rx != nullptr && rx->ProbeCma()) ? 1 : 0;
+    uint8_t peer_cma = 0;
+    if (hs_ok) {
+      hs_ok = peers_[r]->SendAll(&my_cma, 1).ok() &&
+              peers_[r]->RecvAll(&peer_cma, 1).ok();
+    }
     if (tx[r]) {
       tx[r]->Unlink();  // both ends mapped (or unused): never leak
-      if (hs_ok && peer_rx_ok) shm_tx_[r] = std::move(tx[r]);
-      else tx[r].reset();
+      if (hs_ok && peer_rx_ok) {
+        if (peer_cma) tx[r]->EnableRefs();
+        shm_tx_[r] = std::move(tx[r]);
+      } else {
+        tx[r].reset();
+      }
     }
     if (hs_ok && my_rx_ok) shm_rx_[r] = std::move(rx);
   }

@@ -3,6 +3,7 @@
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -15,6 +16,7 @@ namespace {
 
 constexpr size_t kMapBytes =
     sizeof(ShmChannel::Hdr) + ShmChannel::kSlots * ShmChannel::kSlotBytes;
+constexpr uint64_t kProbeMagic = 0x48764474707531ULL;
 
 // Bounded wait on a shm condition: brief spin for the streaming case,
 // then micro-sleeps; 60 s deadline like the socket paths.
@@ -62,6 +64,12 @@ std::unique_ptr<ShmChannel> ShmChannel::Create(const std::string& name) {
   ch->hdr_ = new (map) Hdr();
   ch->hdr_->head.store(0, std::memory_order_relaxed);
   ch->hdr_->tail.store(0, std::memory_order_relaxed);
+  memset(ch->hdr_->addrs, 0, sizeof(ch->hdr_->addrs));
+  ch->hdr_->producer_pid = ::getpid();
+  ch->hdr_->probe_magic = kProbeMagic;
+  ch->hdr_->poisoned.store(0, std::memory_order_relaxed);
+  ch->hdr_->producer_probe_addr =
+      reinterpret_cast<uint64_t>(&ch->hdr_->probe_magic);
   ch->slots_ = static_cast<uint8_t*>(map) + sizeof(Hdr);
   return ch;
 }
@@ -125,12 +133,43 @@ Status ShmChannel::Push(const uint8_t* data, size_t n) {
   size_t slot = head % kSlots;
   memcpy(slots_ + slot * kSlotBytes, data, n);
   hdr_->lens[slot] = n;
+  hdr_->addrs[slot] = 0;
   hdr_->head.store(head + 1, std::memory_order_release);
   return Status::OK();
 }
 
-Status ShmChannel::Pop(
-    const std::function<void(const uint8_t*, size_t)>& consume) {
+Status ShmChannel::PushRef(const uint8_t* data, size_t n) {
+  uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+  Status st = WaitFor(
+      [&] {
+        return head - hdr_->tail.load(std::memory_order_acquire) < kSlots;
+      },
+      "producer waiting for a free ref slot");
+  if (!st.ok()) {
+    // Aborting with descriptors possibly still published: the region may
+    // be reused by the caller — the consumer must not trust later reads.
+    hdr_->poisoned.store(1, std::memory_order_release);
+    return st;
+  }
+  size_t slot = head % kSlots;
+  hdr_->lens[slot] = n;
+  hdr_->addrs[slot] = reinterpret_cast<uint64_t>(data);
+  hdr_->head.store(head + 1, std::memory_order_release);
+  return Status::OK();
+}
+
+Status ShmChannel::WaitDrained() {
+  uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+  Status st = WaitFor(
+      [&] {
+        return hdr_->tail.load(std::memory_order_acquire) >= head;
+      },
+      "producer waiting for the consumer to finish reading");
+  if (!st.ok()) hdr_->poisoned.store(1, std::memory_order_release);
+  return st;
+}
+
+Status ShmChannel::PopInto(uint8_t* dst, size_t max_n, size_t* got) {
   uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
   Status st = WaitFor(
       [&] {
@@ -139,9 +178,43 @@ Status ShmChannel::Pop(
       "consumer waiting for a chunk");
   if (!st.ok()) return st;
   size_t slot = tail % kSlots;
-  consume(slots_ + slot * kSlotBytes, hdr_->lens[slot]);
+  size_t n = hdr_->lens[slot];
+  if (n > max_n)
+    return Status::Error("shm chunk larger than receive window");
+  if (hdr_->addrs[slot] != 0) {
+    // Descriptor: pull straight from the producer's memory.
+    size_t off = 0;
+    while (off < n) {
+      iovec liov{dst + off, std::min<size_t>(n - off, 8 << 20)};
+      iovec riov{reinterpret_cast<void*>(hdr_->addrs[slot] + off),
+                 liov.iov_len};
+      ssize_t k = ::process_vm_readv(hdr_->producer_pid, &liov, 1,
+                                     &riov, 1, 0);
+      if (k <= 0)
+        return Status::Error("process_vm_readv failed mid-transfer");
+      off += static_cast<size_t>(k);
+    }
+  } else {
+    memcpy(dst, slots_ + slot * kSlotBytes, n);
+  }
+  if (hdr_->poisoned.load(std::memory_order_acquire))
+    return Status::Error("shm channel poisoned by an aborted producer");
+  *got = n;
   hdr_->tail.store(tail + 1, std::memory_order_release);
   return Status::OK();
+}
+
+bool ShmChannel::ProbeCma() {
+  // The probe target address is the PRODUCER's VA of probe_magic —
+  // published by the producer itself (this process maps the segment at a
+  // different address).
+  uint64_t magic = 0;
+  iovec liov{&magic, sizeof(magic)};
+  iovec riov{reinterpret_cast<void*>(hdr_->producer_probe_addr),
+             sizeof(magic)};
+  ssize_t k = ::process_vm_readv(hdr_->producer_pid, &liov, 1, &riov, 1,
+                                 0);
+  return k == sizeof(magic) && magic == kProbeMagic;
 }
 
 }  // namespace hvdtpu

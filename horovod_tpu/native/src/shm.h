@@ -11,7 +11,6 @@
 
 #include <atomic>
 #include <cstdint>
-#include <functional>
 #include <memory>
 #include <string>
 
@@ -30,6 +29,18 @@ class ShmChannel {
     std::atomic<uint64_t> tail;  // chunks consumed by the consumer
     char pad1[64 - sizeof(std::atomic<uint64_t>)];
     uint64_t lens[kSlots];
+    // Cross-memory-attach descriptors: addrs[slot] != 0 marks the chunk
+    // as a reference into the producer's address space (the consumer
+    // pulls it with process_vm_readv — zero staging copies) instead of
+    // data in the slot.
+    uint64_t addrs[kSlots];
+    int32_t producer_pid;
+    uint64_t probe_magic;          // consumer CMA capability probe value
+    uint64_t producer_probe_addr;  // producer's own VA of probe_magic
+    // Set by a producer that aborted a transfer without draining: any
+    // still-published descriptor may point at reused memory, so the
+    // consumer must treat reads after this as failed, never as data.
+    std::atomic<uint32_t> poisoned;
   };
 
   // Producer side (the sending rank) creates; consumer opens.  Both
@@ -47,9 +58,25 @@ class ShmChannel {
   // publish.
   Status Push(const uint8_t* data, size_t n);
 
-  // Consumer: wait (bounded) for a published chunk, hand the mapped bytes
-  // to consume(ptr, len), release the slot.
-  Status Pop(const std::function<void(const uint8_t*, size_t)>& consume);
+  // Producer, CMA mode: publish a descriptor for an arbitrarily large
+  // region of this process's memory; the consumer pulls it directly.
+  // The caller MUST call WaitDrained() before reusing/modifying the
+  // region (the consumer reads it asynchronously).
+  Status PushRef(const uint8_t* data, size_t n);
+  Status WaitDrained();
+
+  // Consumer: wait (bounded) for a published chunk and land up to
+  // max_n bytes at dst (slot memcpy or direct process_vm_readv for
+  // descriptors); *got reports the chunk size.
+  Status PopInto(uint8_t* dst, size_t max_n, size_t* got);
+
+  // Consumer-side CMA capability: can this process read the producer's
+  // memory? (probed once against probe_magic).
+  bool ProbeCma();
+  // Producer side: enable descriptor publishing (set after the peer
+  // reported a successful probe).
+  void EnableRefs() { use_refs_ = true; }
+  bool refs_enabled() const { return use_refs_; }
 
  private:
   ShmChannel() = default;
@@ -58,6 +85,7 @@ class ShmChannel {
   void* map_ = nullptr;
   size_t map_bytes_ = 0;
   std::string name_;
+  bool use_refs_ = false;
 };
 
 }  // namespace hvdtpu
