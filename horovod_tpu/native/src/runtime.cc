@@ -194,12 +194,19 @@ void Runtime::BackgroundLoop() {
     RequestList rl;
     {
       std::unique_lock<std::mutex> lk(mu_);
-      // Sleep to cycle time unless new work arrives (RunLoopOnce,
-      // operations.cc:592-598).
+      // Sleep to cycle time, but wake the moment work arrives: a
+      // latency-sensitive sequential op should not pay the full cycle
+      // (the reference sleeps unconditionally, operations.cc:592-598 —
+      // here bursty enqueues still batch into one round because they
+      // accumulate while the previous round executes).
       enqueue_cv_.wait_for(
           lk, std::chrono::duration<double, std::milli>(
               cycle_time_ms_.load()),
-          [this] { return stop_.load(); });
+          [this] {
+            return stop_.load() || !pending_order_.empty() ||
+                   join_requested_.load() || barrier_requested_.load() ||
+                   shutdown_requested_.load();
+          });
       for (const auto& name : pending_order_) {
         auto it = pending_.find(name);
         if (it == pending_.end()) continue;
