@@ -1,0 +1,80 @@
+"""Shared-memory transport behaviors: asymmetric disable falls back to
+TCP without desynchronizing the handshake; disabled-everywhere still
+passes traffic; segments never leak into /dev/shm."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.native.controller import NativeController
+
+    rank = int(sys.argv[1])
+    ctl = NativeController(rank, 2, "127.0.0.1:" + sys.argv[2])
+    # Large (shm-eligible) and small payloads both ways.
+    big = np.full((1 << 20,), float(rank + 1), dtype=np.float32)
+    out = ctl.allreduce(big, op=1, name="big")
+    assert float(out[0]) == 3.0 and float(out[-1]) == 3.0
+    small = np.full((8,), float(rank + 1), dtype=np.float32)
+    np.testing.assert_allclose(
+        ctl.allreduce(small, op=1, name="small"), 3.0)
+    g = ctl.allgather(np.full((2,), float(rank), dtype=np.float32),
+                      name="g")
+    np.testing.assert_allclose(g, [0, 0, 1, 1])
+    ctl.shutdown()
+    print("DONE", rank)
+""")
+
+
+def _run_pair(env0, env1):
+    port = _free_port()
+    script = WORKER.format(repo=REPO)
+    procs = []
+    for rank, extra in ((0, env0), (1, env1)):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   HVD_TPU_CYCLE_TIME="1", **extra)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script, str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env))
+    outs = [p.communicate(timeout=90) for p in procs]
+    for p, (o, e) in zip(procs, outs):
+        assert p.returncode == 0, (o, e)
+    assert "DONE 0" in outs[0][0] and "DONE 1" in outs[1][0]
+
+
+@pytest.mark.timeout(180)
+def test_asymmetric_shm_disable_falls_back_to_tcp():
+    # One rank opts out of shm: the pair must agree (handshake stays
+    # aligned) and all traffic rides TCP correctly.
+    _run_pair({"HVD_TPU_DISABLE_SHM": "1"}, {})
+
+
+@pytest.mark.timeout(180)
+def test_shm_disabled_everywhere():
+    _run_pair({"HVD_TPU_DISABLE_SHM": "1"}, {"HVD_TPU_DISABLE_SHM": "1"})
+
+
+@pytest.mark.timeout(180)
+def test_shm_enabled_no_segment_leak():
+    _run_pair({}, {})
+    leaked = [f for f in os.listdir("/dev/shm") if f.startswith("hvt_")]
+    assert leaked == [], leaked
