@@ -29,7 +29,7 @@ def _plan(seed, n_ops, size):
     ops = []
     for i in range(n_ops):
         kind = rng.choice(["allreduce", "allgather", "broadcast",
-                           "alltoall", "repeat"])
+                           "alltoall", "repeat", "grouped", "scaled"])
         dtype = rng.choice(["f32", "f64", "i32", "i64"])
         shape = tuple(int(d) for d in rng.randint(1, 9, rng.randint(1, 4)))
         reduce_op = int(rng.choice([0, 1, 3, 4]))  # avg/sum/min/max
@@ -100,6 +100,24 @@ def _worker(rank, size, port, seed, n_ops, q):
                 want = _oracle("broadcast", dtype, shape, reduce_op, root,
                                tag, size)
                 np.testing.assert_array_equal(out, want)
+            elif kind == "grouped":
+                # Atomic group of 3 fp32 tensors, summed.
+                xs = [_tensor("f32", shape, rank, (tag, j))
+                      for j in range(3)]
+                outs = ctl.grouped_allreduce(xs, op=1, name=f"gp.{i}")
+                for j, o in enumerate(outs):
+                    want = sum(_tensor("f32", shape, r, (tag, j))
+                               for r in range(size))
+                    np.testing.assert_allclose(o, want, rtol=1e-5,
+                                               atol=1e-6)
+            elif kind == "scaled":
+                x32 = _tensor("f32", shape, rank, tag)
+                out = ctl.allreduce(x32, op=1, prescale=0.5,
+                                    postscale=2.0, name=f"sc.{i}")
+                want = 2.0 * sum(0.5 * _tensor("f32", shape, r, tag)
+                                 for r in range(size))
+                np.testing.assert_allclose(out, want, rtol=1e-5,
+                                           atol=1e-6)
             elif kind == "alltoall":
                 flat = np.ascontiguousarray(
                     _tensor(dtype, (size * 3,), rank, tag))
