@@ -102,6 +102,14 @@ TORCH_ASYNC_WORKER = textwrap.dedent("""
     hvd.synchronize(hb)
     torch.testing.assert_close(b, torch.full((5,), 1.0))
 
+    # Variable-size allgather: rank r contributes r+1 rows (reference
+    # test_horovod_allgather_variable_size).
+    v = torch.full((rank + 1, 2), float(rank))
+    g = hvd.allgather(v)
+    assert g.shape == (3, 2)
+    torch.testing.assert_close(g[:1], torch.zeros(1, 2))
+    torch.testing.assert_close(g[1:], torch.ones(2, 2))
+
     with open({outfile!r} + f".{{rank}}", "w") as f:
         json.dump({{"ok": True}}, f)
     hvd.shutdown()
