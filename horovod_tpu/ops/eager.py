@@ -208,9 +208,34 @@ def allreduce(tensor, op_fn, name: Optional[str] = None,
     return _run_global(op_fn, garr)
 
 
+def _flatten01(a):
+    return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+
+
+def _device_allgather(tensor, ctl):
+    """Device-plane allgather for equal per-rank dim-0 (the SPMD common
+    case): the payload never leaves HBM.  Unequal dims return None — the
+    host plane does the pad/displacement dance."""
+    if getattr(tensor, "ndim", 0) < 1:
+        return None
+    import jax.numpy as jnp
+    rows = int(tensor.shape[0])
+    sizes = _device_allreduce(
+        jnp.asarray(_one_hot_sizes(rows)), _sum0, ctl)
+    if sizes is None:
+        return None
+    if not bool((np.asarray(sizes) == rows).all()):
+        return None  # ragged: host plane
+    return _device_allreduce(tensor, _flatten01, ctl)
+
+
 def allgather(tensor, name: Optional[str] = None):
     """Concatenate along dim 0 across processes (unequal dim-0 allowed)."""
     ctl = _controller()
+    if _is_device_array(tensor):
+        out = _device_allgather(tensor, ctl)
+        if out is not None:
+            return out
     if ctl is not None:
         return _ctl(ctl.allgather, _np(tensor), name=name)
     if global_state.process_count == 1:
@@ -237,6 +262,12 @@ def _one_hot_sizes(rows: int) -> np.ndarray:
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     ctl = _controller()
+    if _is_device_array(tensor):
+        # Broadcast shapes match across ranks by contract, so the
+        # device plane applies directly (select the root's shard).
+        out = _device_allreduce(tensor, _take_fn(root_rank), ctl)
+        if out is not None:
+            return out
     if ctl is not None:
         return _ctl(ctl.broadcast, _np(tensor), root_rank=root_rank,
                     name=name)

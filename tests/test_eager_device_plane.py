@@ -33,6 +33,13 @@ def test_device_array_stays_on_device(monkeypatch):
     # Average path too (scales applied on device).
     out = hvd.allreduce(x, op=hvd.Average)
     assert isinstance(out, jax.Array)
+    # Broadcast and (equal-dim) allgather ride the device plane too.
+    out = hvd.broadcast(x, root_rank=0)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    out = hvd.allgather(x)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
 
 
 def test_numpy_input_uses_host_plane():
@@ -72,6 +79,14 @@ def _dist_worker(rank, size, coord_port, q):
         out = hvd.allreduce(x, op=hvd.Sum)
         assert isinstance(out, jax.Array)
         got = float(np.asarray(out)[0])
+        b = hvd.broadcast(jnp.full((4,), float(rank)), root_rank=1)
+        assert isinstance(b, jax.Array)
+        assert float(np.asarray(b)[0]) == 1.0
+        g = hvd.allgather(jnp.full((2, 3), float(rank)))
+        assert isinstance(g, jax.Array)
+        assert np.asarray(g).shape == (4, 3)
+        assert float(np.asarray(g)[0, 0]) == 0.0
+        assert float(np.asarray(g)[2, 0]) == 1.0
         q.put((rank, "ok", got))
     except Exception as e:  # noqa: BLE001
         q.put((rank, "error", repr(e)))
