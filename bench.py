@@ -371,11 +371,10 @@ def bench_eager():
         s.close()
         return port
 
-    worker = _eager_bench_worker
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
-    procs = [ctx.Process(target=worker,
+    procs = [ctx.Process(target=_eager_bench_worker,
                          args=(r, np_procs, port, mb << 20, iters, q))
              for r in range(np_procs)]
     for p in procs:

@@ -142,10 +142,10 @@ def _forward_output(w: WorkerProcess, output_dir: Optional[str] = None,
         # earlier rounds' capture.
         sink = open(os.path.join(rank_dir, "stdout"), "a")
     try:
+        import datetime
         for line in w.proc.stdout:
             stamp = ""
             if prefix_timestamp:
-                import datetime
                 stamp = datetime.datetime.now().isoformat(
                     timespec="milliseconds") + " "
             sys.stdout.write(f"{stamp}[{w.slot.rank}]<stdout> {line}")

@@ -211,8 +211,7 @@ def _alltoall_nograd(tensor: _torch.Tensor, splits,
                      name: Optional[str]):
     out, recv_splits = _C.alltoall(_to_numpy(tensor), splits=splits,
                                    name=name)
-    return (_torch.from_numpy(np.asarray(out)),
-            _torch.from_numpy(np.asarray(recv_splits)))
+    return _out_to_torch(out), _out_to_torch(recv_splits)
 
 
 class _AlltoallFn(_torch.autograd.Function):
