@@ -392,14 +392,39 @@ def bench_eager():
     })
 
 
+def _tpu_transport_alive() -> bool:
+    """The axon TPU tunnel (loopback relay) can die; when it does, any
+    TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
+    connection) instead of erroring.  Probe the relay port first so the
+    bench degrades to a CPU-measurable metric rather than hanging."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("axon", ""):
+        return True  # cpu/tpu-native platforms: no tunnel involved
+    import socket as socket_mod
+    for port in (8082, 8092, 8102, 8112):
+        try:
+            with socket_mod.create_connection(("127.0.0.1", port),
+                                              timeout=3):
+                return True
+        except OSError:
+            continue
+    return False
+
+
 def main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
+    if mode == "eager":
+        return bench_eager()  # never touches the accelerator
+    if mode in ("resnet", "bert") and not _tpu_transport_alive():
+        # Emit the DP scaling-efficiency metric (virtual CPU mesh) so the
+        # round still records a number, with the degradation visible.
+        sys.stderr.write(
+            "bench: TPU tunnel unreachable; falling back to the CPU-mesh "
+            "scaling metric\n")
+        return bench_scaling()
     if mode == "bert":
         return bench_bert()
     if mode == "scaling":
         return bench_scaling()
-    if mode == "eager":
-        return bench_eager()
     return bench_resnet()
 
 
