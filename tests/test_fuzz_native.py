@@ -29,7 +29,8 @@ def _plan(seed, n_ops, size):
     ops = []
     for i in range(n_ops):
         kind = rng.choice(["allreduce", "allgather", "broadcast",
-                           "alltoall", "repeat", "grouped", "scaled"])
+                           "alltoall", "repeat", "grouped", "scaled",
+                           "adasum"])
         dtype = rng.choice(["f32", "f64", "i32", "i64"])
         shape = tuple(int(d) for d in rng.randint(1, 9, rng.randint(1, 4)))
         reduce_op = int(rng.choice([0, 1, 3, 4]))  # avg/sum/min/max
@@ -68,6 +69,33 @@ def _oracle(kind, dtype, shape, reduce_op, root, tag, size):
     return None
 
 
+def _adasum_pair(a, b):
+    """Reference coefficient math (adasum.h:385-395): scaled add with
+    ac = 1 - dot/(2||a||^2), bc = 1 - dot/(2||b||^2); accumulation in
+    float64, per-pair store back in the payload dtype like the native
+    kernel."""
+    af = a.ravel().astype(np.float64)
+    bf = b.ravel().astype(np.float64)
+    dot = float(af @ bf)
+    na = float(af @ af)
+    nb = float(bf @ bf)
+    ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+    bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+    return (ac * a.astype(np.float64) +
+            bc * b.astype(np.float64)).astype(a.dtype)
+
+
+def _adasum_tree(ts):
+    live = list(ts)
+    while len(live) > 1:
+        nxt = [_adasum_pair(live[i], live[i + 1])
+               for i in range(0, len(live) - 1, 2)]
+        if len(live) % 2 == 1:
+            nxt.append(live[-1])
+        live = nxt
+    return live[0]
+
+
 def _worker(rank, size, port, seed, n_ops, q):
     sys.path.insert(0, REPO)
     os.environ["HVD_TPU_CYCLE_TIME"] = "1"
@@ -100,6 +128,13 @@ def _worker(rank, size, port, seed, n_ops, q):
                 want = _oracle("broadcast", dtype, shape, reduce_op, root,
                                tag, size)
                 np.testing.assert_array_equal(out, want)
+            elif kind == "adasum":
+                x32 = _tensor("f32", shape, rank, tag)
+                out = ctl.allreduce(x32, op=2, name=f"ad.{i}")  # ADASUM
+                want = _adasum_tree(
+                    [_tensor("f32", shape, r, tag) for r in range(size)])
+                np.testing.assert_allclose(out, want, rtol=1e-4,
+                                           atol=1e-5)
             elif kind == "grouped":
                 # Atomic group of 3 fp32 tensors, summed.
                 xs = [_tensor("f32", shape, rank, (tag, j))
