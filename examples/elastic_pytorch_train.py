@@ -8,6 +8,11 @@ committed state and re-rendezvouses.
         python examples/elastic_pytorch_train.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import torch
 import torch.nn as nn
 import torch.nn.functional as F

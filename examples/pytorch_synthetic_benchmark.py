@@ -7,6 +7,11 @@ the TPU-native stack's CPU eager path.  Launch:
     hvdrun -np 2 python examples/pytorch_synthetic_benchmark.py --num-iters 3
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -6,6 +6,11 @@ distributed member.
     python examples/ray_executor_train.py   # needs a ray cluster/local ray
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def train_fn():
     import numpy as np
@@ -35,3 +40,4 @@ def main():
 
 if __name__ == "__main__":
     main()
+

@@ -5,6 +5,11 @@ with gradients reduced through DistributedGradientTape.
     hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -1,0 +1,59 @@
+"""Smoke-run the runnable examples (tiny sizes, 1–2 processes) so they
+cannot rot: the reference ships its examples as working artifacts and so
+do we."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Keep the axon TPU plugin entirely out of the subprocess: with the
+    # tunnel down, any accidental hardware-backend init hangs forever.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.timeout(300)
+def test_jax_mnist_single_proc():
+    r = _run([os.path.join(EXAMPLES, "jax_mnist.py"), "--epochs", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loss" in r.stdout
+
+
+@pytest.mark.timeout(300)
+def test_pytorch_synthetic_benchmark_single_proc():
+    pytest.importorskip("torch")
+    r = _run([os.path.join(EXAMPLES, "pytorch_synthetic_benchmark.py"),
+              "--num-iters", "1", "--num-batches-per-iter", "1",
+              "--num-warmup-batches", "1", "--batch-size", "4",
+              "--image-size", "32"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "img/sec" in r.stdout
+
+
+@pytest.mark.timeout(300)
+def test_tf2_synthetic_benchmark_single_proc():
+    pytest.importorskip("tensorflow")
+    r = _run([os.path.join(EXAMPLES, "tensorflow2_synthetic_benchmark.py"),
+              "--num-iters", "1", "--num-batches-per-iter", "1",
+              "--num-warmup-batches", "1", "--batch-size", "4",
+              "--image-size", "32"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "img/sec" in r.stdout
+
+
+@pytest.mark.timeout(300)
+def test_elastic_pytorch_example_2proc():
+    pytest.importorskip("torch")
+    from horovod_tpu.runner.launch import main
+    rc = main(["-np", "2", "--controller-port", "28771", sys.executable,
+               os.path.join(EXAMPLES, "elastic_pytorch_train.py")])
+    assert rc == 0
