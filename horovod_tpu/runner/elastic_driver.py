@@ -114,6 +114,13 @@ class ElasticDriver:
         self._blacklist: Set[str] = set()
         self._current_hosts: List[HostInfo] = []
         self._workers: Dict[str, exec_mod.WorkerProcess] = {}  # slot_id →
+        # Slots the driver itself terminated on scale-down: their exits
+        # are expected, not failures.
+        self._expected_exits: Set[str] = set()
+        # Spawn generation per slot: exit events carry the generation they
+        # belong to, so a stale callback from a superseded process can
+        # never untrack or fail its replacement.
+        self._gen: Dict[str, int] = {}
         self._shutdown = threading.Event()
         self._finished: Dict[str, int] = {}
         self._succeeded = False  # any worker exited 0: job is completing
@@ -216,36 +223,74 @@ class ElasticDriver:
                 print(f"[elastic] round {self._round}: "
                       f"{np_} procs on "
                       f"{','.join(h.hostname for h in hosts)}")
-            # Spawn workers for slots without a live process.
+            # Terminate workers whose slot left the assignment
+            # (scale-down): a stranded worker would time out waiting for
+            # a round that can never include it and read as a failure.
+            # One batched terminate_all call: per-worker calls would
+            # serialize up-to-10 s drain waits under the driver lock.
+            wanted = {self._slot_id(s) for s in slots}
+            removed = []
+            for sid, w in list(self._workers.items()):
+                if sid not in wanted and w.proc.poll() is None:
+                    self._expected_exits.add(sid)
+                    removed.append(w)
+                    if self._verbose:
+                        print(f"[elastic] slot {sid} removed by "
+                              "scale-down; stopping its worker")
+            if removed:
+                exec_mod.terminate_all(removed)
+            # Spawn workers for slots without a live process (a worker the
+            # driver already asked to die counts as absent — its exit
+            # event is generation-stale once the slot respawns).
             for s in slots:
                 sid = self._slot_id(s)
                 w = self._workers.get(sid)
-                if w is not None and w.proc.poll() is None:
+                if (w is not None and w.proc.poll() is None
+                        and sid not in self._expected_exits):
                     continue  # surviving worker re-rendezvouses in place
                 self._spawn(s)
 
     def _spawn(self, s: SlotInfo):
+        sid = self._slot_id(s)
         env = dict(self._extra_env)
-        env["HVD_TPU_ELASTIC_SLOT"] = self._slot_id(s)
+        env["HVD_TPU_ELASTIC_SLOT"] = sid
         env["HVD_TPU_HOSTNAME"] = s.hostname
         env["HOROVOD_HOSTNAME"] = s.hostname
+        self._gen[sid] = gen = self._gen.get(sid, 0) + 1
         ws = exec_mod.launch_workers(
             [s], self._command, controller_addr="elastic",
             extra_env=env,
-            on_exit=lambda slot, code, sid=self._slot_id(s):
-                self._on_worker_exit(sid, slot, code),
+            on_exit=lambda slot, code, sid=sid, gen=gen:
+                self._on_worker_exit(sid, gen, slot, code),
             platform_policy=self._platform_policy,
             ssh_identity_file=self._ssh_identity_file,
             output_dir=self._output_dir,
             prefix_timestamp=self._prefix_timestamp)
-        self._workers[self._slot_id(s)] = ws[0]
+        self._workers[sid] = ws[0]
 
-    def _on_worker_exit(self, sid: str, slot: SlotInfo, code: int):
+    def _on_worker_exit(self, sid: str, gen: int, slot: SlotInfo,
+                        code: int):
         if self._shutdown.is_set():
             return
         with self._lock:
+            if self._gen.get(sid) != gen:
+                # A superseded process's exit (the slot respawned since):
+                # must not untrack or fail its replacement.
+                self._expected_exits.discard(sid)
+                if self._succeeded and not self._workers:
+                    self._set_result(0)
+                return
             self._workers.pop(sid, None)
             self._finished[sid] = code
+            if sid in self._expected_exits:
+                # Scale-down termination the driver requested: no
+                # blacklist, no new round, and never a job failure — but
+                # the completion check must still run (this exit may be
+                # the last one the driver was waiting on).
+                self._expected_exits.discard(sid)
+                if self._succeeded and not self._workers:
+                    self._set_result(0)
+                return
             if code == 0:
                 # Success of any worker ends the job successfully once all
                 # live workers drain (reference: results registered per
@@ -324,7 +369,17 @@ class ElasticDriver:
                 new = {h.hostname: h.slots for h in hosts}
                 if new == cur:
                     continue
-                added_only = set(cur).issubset(set(new))
+                if sum(new.values()) < self._min_np:
+                    # Shrunk below min-np: do not publish an unlaunchable
+                    # round — keep the current one and wait for capacity
+                    # (worker failures on lost hosts take the blacklist
+                    # path, which enforces min-np with an abort).
+                    if self._verbose:
+                        print(f"[elastic] capacity {sum(new.values())} < "
+                              f"min-np {self._min_np}; waiting")
+                    continue
+                added_only = (set(cur).issubset(set(new)) and
+                              all(new[h] >= cur[h] for h in cur))
                 if self._max_np is not None and added_only and \
                         sum(cur.values()) >= self._max_np:
                     continue  # already at capacity

@@ -184,3 +184,42 @@ def test_elastic_scale_up_adds_worker(tmp_path):
     # Final epoch completed by all 3 ranks.
     finals = [e for e in events if e["epoch"] == 7]
     assert len(finals) == 3 and all(e["size"] == 3 for e in finals)
+
+
+@pytest.mark.timeout(300)
+def test_elastic_scale_down_removes_worker(tmp_path):
+    """Host capacity shrinks mid-run (reference host-removal path): the
+    removed slot's worker is stopped by the driver (expected exit, no
+    blacklist), survivors re-rendezvous, and the job completes at the
+    smaller world size."""
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(SCALEUP_WORKER.format(repo=REPO, log=log, epochs=8))
+    discovery = FixedHosts([HostInfo("localhost", 3)])
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    driver = ElasticDriver(
+        discovery, [sys.executable, str(script)],
+        min_np=2, max_np=3, controller_base_port=28600, verbose=True)
+
+    def shrink():
+        import time as _t
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            if _read_logs(log, [f"localhost:{i}" for i in range(3)]):
+                break
+            _t.sleep(0.2)
+        discovery.set([HostInfo("localhost", 2)])
+
+    t = threading.Thread(target=shrink, daemon=True)
+    t.start()
+    rc = driver.run()
+    assert rc == 0
+    events = _read_logs(log, [f"localhost:{i}" for i in range(3)])
+    sizes = {e["size"] for e in events}
+    assert 3 in sizes, "never ran at the initial world size"
+    assert 2 in sizes, "never re-rendezvoused at the smaller size"
+    # Final epoch completed by exactly the 2 surviving ranks.
+    finals = [e for e in events if e["epoch"] == 7]
+    assert len(finals) == 2 and all(e["size"] == 2 for e in finals)
+    # No host was blacklisted — the scale-down exit was expected.
+    assert driver._blacklist == set()

@@ -51,8 +51,12 @@ def test_tf2_synthetic_benchmark_single_proc():
 
 
 @pytest.mark.timeout(300)
-def test_elastic_pytorch_example_2proc():
+def test_elastic_pytorch_example_2proc(monkeypatch):
     pytest.importorskip("torch")
+    # Same scrubbing _run() does: spawned workers inherit os.environ, and
+    # an inherited axon plugin env + dead tunnel would hang them.
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     from horovod_tpu.runner.launch import main
     rc = main(["-np", "2", "--controller-port", "28771", sys.executable,
                os.path.join(EXAMPLES, "elastic_pytorch_train.py")])
