@@ -102,6 +102,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--stall-check-shutdown-time-seconds", type=float,
                    default=None)
     p.add_argument("--log-level", default=None)
+    p.add_argument("--log-hide-timestamp", action="store_true",
+                   help="hide timestamps in runtime log lines")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--check-build", action="store_true",
                    help="print available frameworks/features and exit "
@@ -194,6 +196,8 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
             args.stall_check_shutdown_time_seconds)
     if args.log_level:
         env["HVD_TPU_LOG_LEVEL"] = args.log_level
+    if args.log_hide_timestamp:
+        env["HVD_TPU_LOG_HIDE_TIME"] = "1"
     return env
 
 

@@ -55,6 +55,11 @@ def test_knob_env_new_flags():
     assert env["HVD_TPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.5"
 
 
+def test_log_hide_timestamp_flag():
+    args = parse_args(["-np", "1", "--log-hide-timestamp", "python", "x"])
+    assert knob_env(args)["HVD_TPU_LOG_HIDE_TIME"] == "1"
+
+
 def test_local_addresses_iface_restriction():
     from horovod_tpu.runner.probe import local_addresses
     assert local_addresses(iface="lo") == ["127.0.0.1"]
