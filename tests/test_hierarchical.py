@@ -40,6 +40,12 @@ def _worker(rank, size, port, q):
         mx = ctl.allreduce(np.full((5,), float(rank), dtype=np.float64),
                            op=4, name="hmax")
         np.testing.assert_allclose(mx, size - 1)
+        # Large payload: exercises the chunk-pipelined intra-node chain
+        # and the shm/CMA transports through the hierarchical path.
+        big = np.full((1 << 20,), float(rank + 1), dtype=np.float32)
+        out = ctl.allreduce(big, op=1, name="hbig")
+        np.testing.assert_allclose(out[:4], sum(range(1, size + 1)))
+        np.testing.assert_allclose(out[-4:], sum(range(1, size + 1)))
         q.put((rank, "ok", True))
     except Exception as e:  # noqa: BLE001
         q.put((rank, "error", repr(e)))
