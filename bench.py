@@ -243,13 +243,17 @@ def _timed_resnet(mesh, per_chip_batch, image_size, depth, width, iters,
     return batch * iters / dt  # global img/s
 
 
-def bench_scaling():
+def bench_scaling(degraded_from=None):
     """Data-parallel scaling efficiency on an N-device mesh: step time
     without gradient collectives / step time with them — the fraction of
     the step NOT spent on communication, which is what the reference's
     headline "90% scaling efficiency at 512 GPUs" measures.  This form is
     valid on a virtual CPU mesh too (raw N=8-vs-N=1 throughput there would
-    measure shared-core contention, not communication)."""
+    measure shared-core contention, not communication).
+
+    When invoked as the degraded fallback for a real-chip mode (TPU tunnel
+    dead), vs_baseline is null: CPU-loopback comm fraction is not
+    comparable to the reference's 512-GPU scaling chart."""
     import jax
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -264,9 +268,11 @@ def bench_scaling():
     iters = int(os.environ.get("BENCH_ITERS", "5"))
 
     # Default to an n-device virtual CPU mesh (multi-chip TPU hardware is
-    # rarely on the bench host); BENCH_SCALING_REAL=1 uses real devices.
+    # rarely on the bench host); BENCH_SCALING_REAL=1 uses real devices —
+    # except on the degraded path, where the real transport is known dead
+    # and touching it would hang forever.
     # Must run before the first backend-initializing jax call.
-    if os.environ.get("BENCH_SCALING_REAL") != "1":
+    if degraded_from is not None or os.environ.get("BENCH_SCALING_REAL") != "1":
         try:
             jax.config.update("jax_platforms", "cpu")
             jax.config.update("jax_num_cpu_devices", n)
@@ -287,7 +293,7 @@ def bench_scaling():
                              width, iters, distributed=False)
     # throughputs are img/s: higher nocomm throughput → comm overhead.
     eff = min(t_comm / t_nocomm, 1.0)
-    _emit({
+    payload = {
         "metric": f"resnet{depth}_dp_scaling_efficiency",
         "value": round(eff, 4),
         "unit": f"non-communication fraction of DP step, N={n}",
@@ -296,7 +302,14 @@ def bench_scaling():
         "throughput_with_comm": round(t_comm, 2),
         "throughput_without_comm": round(t_nocomm, 2),
         "devices": n,
-    })
+    }
+    if degraded_from is not None:
+        # A CPU-loopback comm fraction says nothing about ICI at pod-slice
+        # scale; don't imply comparability with the reference's GPU chart.
+        payload["vs_baseline"] = None
+        payload["degraded_from"] = degraded_from
+        payload["degraded_reason"] = "tpu_tunnel_unreachable"
+    _emit(payload)
 
 
 def bench_resnet():
@@ -420,7 +433,7 @@ def main():
         sys.stderr.write(
             "bench: TPU tunnel unreachable; falling back to the CPU-mesh "
             "scaling metric\n")
-        return bench_scaling()
+        return bench_scaling(degraded_from=mode)
     if mode == "bert":
         return bench_bert()
     if mode == "scaling":

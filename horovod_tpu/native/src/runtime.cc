@@ -386,8 +386,11 @@ void Runtime::ExecuteAllreduce(
                   entries[0]->input && entries[0]->output;
   if (in_place) {
     fb = static_cast<uint8_t*>(entries[0]->output);
-    if (entries[0]->output != entries[0]->input)
+    if (entries[0]->output != entries[0]->input) {
+      timeline_.Record(resp.names[0], "B", "MEMCPY_IN_FUSION_BUFFER");
       memcpy(fb, entries[0]->input, total_bytes);
+      timeline_.Record(resp.names[0], "E", "MEMCPY_IN_FUSION_BUFFER");
+    }
   } else {
     if (fusion_buffer_.size() < total_bytes)
       fusion_buffer_.resize(total_bytes);

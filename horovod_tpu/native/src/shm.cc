@@ -123,6 +123,10 @@ void ShmChannel::Unlink() {
 }
 
 Status ShmChannel::Push(const uint8_t* data, size_t n) {
+  if (n > kSlotBytes)
+    return Status::Error("shm Push: chunk of " + std::to_string(n) +
+                         " bytes exceeds slot size " +
+                         std::to_string(kSlotBytes));
   uint64_t head = hdr_->head.load(std::memory_order_relaxed);
   Status st = WaitFor(
       [&] {
@@ -139,6 +143,8 @@ Status ShmChannel::Push(const uint8_t* data, size_t n) {
 }
 
 Status ShmChannel::PushRef(const uint8_t* data, size_t n) {
+  // No size guard here: a descriptor publishes (addr, n) without copying
+  // into the slot, and the consumer chunk-reads arbitrarily large regions.
   uint64_t head = hdr_->head.load(std::memory_order_relaxed);
   Status st = WaitFor(
       [&] {
