@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -32,6 +33,22 @@ try:  # bfloat16 — the TPU-native wire format (C++ kernels: code 8)
     _DTYPE_CODES[np.dtype(_ml_dtypes.bfloat16)] = 8
 except ImportError:
     pass
+
+
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_CODES.items()}
+
+# Device-executor callback signature (runtime.h DeviceExecutorFn): executes
+# one negotiated, possibly-fused device-resident Response on the background
+# thread, in coordinator response order.
+_DEVICE_EXEC_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int,                        # return: 0 ok
+    ctypes.c_int, ctypes.c_int,          # request_type, n
+    ctypes.POINTER(ctypes.c_char_p),     # names
+    ctypes.POINTER(ctypes.c_int64),      # sizes (element counts)
+    ctypes.c_int, ctypes.c_int,          # dtype code, reduce op
+    ctypes.c_int,                        # root_rank
+    ctypes.c_double, ctypes.c_double,    # prescale, postscale
+    ctypes.POINTER(ctypes.c_char), ctypes.c_int)  # err buf, err cap
 
 
 def _lib_path() -> str:
@@ -102,6 +119,15 @@ def load_library():
     lib.hvd_native_set_topology.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.hvd_native_counters.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
+    lib.hvd_native_allreduce_device.restype = ctypes.c_int64
+    lib.hvd_native_allreduce_device.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double]
+    lib.hvd_native_broadcast_device.restype = ctypes.c_int64
+    lib.hvd_native_broadcast_device.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_set_device_executor.argtypes = [_DEVICE_EXEC_FN]
     _lib = lib
     return lib
 
@@ -142,6 +168,28 @@ class NativeController:
         self._lib.hvd_native_set_topology(
             local_size, 1 if cfg.hierarchical_allreduce else 0)
         self._counters = {}
+        # Negotiated device plane: HBM-resident tensors enqueued with
+        # *_device keep their payload on the accelerator; the registered
+        # executor runs each fused Response through the jitted device plane
+        # (reference: device-buffer fusion inside the negotiated runtime,
+        # nccl_operations.cc:126-184).
+        self._device_lock = threading.Lock()
+        self._device_inputs = {}   # name -> jax.Array awaiting execution
+        self._device_results = {}  # name -> executed result
+        self._device_cb = None     # keep the CFUNCTYPE alive (GC hazard)
+        self._device_exec_impl = None
+        # Register the executor NOW, not lazily on first device op: every
+        # rank of the communicator must be able to participate in a device
+        # Response (joined ranks contribute zero proxies) even if it never
+        # submitted a device tensor itself — a rank without an executor
+        # would strand its peers inside the SPMD collective.  Building the
+        # impl touches no jax state; the spanning check happens at
+        # enqueue/execution time.
+        try:
+            from ..ops.eager import _negotiated_executor
+            self.set_device_executor(_negotiated_executor(self))
+        except ImportError:
+            pass
         # Autotune (reference ParameterManager): rank 0 owns fusion
         # decisions, so the tuner runs there and applies via SetParams.
         self._autotune = None
@@ -197,6 +245,117 @@ class NativeController:
         self._lib.hvd_native_counters(ctypes.byref(nbytes),
                                       ctypes.byref(secs))
         self._autotune.record_bytes(nbytes.value)
+
+    # -- negotiated device plane ------------------------------------------
+
+    def set_device_executor(self, impl) -> None:
+        """Register the device-plane executor.  ``impl(request_type, names,
+        sizes, np_dtype, op, root_rank, prescale, postscale, inputs)`` runs
+        one negotiated Response on device and returns {name: result} for the
+        locally-submitted names (missing names are joined-rank zero
+        proxies the impl synthesizes itself)."""
+        self._device_exec_impl = impl
+        if self._device_cb is not None:
+            return
+        controller = self
+
+        def _cb(rtype, n, names_p, sizes_p, dtype_code, op, root,
+                prescale, postscale, err, err_cap):
+            try:
+                names = [names_p[i].decode() for i in range(n)]
+                sizes = [int(sizes_p[i]) for i in range(n)]
+                np_dtype = _CODE_TO_DTYPE[dtype_code]
+                with controller._device_lock:
+                    inputs = {nm: controller._device_inputs[nm]
+                              for nm in names
+                              if nm in controller._device_inputs}
+                results = controller._device_exec_impl(
+                    rtype, names, sizes, np_dtype, op, root, prescale,
+                    postscale, inputs)
+                with controller._device_lock:
+                    controller._device_results.update(results)
+                return 0
+            except BaseException as e:  # noqa: BLE001 — must not unwind into C
+                msg = repr(e).encode()[: max(err_cap - 1, 0)]
+                ctypes.memmove(err, msg + b"\x00", len(msg) + 1)
+                return 1
+
+        self._device_cb = _DEVICE_EXEC_FN(_cb)
+        self._lib.hvd_native_set_device_executor(self._device_cb)
+
+    def _device_dtype_code(self, arr) -> int:
+        code = _DTYPE_CODES.get(np.dtype(arr.dtype))
+        if code is None:
+            raise TypeError(
+                f"unsupported dtype {arr.dtype} for the device plane")
+        return code
+
+    def _device_shape_arg(self, arr):
+        shape = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (1,)))
+        return arr.ndim, shape
+
+    def allreduce_device_submit(self, arr, op: int = 1,
+                                prescale: float = 1.0,
+                                postscale: float = 1.0,
+                                name: Optional[str] = None
+                                ) -> Tuple[int, str]:
+        nm = self._auto_name("allreduce", name).decode()
+        with self._device_lock:
+            self._device_inputs[nm] = arr
+        ndim, shape = self._device_shape_arg(arr)
+        h = self._lib.hvd_native_allreduce_device(
+            nm.encode(), ndim, shape, self._device_dtype_code(arr), op,
+            prescale, postscale)
+        if h < 0:
+            with self._device_lock:
+                self._device_inputs.pop(nm, None)
+            raise NativeError(self._last_error())
+        return h, nm
+
+    def broadcast_device_submit(self, arr, root_rank: int = 0,
+                                name: Optional[str] = None
+                                ) -> Tuple[int, str]:
+        nm = self._auto_name("broadcast", name).decode()
+        with self._device_lock:
+            self._device_inputs[nm] = arr
+        ndim, shape = self._device_shape_arg(arr)
+        h = self._lib.hvd_native_broadcast_device(
+            nm.encode(), ndim, shape, self._device_dtype_code(arr),
+            root_rank)
+        if h < 0:
+            with self._device_lock:
+                self._device_inputs.pop(nm, None)
+            raise NativeError(self._last_error())
+        return h, nm
+
+    def device_finish(self, h: int, name: str):
+        """Wait for a *_device_submit handle and collect the on-device
+        result (the payload never visited host memory)."""
+        try:
+            self._wait(h)
+        except NativeError:
+            with self._device_lock:
+                self._device_inputs.pop(name, None)
+                self._device_results.pop(name, None)
+            raise
+        self._lib.hvd_native_release(h)
+        with self._device_lock:
+            self._device_inputs.pop(name, None)
+            out = self._device_results.pop(name, None)
+        return out
+
+    def allreduce_device(self, arr, op: int = 1, prescale: float = 1.0,
+                         postscale: float = 1.0,
+                         name: Optional[str] = None):
+        h, nm = self.allreduce_device_submit(
+            arr, op=op, prescale=prescale, postscale=postscale, name=name)
+        return self.device_finish(h, nm)
+
+    def broadcast_device(self, arr, root_rank: int = 0,
+                         name: Optional[str] = None):
+        h, nm = self.broadcast_device_submit(arr, root_rank=root_rank,
+                                             name=name)
+        return self.device_finish(h, nm)
 
     # -- collectives -------------------------------------------------------
 

@@ -80,6 +80,35 @@ int64_t hvd_native_allreduce(const char* name, const void* input,
   return EnqueueChecked(std::move(e));
 }
 
+// Device-resident enqueue: the payload stays in accelerator HBM; the
+// runtime negotiates/fuses/caches as usual and hands the fused response to
+// the registered device executor instead of the host rings.
+int64_t hvd_native_allreduce_device(const char* name, int ndim,
+                                    const int64_t* shape, int dtype, int op,
+                                    double prescale, double postscale) {
+  auto e = MakeEntry(name, RequestType::ALLREDUCE, nullptr, nullptr, ndim,
+                     shape, dtype);
+  e->op = static_cast<ReduceOp>(op);
+  e->prescale = prescale;
+  e->postscale = postscale;
+  e->device = true;
+  return EnqueueChecked(std::move(e));
+}
+
+int64_t hvd_native_broadcast_device(const char* name, int ndim,
+                                    const int64_t* shape, int dtype,
+                                    int root_rank) {
+  auto e = MakeEntry(name, RequestType::BROADCAST, nullptr, nullptr, ndim,
+                     shape, dtype);
+  e->root_rank = root_rank;
+  e->device = true;
+  return EnqueueChecked(std::move(e));
+}
+
+void hvd_native_set_device_executor(DeviceExecutorFn fn) {
+  Runtime::Get().SetDeviceExecutor(fn);
+}
+
 int64_t hvd_native_allgather(const char* name, const void* input, int ndim,
                              const int64_t* shape, int dtype) {
   return EnqueueChecked(MakeEntry(name, RequestType::ALLGATHER, input,

@@ -44,7 +44,7 @@ class ResponseCache {
     if (m.type != q.type || m.dtype != q.dtype || m.op != q.op ||
         m.root_rank != q.root_rank || m.prescale != q.prescale ||
         m.postscale != q.postscale || m.shape != q.shape ||
-        m.splits != q.splits)
+        m.splits != q.splits || m.device != q.device)
       return -1;
     return static_cast<int32_t>(it->second);
   }

@@ -77,6 +77,11 @@ struct TensorEntry {
   double postscale = 1.0;
   const void* input = nullptr;
   void* output = nullptr;          // for allreduce/broadcast: same size as in
+  // Device-resident entry: payload lives in accelerator HBM and is executed
+  // by the registered device executor (the TPU analog of the reference's
+  // device-buffer fusion inside the negotiated runtime,
+  // nccl_operations.cc:126-184); input/output stay null.
+  bool device = false;
   std::vector<int64_t> splits;     // alltoall send splits (first-dim rows)
   // Variable-size outputs (allgather/alltoall): runtime allocates and Python
   // copies out; holds the buffer until handle collected.

@@ -45,6 +45,14 @@ std::string Validate(const std::map<int32_t, Request>& by_rank) {
       err << "mismatched prescale/postscale factors";
       return err.str();
     }
+    if (q.device != first->device) {
+      // Reference validates device placement consistency the same way
+      // (controller.cc:482-706): a collective must be all-HBM or all-host.
+      err << "mismatched device placement: rank " << first_rank << " is "
+          << (first->device ? "device" : "host") << ", rank " << rank
+          << " is " << (q.device ? "device" : "host");
+      return err.str();
+    }
     if (q.type == RequestType::ALLREDUCE ||
         q.type == RequestType::BROADCAST) {
       if (q.shape != first->shape) {
@@ -237,6 +245,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
           open_fusion != nullptr && open_fusion->dtype == q.dtype &&
           open_fusion->op == q.op && open_fusion->prescale == q.prescale &&
           open_fusion->postscale == q.postscale &&
+          open_fusion->device == q.device &&
           open_bytes + bytes <= effective_fusion_threshold();
       if (fusible) {
         open_fusion->names.push_back(name);
@@ -251,6 +260,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
         resp.op = q.op;
         resp.prescale = q.prescale;
         resp.postscale = q.postscale;
+        resp.device = q.device;
         resp.sizes = {NumElements(q.shape)};
         resp.cache_bits = {cache_bit};
         rl.responses.push_back(resp);
@@ -283,6 +293,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       resp.names = {name};
       resp.dtype = q.dtype;
       resp.root_rank = q.root_rank;
+      resp.device = q.device;
       resp.sizes = {NumElements(q.shape)};
       resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);

@@ -222,6 +222,7 @@ void Runtime::BackgroundLoop() {
         q.prescale = e->prescale;
         q.postscale = e->postscale;
         q.splits = e->splits;
+        q.device = e->device;
         // Response-cache fast path: announce a previously-negotiated
         // tensor as one bit instead of the full request (reference
         // controller.cc:181-237).
@@ -342,6 +343,7 @@ void Runtime::ExecuteResponse(const Response& resp) {
       q.prescale = e->prescale;
       q.postscale = e->postscale;
       q.splits = e->splits;
+      q.device = e->device;
       worker_cache_.InsertAt(resp.cache_bits[i], resp.names[i], q);
     }
   }
@@ -367,9 +369,61 @@ void Runtime::ExecuteResponse(const Response& resp) {
   }
 }
 
+void Runtime::ExecuteDeviceCollective(
+    const Response& resp,
+    std::vector<std::shared_ptr<TensorEntry>>& entries) {
+  // Negotiated device-resident execution: the fused payload never touches
+  // host memory — the registered executor runs it on HBM via the jitted
+  // device plane (reference: NCCLAllreduce on device fusion buffers,
+  // nccl_operations.cc:126-184).  Invoked in coordinator response order,
+  // identical across ranks, so the executor's SPMD collectives line up
+  // even when per-rank enqueue order diverged.
+  DeviceExecutorFn fn = device_executor_.load();
+  Status st;
+  if (fn == nullptr) {
+    st = Status::PreconditionError(
+        "device-resident response but no device executor registered");
+    // Surface this even when this rank holds no local entries (e.g. a
+    // joined rank): its non-participation strands peers inside the SPMD
+    // collective, and a silent drop would look like a hang.
+    fprintf(stderr,
+            "[hvdtpu rank %d] ERROR: device response '%s' has no device "
+            "executor; peer ranks will stall in the device collective\n",
+            net_ ? net_->rank() : -1, resp.names[0].c_str());
+  } else {
+    std::vector<const char*> names(resp.names.size());
+    for (size_t i = 0; i < resp.names.size(); ++i)
+      names[i] = resp.names[i].c_str();
+    char err[512];
+    err[0] = '\0';
+    timeline_.Record(resp.names[0], "B", "DEVICE_COLLECTIVE");
+    int rc = fn(static_cast<int>(resp.type),
+                static_cast<int>(names.size()), names.data(),
+                resp.sizes.data(), static_cast<int>(resp.dtype),
+                static_cast<int>(resp.op), resp.root_rank, resp.prescale,
+                resp.postscale, err, sizeof(err));
+    timeline_.Record(resp.names[0], "E", "DEVICE_COLLECTIVE");
+    if (rc != 0) {
+      st = Status::Error(err[0] ? err : "device executor failed");
+    } else {
+      int64_t total_elems = 0;
+      for (size_t i = 0; i < resp.names.size() && i < resp.sizes.size();
+           ++i)
+        total_elems += resp.sizes[i];
+      bytes_processed_ += total_elems * DataTypeSize(resp.dtype);
+    }
+  }
+  for (auto& e : entries)
+    if (e) Finish(e, st);
+}
+
 void Runtime::ExecuteAllreduce(
     const Response& resp,
     std::vector<std::shared_ptr<TensorEntry>>& entries) {
+  if (resp.device) {
+    ExecuteDeviceCollective(resp, entries);
+    return;
+  }
   // resp.sizes[i] = element count of names[i] (authoritative — joined ranks
   // have no local entry and synthesize zeros).
   int64_t total_elems = 0;
@@ -480,6 +534,11 @@ void Runtime::ExecuteAllgather(const Response& resp,
 
 void Runtime::ExecuteBroadcast(const Response& resp,
                                std::shared_ptr<TensorEntry> entry) {
+  if (resp.device) {
+    std::vector<std::shared_ptr<TensorEntry>> entries{entry};
+    ExecuteDeviceCollective(resp, entries);
+    return;
+  }
   const size_t elem = DataTypeSize(resp.dtype);
   const int64_t nbytes = resp.sizes[0] * elem;
   std::vector<uint8_t> scratch;

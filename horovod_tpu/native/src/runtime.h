@@ -29,6 +29,18 @@
 
 namespace hvdtpu {
 
+// Device-executor callback (registered from Python via ctypes): executes one
+// negotiated, possibly-fused Response whose entries are accelerator-resident
+// — the TPU analog of NCCLAllreduce::Execute running on device buffers
+// inside the negotiated runtime (reference nccl_operations.cc:126-184).
+// Invoked on the background thread in coordinator response order (identical
+// on every rank, so SPMD-dispatched device collectives line up).
+// Returns 0 on success; nonzero with a message written into err.
+typedef int (*DeviceExecutorFn)(int request_type, int n, const char** names,
+                                const int64_t* sizes, int dtype, int op,
+                                int root_rank, double prescale,
+                                double postscale, char* err, int err_cap);
+
 struct HandleState {
   std::atomic<bool> done{false};
   Status status;
@@ -64,6 +76,7 @@ class Runtime {
   // Node topology for hierarchical collectives (ranks grouped into nodes
   // of local_size consecutive ranks; ICI-intra / DCN-inter analog).
   void SetTopology(int local_size, bool hierarchical_allreduce);
+  void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();
 
@@ -79,6 +92,9 @@ class Runtime {
                         std::shared_ptr<TensorEntry> entry);
   void ExecuteAlltoall(const Response& resp,
                        std::shared_ptr<TensorEntry> entry);
+  void ExecuteDeviceCollective(
+      const Response& resp,
+      std::vector<std::shared_ptr<TensorEntry>>& entries);
   std::shared_ptr<TensorEntry> TakeSubmitted(const std::string& name);
   void Finish(std::shared_ptr<TensorEntry>& e, const Status& s);
 
@@ -127,6 +143,7 @@ class Runtime {
   std::atomic<int64_t> bytes_processed_{0};
   int local_size_ = 1;
   bool hierarchical_allreduce_ = false;
+  std::atomic<DeviceExecutorFn> device_executor_{nullptr};
   std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;
   Status loop_error_;

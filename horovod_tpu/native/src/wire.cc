@@ -13,6 +13,7 @@ static void SerializeRequest(const Request& q, Writer& w) {
   w.f64(q.prescale);
   w.f64(q.postscale);
   w.vec_i64(q.splits);
+  w.u8(q.device ? 1 : 0);
 }
 
 static Request DeserializeRequest(Reader& r) {
@@ -27,6 +28,7 @@ static Request DeserializeRequest(Reader& r) {
   q.prescale = r.f64();
   q.postscale = r.f64();
   q.splits = r.vec_i64();
+  q.device = r.u8() != 0;
   return q;
 }
 
@@ -63,6 +65,7 @@ static void SerializeResponse(const Response& s, Writer& w) {
   w.f64(s.postscale);
   w.vec_i64(s.sizes);
   w.vec_u32(s.cache_bits);
+  w.u8(s.device ? 1 : 0);
 }
 
 static Response DeserializeResponse(Reader& r) {
@@ -79,6 +82,7 @@ static Response DeserializeResponse(Reader& r) {
   s.postscale = r.f64();
   s.sizes = r.vec_i64();
   s.cache_bits = r.vec_u32();
+  s.device = r.u8() != 0;
   return s;
 }
 

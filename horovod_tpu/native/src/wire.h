@@ -26,6 +26,7 @@ struct Request {
   double prescale = 1.0;
   double postscale = 1.0;
   std::vector<int64_t> splits;  // alltoall: rows destined per rank
+  bool device = false;          // payload is accelerator-resident (HBM)
 };
 
 // What every worker sends each cycle.
@@ -53,6 +54,9 @@ struct Response {
   std::vector<int64_t> sizes;
   // Cache slot per name (aligned with ``names``; UINT32_MAX = uncached).
   std::vector<uint32_t> cache_bits;
+  // Execute through the registered device executor on HBM buffers instead
+  // of the host TCP data plane (all fused entries are device-resident).
+  bool device = false;
 };
 
 struct ResponseList {

@@ -96,6 +96,100 @@ def _device_allreduce(tensor, op_fn, ctl):
     return None
 
 
+def _negotiated_device_ready(ctl) -> bool:
+    """True when HBM-resident eager tensors can take the *negotiated*
+    device plane: named-tensor negotiation, fusion and the response cache
+    run exactly as for host tensors, but the fused payload executes through
+    the jitted device collective instead of host rings (the reference's
+    device-buffer fusion inside the negotiated runtime,
+    nccl_operations.cc:126-184).
+
+    Requires a spanning JAX world (jax.process_count() == communicator
+    size) — the coordinator's response order is identical on every rank, so
+    the executor's SPMD collectives line up even when per-rank enqueue
+    order diverged.  Attaches the executor to the controller on first use.
+    """
+    import os
+    if os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE", "1") == "0":
+        return False
+    if getattr(ctl, "_negotiated_device_ok", False):
+        return True
+    try:
+        import jax
+        ok = jax.process_count() == ctl.size()
+    except Exception:
+        ok = False
+    if ok:
+        # Cache only the positive result: a world that is still forming
+        # (jax.distributed not yet spanning) must be re-checked on later
+        # calls, or every HBM tensor would silently take the host plane
+        # for the life of the process.  The executor itself is registered
+        # at controller construction (see NativeController.__init__).
+        ctl._negotiated_device_ok = True
+    return ok
+
+
+def _negotiated_executor(ctl):
+    """Build the device-plane executor for one controller: executes a
+    negotiated (possibly fused) Response entirely on device.  Runs on the
+    native background thread in coordinator response order."""
+
+    def impl(rtype, names, sizes, np_dtype, op, root, prescale, postscale,
+             inputs):
+        import jax.numpy as jnp
+        from .collective import _eager_op_fn
+        dtype = jnp.dtype(np_dtype)
+        arrays, shapes = [], []
+        for nm, sz in zip(names, sizes):
+            a = inputs.get(nm)
+            if a is None:
+                # Joined-rank zero proxy (reference GetTensorEntries-
+                # FromResponse zero tensors, tensor_queue.cc).
+                a = jnp.zeros((sz,), dtype=dtype)
+            arrays.append(a)
+            shapes.append(a.shape)
+        # Fused dispatch: one flat payload -> one device collective per
+        # Response (the fusion-buffer analog; packing is D2D only).
+        if len(arrays) == 1:
+            fused = jnp.ravel(arrays[0])
+        else:
+            fused = jnp.concatenate([jnp.ravel(a) for a in arrays])
+        if rtype == 0:  # ALLREDUCE
+            base = _eager_op_fn(int(op), float(prescale), float(postscale))
+        elif rtype == 2:  # BROADCAST
+            base = _take_fn(int(root))
+        else:
+            raise ValueError(
+                f"device plane does not execute request type {rtype}")
+        # Split + reshape inside the jitted computation: eager indexing of
+        # a non-fully-addressable global array is not portable across
+        # multi-process JAX versions.
+        fn = _fused_split_fn(base, tuple(sizes), tuple(shapes))
+        parts = _device_allreduce(fused, fn, ctl)
+        if parts is None:
+            raise RuntimeError(
+                "device plane unavailable (no spanning JAX world)")
+        return {nm: parts[i] for i, nm in enumerate(names) if nm in inputs}
+
+    return impl
+
+
+@functools.lru_cache(maxsize=512)
+def _fused_split_fn(base_fn, sizes, shapes):
+    """Reduce the fused flat payload with ``base_fn`` then split it back
+    into per-tensor views, all in one compiled program (the fusion-buffer
+    unpack, on device)."""
+    def fn(stack):
+        out = base_fn(stack)
+        res = []
+        off = 0
+        for sz, shp in zip(sizes, shapes):
+            res.append(out[off: off + sz].reshape(shp))
+            off += sz
+        return tuple(res)
+    return fn
+
+
 def _ctl(fn, *args, **kwargs):
     """Run a native-controller call, mapping transport/collective failures
     to HorovodInternalError so the elastic retry loop can restore state
@@ -192,11 +286,21 @@ def allreduce(tensor, op_fn, name: Optional[str] = None,
     callables across the C boundary)."""
     ctl = _controller()
     if _is_device_array(tensor):
-        # TPU-resident tensors take the on-device ICI plane when one exists
-        # (never copies to host); None → no device path to the other ranks.
-        out = _device_allreduce(tensor, op_fn, ctl)
-        if out is not None:
-            return out
+        if ctl is not None:
+            # Negotiated device plane: controller negotiation, fusion and
+            # response cache run as usual; the fused payload executes on
+            # HBM via the registered executor (never copies to host).
+            if _negotiated_device_ready(ctl):
+                return _ctl(ctl.allreduce_device, tensor,
+                            op=1 if op_code is None else int(op_code),
+                            prescale=prescale, postscale=postscale,
+                            name=name)
+        else:
+            # No controller: direct SPMD device plane (multi-process JAX /
+            # single process); None → no device path to the other ranks.
+            out = _device_allreduce(tensor, op_fn, ctl)
+            if out is not None:
+                return out
     if ctl is not None:
         return _ctl(ctl.allreduce, _np(tensor),
                     op=1 if op_code is None else int(op_code),
@@ -232,7 +336,12 @@ def _device_allgather(tensor, ctl):
 def allgather(tensor, name: Optional[str] = None):
     """Concatenate along dim 0 across processes (unequal dim-0 allowed)."""
     ctl = _controller()
-    if _is_device_array(tensor):
+    if _is_device_array(tensor) and ctl is None:
+        # Direct SPMD device plane (no controller).  With a controller
+        # attached, allgather goes through negotiation on the host plane:
+        # issuing direct mesh collectives from the caller thread would race
+        # the negotiated device responses executing on the background
+        # thread over the same process mesh.
         out = _device_allgather(tensor, ctl)
         if out is not None:
             return out
@@ -263,11 +372,16 @@ def _one_hot_sizes(rows: int) -> np.ndarray:
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     ctl = _controller()
     if _is_device_array(tensor):
-        # Broadcast shapes match across ranks by contract, so the
-        # device plane applies directly (select the root's shard).
-        out = _device_allreduce(tensor, _take_fn(root_rank), ctl)
-        if out is not None:
-            return out
+        if ctl is not None:
+            if _negotiated_device_ready(ctl):
+                return _ctl(ctl.broadcast_device, tensor,
+                            root_rank=root_rank, name=name)
+        else:
+            # Broadcast shapes match across ranks by contract, so the
+            # device plane applies directly (select the root's shard).
+            out = _device_allreduce(tensor, _take_fn(root_rank), ctl)
+            if out is not None:
+                return out
     if ctl is not None:
         return _ctl(ctl.broadcast, _np(tensor), root_rank=root_rank,
                     name=name)

@@ -92,6 +92,109 @@ def _dist_worker(rank, size, coord_port, q):
         q.put((rank, "error", repr(e)))
 
 
+def _negotiated_worker(rank, size, ctl_port, jax_port, q):
+    """Worker for the *negotiated* device plane: a native controller (TCP
+    negotiation/fusion/cache) + a spanning jax.distributed world.  Device
+    arrays go through named-tensor negotiation and execute on device
+    (VERDICT r2 #2: reference nccl_operations.cc:126-184)."""
+    sys.path.insert(0, REPO)
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{jax_port}",
+            num_processes=size, process_id=rank)
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import eager
+
+        os.environ["HVD_TPU_CONTROLLER_ADDR"] = f"127.0.0.1:{ctl_port}"
+        os.environ["HVD_TPU_RANK"] = str(rank)
+        os.environ["HVD_TPU_SIZE"] = str(size)
+        hvd.init()
+        ctl = eager._controller()
+        assert ctl is not None, "native controller not attached"
+
+        # Tripwire: the negotiated device plane must never convert the
+        # payload to numpy (no host copy).
+        eager._np = lambda _t: (_ for _ in ()).throw(
+            AssertionError("host copy on negotiated device plane"))
+
+        # 1. Sync allreduce through negotiation.
+        x = jnp.full((8,), float(rank + 1), dtype=jnp.float32)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        assert isinstance(out, jax.Array)
+        assert float(np.asarray(out)[0]) == 3.0
+
+        # 2. Enqueue-order SKEW: per-rank submission order diverges; the
+        # coordinator's response order still lines both ranks up (the
+        # whole point of negotiation — the direct SPMD plane cannot do
+        # this).
+        a = jnp.full((4,), 10.0 * (rank + 1), dtype=jnp.float32)
+        b = jnp.full((6,), 100.0 * (rank + 1), dtype=jnp.float32)
+        if rank == 0:
+            ha = ctl.allreduce_device_submit(a, op=1, name="skew.a")
+            hb = ctl.allreduce_device_submit(b, op=1, name="skew.b")
+        else:
+            hb = ctl.allreduce_device_submit(b, op=1, name="skew.b")
+            ha = ctl.allreduce_device_submit(a, op=1, name="skew.a")
+        ra = ctl.device_finish(*ha)
+        rb = ctl.device_finish(*hb)
+        assert float(np.asarray(ra)[0]) == 30.0, np.asarray(ra)
+        assert float(np.asarray(rb)[0]) == 300.0, np.asarray(rb)
+
+        # 3. Average + broadcast ride the same negotiated plane.
+        avg = hvd.allreduce(x, op=hvd.Average)
+        assert float(np.asarray(avg)[0]) == 1.5
+        bc = hvd.broadcast(jnp.full((3,), float(rank), dtype=jnp.float32),
+                           root_rank=1)
+        assert isinstance(bc, jax.Array)
+        assert float(np.asarray(bc)[0]) == 1.0
+
+        # 4. Repeat iteration — exercises the response-cache fast path for
+        # device requests (same names, same meta).
+        for _ in range(3):
+            out = hvd.allreduce(x, op=hvd.Sum, name="cached.t")
+            assert float(np.asarray(out)[0]) == 3.0
+
+        ctl.shutdown()
+        q.put((rank, "ok", None))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, "error", traceback.format_exc()[-2000:] + repr(e)))
+
+
+@pytest.mark.timeout(240)
+def test_negotiated_device_plane_two_ranks():
+    """Controller negotiation + fusion + cache with HBM-resident payloads:
+    two jax.distributed processes, each also a native-controller rank;
+    device arrays never touch host numpy and enqueue-order skew resolves
+    through coordinator ordering."""
+    size = 2
+    ctl_port, jax_port = _free_port(), _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_negotiated_worker,
+                         args=(r, size, ctl_port, jax_port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    try:
+        for _ in range(size):
+            rank, status, payload = q.get(timeout=180)
+            assert status == "ok", f"rank {rank}: {payload}"
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        # On failure a surviving worker may be blocked inside the
+        # distributed collective — never leak it into the rest of the
+        # suite.
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+
+
 @pytest.mark.timeout(240)
 def test_multiprocess_jax_distributed_device_plane():
     """Two jax.distributed processes (CPU backend standing in for two TPU
