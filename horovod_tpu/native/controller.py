@@ -116,7 +116,10 @@ def load_library():
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
-    lib.hvd_native_set_topology.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_set_topology.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
+    lib.hvd_native_adasum_scratch_peak.restype = ctypes.c_int64
     lib.hvd_native_counters.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
     lib.hvd_native_allreduce_device.restype = ctypes.c_int64
@@ -166,7 +169,8 @@ class NativeController:
         # env contract; reference HOROVOD_HIERARCHICAL_ALLREDUCE knob).
         local_size = int(_config.get_env("LOCAL_SIZE", "1") or 1)
         self._lib.hvd_native_set_topology(
-            local_size, 1 if cfg.hierarchical_allreduce else 0)
+            local_size, 1 if cfg.hierarchical_allreduce else 0,
+            1 if cfg.hierarchical_allgather else 0)
         self._counters = {}
         # Negotiated device plane: HBM-resident tensors enqueued with
         # *_device keep their payload on the accelerator; the registered
@@ -540,6 +544,17 @@ class NativeController:
     def barrier(self):
         if self._lib.hvd_native_barrier() != 0:
             raise NativeError(self._last_error())
+
+    def last_allgather_schedule(self) -> int:
+        """0 = flat ring, 1 = hierarchical (most recent allgather)."""
+        return self._lib.hvd_native_last_allgather_schedule()
+
+    def adasum_scratch_peak(self) -> int:
+        """Peak scratch bytes of the Adasum VHDD path since last reset."""
+        return self._lib.hvd_native_adasum_scratch_peak()
+
+    def adasum_scratch_reset(self) -> None:
+        self._lib.hvd_native_adasum_scratch_reset()
 
     def rank(self) -> int:
         return self._lib.hvd_native_rank()

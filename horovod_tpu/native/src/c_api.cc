@@ -180,9 +180,21 @@ int hvd_native_barrier() {
   return st.ok() ? 0 : -1;
 }
 
-void hvd_native_set_topology(int local_size, int hierarchical_allreduce) {
-  Runtime::Get().SetTopology(local_size, hierarchical_allreduce != 0);
+void hvd_native_set_topology(int local_size, int hierarchical_allreduce,
+                             int hierarchical_allgather) {
+  Runtime::Get().SetTopology(local_size, hierarchical_allreduce != 0,
+                             hierarchical_allgather != 0);
 }
+
+// Test/observability hook: 0 = flat ring, 1 = hierarchical (schedule used
+// by this process's most recent allgather).
+int hvd_native_last_allgather_schedule() {
+  return LastAllgatherSchedule();
+}
+
+// Test/observability hooks: peak scratch bytes of the Adasum VHDD path.
+int64_t hvd_native_adasum_scratch_peak() { return AdasumScratchPeak(); }
+void hvd_native_adasum_scratch_reset() { ResetAdasumScratchPeak(); }
 
 void hvd_native_set_params(int64_t fusion_threshold, double cycle_time_ms) {
   Runtime::Get().SetParams(fusion_threshold, cycle_time_ms);

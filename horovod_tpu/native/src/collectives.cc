@@ -4,6 +4,7 @@
 #include <sys/socket.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cmath>
 #include <functional>
@@ -258,6 +259,28 @@ Status FullDuplexThreaded(Network& net, int send_peer,
   return st.ok() ? send_st : st;
 }
 
+// Chunk-pipelined intra-node chain: the leader streams the payload down
+// leader -> leader+1 -> ... -> leader+L-1; downstream ranks start
+// forwarding while upstream bytes are still in flight.  Shared by the
+// hierarchical allreduce/allgather/Adasum fan-out phases.
+Status ChainFanout(Network& net, uint8_t* buf, int64_t nbytes, int rank,
+                   int leader, int local_size) {
+  const int pos = rank - leader;
+  const int64_t kChunk = 4 << 20;
+  for (int64_t off = 0; off < nbytes; off += kChunk) {
+    int64_t k = std::min(kChunk, nbytes - off);
+    if (pos > 0) {
+      Status st = RecvStream(net, rank - 1, buf + off, k);
+      if (!st.ok()) return st;
+    }
+    if (pos < local_size - 1) {
+      Status st = SendStream(net, rank + 1, buf + off, k);
+      if (!st.ok()) return st;
+    }
+  }
+  return Status::OK();
+}
+
 Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
                   size_t nsend, int recv_peer, uint8_t* recv_buf,
                   size_t nrecv,
@@ -451,47 +474,103 @@ Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
     if (!st.ok()) return st;
   }
 
-  // Phase 3: leaders broadcast the global result within their node.
-  const size_t nbytes = count * DataTypeSize(dtype);
-  if (local_size > 1) {
-    // Chain within the node: leader → leader+1 → ... → leader+L-1,
-    // chunk-pipelined (intra-node hops ride shm when available).
-    int pos = rank - leader;
-    uint8_t* bbuf = static_cast<uint8_t*>(vbuf);
-    const int64_t kChunk = 4 << 20;
-    for (int64_t off = 0; off < static_cast<int64_t>(nbytes);
-         off += kChunk) {
-      int64_t k = std::min(kChunk, static_cast<int64_t>(nbytes) - off);
-      if (pos > 0) {
-        st = RecvStream(net, rank - 1, bbuf + off, k);
-        if (!st.ok()) return st;
-      }
-      if (pos < local_size - 1) {
-        st = SendStream(net, rank + 1, bbuf + off, k);
-        if (!st.ok()) return st;
-      }
-    }
-  }
-  return Status::OK();
+  // Phase 3: leaders broadcast the global result within their node
+  // (intra-node hops ride shm when available).
+  return ChainFanout(net, static_cast<uint8_t*>(vbuf),
+                     count * DataTypeSize(dtype), rank, leader, local_size);
 }
 
-Status RingAllgatherv(Network& net, uint8_t* buf,
-                      const std::vector<int64_t>& bytes,
-                      const std::vector<int64_t>& offsets) {
-  const int size = net.size();
-  const int rank = net.rank();
-  if (size == 1) return Status::OK();
-  const int right = (rank + 1) % size;
-  const int left = (rank - 1 + size) % size;
-  for (int t = 0; t < size - 1; ++t) {
-    int send_b = ((rank - t) % size + size) % size;
-    int recv_b = ((rank - t - 1) % size + size) % size;
+namespace {
+// Schedule marker for tests/observability (0 flat ring, 1 hierarchical).
+std::atomic<int> g_allgather_schedule{0};
+
+// Ring allgatherv restricted to `members`; bytes/offsets are indexed by
+// member *position* (block i belongs to members[i]).
+Status RingAllgathervGroup(Network& net, uint8_t* buf,
+                           const std::vector<int64_t>& bytes,
+                           const std::vector<int64_t>& offsets,
+                           const std::vector<int>& members) {
+  const int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  int idx = -1;
+  for (int i = 0; i < m; ++i)
+    if (members[i] == net.rank()) idx = i;
+  if (idx < 0)
+    return Status::InvalidArgument("rank not in allgather group");
+  const int right = members[(idx + 1) % m];
+  const int left = members[(idx - 1 + m) % m];
+  for (int t = 0; t < m - 1; ++t) {
+    int send_b = ((idx - t) % m + m) % m;
+    int recv_b = ((idx - t - 1) % m + m) % m;
     Status st = FullDuplex(net, right, buf + offsets[send_b],
                            bytes[send_b], left, buf + offsets[recv_b],
                            bytes[recv_b]);
     if (!st.ok()) return st;
   }
   return Status::OK();
+}
+}  // namespace
+
+int LastAllgatherSchedule() { return g_allgather_schedule.load(); }
+
+Status RingAllgatherv(Network& net, uint8_t* buf,
+                      const std::vector<int64_t>& bytes,
+                      const std::vector<int64_t>& offsets) {
+  g_allgather_schedule.store(0);
+  std::vector<int> all(net.size());
+  for (int i = 0; i < net.size(); ++i) all[i] = i;
+  return RingAllgathervGroup(net, buf, bytes, offsets, all);
+}
+
+Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
+                              const std::vector<int64_t>& bytes,
+                              const std::vector<int64_t>& offsets,
+                              int local_size) {
+  const int size = net.size();
+  const int rank = net.rank();
+  if (local_size <= 1 || size % local_size != 0 || size == local_size)
+    return RingAllgatherv(net, buf, bytes, offsets);
+  g_allgather_schedule.store(1);
+  const int node = rank / local_size;
+  const int leader = node * local_size;
+  const int n_nodes = size / local_size;
+
+  // Phase 1: node members stage their block on the leader (intra-node
+  // hops — shm/CMA when available; the reference's shared-memory window,
+  // MEMCPY_IN_SHARED_BUFFER).  SendStream/RecvStream chunk internally.
+  if (rank == leader) {
+    for (int i = 1; i < local_size; ++i) {
+      int peer = leader + i;
+      Status st = RecvStream(net, peer, buf + offsets[peer], bytes[peer]);
+      if (!st.ok()) return st;
+    }
+  } else {
+    Status st = SendStream(net, leader, buf + offsets[rank], bytes[rank]);
+    if (!st.ok()) return st;
+  }
+
+  // Phase 2: leaders ring-allgatherv node-level blocks (rank order makes
+  // each node's member regions contiguous).
+  if (rank == leader) {
+    std::vector<int64_t> node_bytes(n_nodes), node_offs(n_nodes);
+    std::vector<int> leaders(n_nodes);
+    for (int b = 0; b < n_nodes; ++b) {
+      leaders[b] = b * local_size;
+      node_offs[b] = offsets[static_cast<size_t>(b) * local_size];
+      int64_t tot = 0;
+      for (int i = 0; i < local_size; ++i)
+        tot += bytes[static_cast<size_t>(b) * local_size + i];
+      node_bytes[b] = tot;
+    }
+    Status st = RingAllgathervGroup(net, buf, node_bytes, node_offs,
+                                    leaders);
+    if (!st.ok()) return st;
+  }
+
+  // Phase 3: fan the full result down the intra-node chain.
+  int64_t total = 0;
+  for (auto b : bytes) total += b;
+  return ChainFanout(net, buf, total, rank, leader, local_size);
 }
 
 Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
@@ -582,35 +661,272 @@ void AdasumTree(std::vector<std::vector<uint8_t>>& bufs, int64_t n) {
   if (live[0] != 0) bufs[0] = bufs[live[0]];
 }
 
-}  // namespace
+// Scratch-memory instrumentation for the VHDD path (tested: the schedule
+// must stay O(|t|), unlike the old gather+tree's O(P*|t|)).
+std::atomic<int64_t> g_adasum_scratch_peak{0};
 
-Status AdasumAllreduce(Network& net, void* vbuf, int64_t count,
-                       DataType dtype) {
+int BitRev(int i, int bits) {
+  int r = 0;
+  for (int b = 0; b < bits; ++b) r = (r << 1) | ((i >> b) & 1);
+  return r;
+}
+
+// Vector-halving distance-doubling Adasum on a typed working buffer
+// (reference chunked pairwise VHDD, adasum.h:168-395 / adasum_mpi.cc:
+// 107-110; same schedule as the compiled ladder in ops/adasum.py).
+// O(|t|) scratch; members.size() must be a power of two.  Chunked wire
+// transfers are inherited from SendStream/RecvStream/FullDuplex (4 MB
+// chunks / shm slots).  `members` lets hierarchical schedules run the
+// ladder over node leaders only (reference adasum_gpu_operations.cc).
+template <typename T>
+Status AdasumVHDDImpl(Network& net, T* data, int64_t count,
+                      const std::vector<int>& members) {
+  const int P = static_cast<int>(members.size());
+  int rank = -1;  // index within the group
+  for (int i = 0; i < P; ++i)
+    if (members[i] == net.rank()) rank = i;
+  if (rank < 0)
+    return Status::InvalidArgument("rank not in adasum group");
+  const int levels = __builtin_ctz(P);
+  const int64_t L = ((count + P - 1) / P) * P;
+  int64_t scratch = 0;
+  auto track = [&](int64_t bytes) {
+    scratch += bytes;
+    int64_t prev = g_adasum_scratch_peak.load();
+    while (scratch > prev &&
+           !g_adasum_scratch_peak.compare_exchange_weak(prev, scratch)) {
+    }
+  };
+
+  std::vector<T> x(L, T(0));
+  track(L * sizeof(T));
+  memcpy(x.data(), data, count * sizeof(T));
+  std::vector<T> recv(L / 2);
+  track((L / 2) * sizeof(T));
+
+  int64_t cur = L;
+  for (int level = 0; level < levels; ++level) {
+    const int d = 1 << level;
+    const int partner = members[rank ^ d];
+    const int64_t half = cur / 2;
+    const int bit = (rank >> level) & 1;
+    T* lower = x.data();
+    T* upper = x.data() + half;
+    T* keep = bit == 0 ? lower : upper;
+    T* send = bit == 0 ? upper : lower;
+    Status st = FullDuplex(
+        net, partner, reinterpret_cast<const uint8_t*>(send),
+        half * sizeof(T), partner, reinterpret_cast<uint8_t*>(recv.data()),
+        half * sizeof(T));
+    if (!st.ok()) return st;
+    // Role assignment matches ops/adasum.py: "a" is the lower (bit==0)
+    // block's logical vector, "b" the upper's, so the group-summed
+    // partials are the true full-vector dot and norms.
+    const T* a = bit == 0 ? keep : recv.data();
+    const T* b = bit == 0 ? recv.data() : keep;
+    double partials[3] = {0.0, 0.0, 0.0};  // dot, ||a||^2, ||b||^2
+    for (int64_t i = 0; i < half; ++i) {
+      const double av = static_cast<double>(a[i]);
+      const double bv = static_cast<double>(b[i]);
+      partials[0] += av * bv;
+      partials[1] += av * av;
+      partials[2] += bv * bv;
+    }
+    // Sum the 24-byte partials over the 2d-member group by recursive
+    // doubling: log2(2d) pairwise exchanges instead of a 2*(2d-1)-step
+    // ring — the scalar reduction is latency-bound, especially on the
+    // cross-node (DCN-analog) levels.  Commutative fp addition makes the
+    // per-rank results bitwise identical.
+    const int group = 2 * d;
+    const int base = (rank / group) * group;
+    for (int h = 1; h < group; h <<= 1) {
+      const int peer = members[base + ((rank - base) ^ h)];
+      double incoming[3];
+      Status gst = FullDuplex(
+          net, peer, reinterpret_cast<const uint8_t*>(partials),
+          sizeof(partials), peer, reinterpret_cast<uint8_t*>(incoming),
+          sizeof(incoming));
+      if (!gst.ok()) return gst;
+      partials[0] += incoming[0];
+      partials[1] += incoming[1];
+      partials[2] += incoming[2];
+    }
+    const double dot = partials[0], na = partials[1], nb = partials[2];
+    const double ac = na > 0 ? 1.0 - dot / (2.0 * na) : 1.0;
+    const double bc = nb > 0 ? 1.0 - dot / (2.0 * nb) : 1.0;
+    T* dst = x.data();
+    for (int64_t i = 0; i < half; ++i)
+      dst[i] = static_cast<T>(ac * static_cast<double>(a[i]) +
+                              bc * static_cast<double>(b[i]));
+    cur = half;
+  }
+
+  // Each rank holds fragment bit_reverse(rank); the reordering happens in
+  // the allgather's offset table (no post-pass).
+  const int64_t frag = L / P;
+  std::vector<T> mine(x.begin(), x.begin() + frag);
+  track(frag * sizeof(T));
+  x.clear();
+  x.shrink_to_fit();
+  track(-L * static_cast<int64_t>(sizeof(T)));
+  recv.clear();
+  recv.shrink_to_fit();
+  track(-(L / 2) * static_cast<int64_t>(sizeof(T)));
+
+  std::vector<T> full(L);
+  track(L * sizeof(T));
+  std::vector<int64_t> bytes(P, frag * sizeof(T)), offs(P);
+  for (int r = 0; r < P; ++r)
+    offs[r] = static_cast<int64_t>(BitRev(r, levels)) * frag * sizeof(T);
+  memcpy(reinterpret_cast<uint8_t*>(full.data()) + offs[rank], mine.data(),
+         frag * sizeof(T));
+  Status st = RingAllgathervGroup(
+      net, reinterpret_cast<uint8_t*>(full.data()), bytes, offs, members);
+  if (!st.ok()) return st;
+  memcpy(data, full.data(), count * sizeof(T));
+  return Status::OK();
+}
+
+// Non-power-of-two fallback: gather + coefficient tree (exact, O(P*|t|)
+// scratch — the reference restricts Adasum to power-of-two worlds,
+// tensorflow/__init__.py:146-147).
+template <typename T>
+Status AdasumGatherTree(Network& net, T* data, int64_t count) {
   const int size = net.size();
-  if (size == 1 || count == 0) return Status::OK();
-  if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64)
-    return Status::InvalidArgument(
-        "eager Adasum supports float32/float64");
-  const size_t elem = DataTypeSize(dtype);
-  const size_t nbytes = count * elem;
-  // Gather all contributions (simple but exact; VHDD schedule is a later
-  // optimization — the compiled path handles large tensors).
+  const size_t nbytes = count * sizeof(T);
   std::vector<std::vector<uint8_t>> bufs(size);
   std::vector<int64_t> bytes(size, nbytes), offsets(size);
   std::vector<uint8_t> gathered(nbytes * size);
   for (int i = 0; i < size; ++i) offsets[i] = i * nbytes;
-  memcpy(gathered.data() + net.rank() * nbytes, vbuf, nbytes);
+  memcpy(gathered.data() + net.rank() * nbytes, data, nbytes);
   Status st = RingAllgatherv(net, gathered.data(), bytes, offsets);
   if (!st.ok()) return st;
   for (int i = 0; i < size; ++i)
     bufs[i].assign(gathered.begin() + i * nbytes,
                    gathered.begin() + (i + 1) * nbytes);
-  if (dtype == DataType::FLOAT32)
-    AdasumTree<float>(bufs, count);
-  else
-    AdasumTree<double>(bufs, count);
-  memcpy(vbuf, bufs[0].data(), nbytes);
+  AdasumTree<T>(bufs, count);
+  memcpy(data, bufs[0].data(), nbytes);
   return Status::OK();
+}
+
+template <typename T>
+Status AdasumTyped(Network& net, T* data, int64_t count) {
+  const int P = net.size();
+  if (P & (P - 1)) return AdasumGatherTree<T>(net, data, count);
+  std::vector<int> all(P);
+  for (int i = 0; i < P; ++i) all[i] = i;
+  return AdasumVHDDImpl<T>(net, data, count, all);
+}
+
+// Run `fn(float* work)` on an fp32 copy of a 16-bit buffer, writing the
+// result back in the wire dtype (fp32 accumulation for fp16/bf16 — the
+// reference's fp16 Adasum kernel policy).
+template <typename Fn>
+Status With16BitAsFloat(void* vbuf, int64_t count, DataType dtype, Fn fn) {
+  std::vector<float> work(count);
+  uint16_t* raw = static_cast<uint16_t*>(vbuf);
+  if (dtype == DataType::FLOAT16) {
+    for (int64_t i = 0; i < count; ++i) work[i] = HalfToFloat(raw[i]);
+  } else {
+    for (int64_t i = 0; i < count; ++i) work[i] = Bf16ToFloat(raw[i]);
+  }
+  Status st = fn(work.data());
+  if (!st.ok()) return st;
+  if (dtype == DataType::FLOAT16) {
+    for (int64_t i = 0; i < count; ++i) raw[i] = FloatToHalf(work[i]);
+  } else {
+    for (int64_t i = 0; i < count; ++i) raw[i] = FloatToBf16(work[i]);
+  }
+  return Status::OK();
+}
+
+// Typed Adasum over a rank subgroup (node leaders) with the same 16-bit
+// conversion policy as the public entry point.
+Status AdasumGroup(Network& net, void* vbuf, int64_t count, DataType dtype,
+                   const std::vector<int>& members) {
+  switch (dtype) {
+    case DataType::FLOAT64:
+      return AdasumVHDDImpl<double>(net, static_cast<double*>(vbuf), count,
+                                    members);
+    case DataType::FLOAT32:
+      return AdasumVHDDImpl<float>(net, static_cast<float*>(vbuf), count,
+                                   members);
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return With16BitAsFloat(vbuf, count, dtype, [&](float* w) {
+        return AdasumVHDDImpl<float>(net, w, count, members);
+      });
+    default:
+      return Status::InvalidArgument(
+          "eager Adasum supports float16/bfloat16/float32/float64");
+  }
+}
+
+}  // namespace
+
+int64_t AdasumScratchPeak() { return g_adasum_scratch_peak.load(); }
+void ResetAdasumScratchPeak() { g_adasum_scratch_peak.store(0); }
+
+Status AdasumAllreduce(Network& net, void* vbuf, int64_t count,
+                       DataType dtype) {
+  const int size = net.size();
+  if (size == 1 || count == 0) return Status::OK();
+  switch (dtype) {
+    case DataType::FLOAT64:
+      return AdasumTyped<double>(net, static_cast<double*>(vbuf), count);
+    case DataType::FLOAT32:
+      return AdasumTyped<float>(net, static_cast<float*>(vbuf), count);
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      // fp32 accumulation for 16-bit wires (reference fp16 Adasum kernels,
+      // adasum.h AVX/F16C specializations — portable here).
+      return With16BitAsFloat(vbuf, count, dtype, [&](float* w) {
+        return AdasumTyped<float>(net, w, count);
+      });
+    default:
+      return Status::InvalidArgument(
+          "eager Adasum supports float16/bfloat16/float32/float64");
+  }
+}
+
+Status HierarchicalAdasum(Network& net, void* vbuf, int64_t count,
+                          DataType dtype, int local_size) {
+  // Reference AdasumGpuAllreduceOp (adasum_gpu_operations.cc:38-…):
+  // intra-node reduction, cross-node VHDD between node leaders, intra-node
+  // fan-out, with local averaging folded in (operations.cc:968-975; the
+  // Adasum coefficients are scale-invariant, so Adasum(node sums)/L ==
+  // Adasum(node means)).
+  const int size = net.size();
+  const int rank = net.rank();
+  const int n_nodes = local_size > 0 ? size / local_size : 0;
+  if (local_size <= 1 || size % local_size != 0 || size == local_size ||
+      (n_nodes & (n_nodes - 1)) != 0)
+    return AdasumAllreduce(net, vbuf, count, dtype);
+  if (count == 0) return Status::OK();
+  const int node = rank / local_size;
+  const int leader = node * local_size;
+
+  // Phase 1: intra-node sum (short hops — ICI analog).
+  std::vector<int> local_members(local_size);
+  for (int i = 0; i < local_size; ++i) local_members[i] = leader + i;
+  Status st = RingAllreduceGroup(net, vbuf, count, dtype, ReduceOp::SUM,
+                                 local_members);
+  if (!st.ok()) return st;
+
+  // Phase 2: node leaders combine node sums with the VHDD ladder
+  // (long hops — DCN analog), then fold in the local average.
+  if (rank == leader) {
+    std::vector<int> leaders(n_nodes);
+    for (int i = 0; i < n_nodes; ++i) leaders[i] = i * local_size;
+    st = AdasumGroup(net, vbuf, count, dtype, leaders);
+    if (!st.ok()) return st;
+    ScaleBuffer(vbuf, count, dtype, 1.0 / local_size);
+  }
+
+  // Phase 3: leaders fan the result down the intra-node chain
+  // (same pipelined schedule as HierarchicalAllreduce phase 3).
+  return ChainFanout(net, static_cast<uint8_t*>(vbuf),
+                     count * DataTypeSize(dtype), rank, leader, local_size);
 }
 
 }  // namespace hvdtpu

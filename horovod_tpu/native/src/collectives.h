@@ -42,6 +42,22 @@ Status RingAllgatherv(Network& net, uint8_t* buf,
                       const std::vector<int64_t>& bytes,
                       const std::vector<int64_t>& offsets);
 
+// Hierarchical allgather (reference MPIHierarchicalAllgather,
+// mpi_operations.cc:186-341: node-leader gather staged through shared
+// memory, cross-node exchange, intra-node fan-out): phase 1 gathers node
+// members' blocks to the node leader over intra-node hops (shm/CMA when
+// available), phase 2 ring-allgathervs node-level blocks across leaders,
+// phase 3 fans the full result down the intra-node chain, chunk-pipelined.
+// Falls back to the flat ring when the topology doesn't divide evenly.
+Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
+                              const std::vector<int64_t>& bytes,
+                              const std::vector<int64_t>& offsets,
+                              int local_size);
+
+// Test/observability hook: schedule used by the most recent allgather on
+// this process (0 = flat ring, 1 = hierarchical).
+int LastAllgatherSchedule();
+
 // In-place broadcast of buf from root (chain schedule).
 Status ChainBroadcast(Network& net, void* buf, int64_t nbytes, int root);
 
@@ -52,11 +68,25 @@ Status PairwiseAlltoallv(Network& net, const uint8_t* send,
                          uint8_t* recv,
                          const std::vector<int64_t>& recv_bytes);
 
-// Adasum allreduce: allgather all contributions, reduce with the adaptive
-// coefficient binary tree (same numerics as ops/adasum.py / reference
-// adasum.h:385-395). Float dtypes only.
+// Adasum allreduce: chunked pairwise vector-halving distance-doubling with
+// grouped scalar reductions for the adaptive coefficients (reference
+// adasum.h:168-395, adasum_mpi.cc:107-110; same numerics as ops/adasum.py).
+// O(|t|) scratch on power-of-two worlds; gather + coefficient tree fallback
+// otherwise.  fp16/bf16 accepted with fp32 accumulation.
 Status AdasumAllreduce(Network& net, void* buf, int64_t count,
                        DataType dtype);
+
+// Hierarchical Adasum (reference adasum_gpu_operations.cc:38-…): intra-node
+// sum, cross-node VHDD between node leaders, local-average fold-in,
+// intra-node fan-out.  Falls back to flat Adasum when the topology doesn't
+// divide evenly or the node count is not a power of two.
+Status HierarchicalAdasum(Network& net, void* buf, int64_t count,
+                          DataType dtype, int local_size);
+
+// Test/observability hooks: peak scratch bytes allocated by the VHDD path
+// since the last reset (proves the O(|t|) memory bound).
+int64_t AdasumScratchPeak();
+void ResetAdasumScratchPeak();
 
 // Elementwise scale in place (used for prescale/postscale/average).
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);

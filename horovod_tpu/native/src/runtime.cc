@@ -471,7 +471,10 @@ void Runtime::ExecuteAllreduce(
   timeline_.Record(resp.names[0], "B", "RING_ALLREDUCE");
   Status st;
   if (resp.op == ReduceOp::ADASUM) {
-    st = AdasumAllreduce(*net_, fb, total_elems, resp.dtype);
+    st = (hierarchical_allreduce_ && local_size_ > 1)
+             ? HierarchicalAdasum(*net_, fb, total_elems, resp.dtype,
+                                  local_size_)
+             : AdasumAllreduce(*net_, fb, total_elems, resp.dtype);
   } else if (hierarchical_allreduce_ && local_size_ > 1) {
     st = HierarchicalAllreduce(*net_, fb, total_elems, resp.dtype, resp.op,
                                local_size_);
@@ -522,7 +525,10 @@ void Runtime::ExecuteAllgather(const Response& resp,
   if (entry && entry->input)
     memcpy(out->data() + offsets[rank], entry->input, bytes[rank]);
   if (entry) timeline_.Record(entry->name, "B", "RING_ALLGATHER");
-  Status st = RingAllgatherv(*net_, out->data(), bytes, offsets);
+  Status st = (hierarchical_allgather_ && local_size_ > 1)
+                  ? HierarchicalAllgatherv(*net_, out->data(), bytes,
+                                           offsets, local_size_)
+                  : RingAllgatherv(*net_, out->data(), bytes, offsets);
   if (entry) {
     timeline_.Record(entry->name, "E", "RING_ALLGATHER");
     entry->var_output = out;
@@ -613,9 +619,11 @@ Status Runtime::BarrierBlocking() {
   return Status::OK();
 }
 
-void Runtime::SetTopology(int local_size, bool hierarchical_allreduce) {
+void Runtime::SetTopology(int local_size, bool hierarchical_allreduce,
+                          bool hierarchical_allgather) {
   local_size_ = local_size;
   hierarchical_allreduce_ = hierarchical_allreduce;
+  hierarchical_allgather_ = hierarchical_allgather;
 }
 
 void Runtime::SetParams(int64_t fusion_threshold, double cycle_time_ms) {

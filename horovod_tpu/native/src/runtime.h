@@ -75,7 +75,8 @@ class Runtime {
   void ReadCounters(int64_t* bytes, double* seconds);
   // Node topology for hierarchical collectives (ranks grouped into nodes
   // of local_size consecutive ranks; ICI-intra / DCN-inter analog).
-  void SetTopology(int local_size, bool hierarchical_allreduce);
+  void SetTopology(int local_size, bool hierarchical_allreduce,
+                   bool hierarchical_allgather);
   void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();
@@ -143,6 +144,7 @@ class Runtime {
   std::atomic<int64_t> bytes_processed_{0};
   int local_size_ = 1;
   bool hierarchical_allreduce_ = false;
+  bool hierarchical_allgather_ = false;
   std::atomic<DeviceExecutorFn> device_executor_{nullptr};
   std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;

@@ -67,7 +67,8 @@ def _bit_reverse(i: int, bits: int) -> int:
     return r
 
 
-def adasum_allreduce(tensor: jax.Array, axis_name: str) -> jax.Array:
+def adasum_allreduce(tensor: jax.Array, axis_name: str,
+                     shard_axis: str | None = None) -> jax.Array:
     """Compiled-path Adasum over a named mesh axis: vector-halving
     distance-doubling ladder (the reference's VHDD schedule,
     adasum.h:168-395) built from ``ppermute`` half-exchanges + grouped
@@ -86,11 +87,24 @@ def adasum_allreduce(tensor: jax.Array, axis_name: str) -> jax.Array:
     (O(P*|tensor|), which OOMs at pod-slice scale).  Non-power-of-two axes
     fall back to the gather+tree path (the reference restricts Adasum to
     power-of-two worlds, tensorflow/__init__.py:146-147).
+
+    ``shard_axis``: for hierarchical schedules, the mesh axis over which
+    each logical vector is *already sharded* (each member of that axis
+    holds a distinct fragment).  The coefficient partials are then summed
+    over the shard axis too, so the combine uses true full-vector dot/norm
+    values (the reference's start-level trick in
+    adasum_gpu_operations.cc); the tree fallback cannot do this, so
+    shard_axis requires a power-of-two ``axis_name``.
     """
     P = lax.axis_size(axis_name)
     if P == 1:
         return tensor
     if P & (P - 1):
+        if shard_axis is not None:
+            raise ValueError(
+                "adasum_allreduce(shard_axis=...) requires a power-of-two "
+                "cross axis (the tree fallback computes per-shard "
+                "coefficients, which would be wrong)")
         return adasum_tree(lax.all_gather(tensor, axis_name))
     levels = P.bit_length() - 1
     idx = lax.axis_index(axis_name)
@@ -118,6 +132,11 @@ def adasum_allreduce(tensor: jax.Array, axis_name: str) -> jax.Array:
         partials = jnp.stack([jnp.vdot(a_seg, b_seg),
                               jnp.vdot(a_seg, a_seg),
                               jnp.vdot(b_seg, b_seg)])
+        if shard_axis is not None:
+            # Fragments of the logical vectors also live across the shard
+            # axis: fold those partials in first so dot/na/nb are the
+            # full-vector values.
+            partials = lax.psum(partials, shard_axis)
         group = 2 * d
         groups = [[g * group + j for j in range(group)]
                   for g in range(P // group)]
@@ -139,3 +158,41 @@ def adasum_allreduce(tensor: jax.Array, axis_name: str) -> jax.Array:
     if pad:
         full = full[:n]
     return full.reshape(shape).astype(dtype)
+
+
+def adasum_allreduce_hierarchical(tensor: jax.Array, local_axis: str,
+                                  cross_axis: str) -> jax.Array:
+    """Hierarchical Adasum over a 2-axis mesh (reference
+    adasum_gpu_operations.cc:38-…): intra-``local_axis`` reduce-scatter
+    (sum — the ICI-cheap phase), cross-``cross_axis`` VHDD on the shards
+    with full-vector coefficients (partials folded over the shard axis),
+    intra-axis all-gather, and the local average folded in (reference
+    operations.cc:968-975; Adasum coefficients are scale-invariant, so
+    Adasum(node sums)/L == Adasum(node means)).
+
+    Numerics: equals ``adasum_tree`` over the per-node means — asserted
+    against that oracle on a 2x4 virtual mesh in tests/test_collectives.py.
+    """
+    L = lax.axis_size(local_axis)
+    crossP = lax.axis_size(cross_axis)
+    if L == 1:
+        return adasum_allreduce(tensor, cross_axis)
+    if crossP == 1:
+        return lax.pmean(tensor, local_axis)
+    if crossP & (crossP - 1):
+        # Tree fallback needs whole vectors: combine node means directly.
+        node_mean = lax.pmean(tensor, local_axis)
+        return adasum_tree(
+            lax.all_gather(node_mean, cross_axis)).astype(tensor.dtype)
+    shape, dtype = tensor.shape, tensor.dtype
+    x = tensor.astype(jnp.float32).reshape(-1)
+    n = x.shape[0]
+    pad = (-n) % L
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+    shard = adasum_allreduce(shard, cross_axis, shard_axis=local_axis)
+    full = lax.all_gather(shard, local_axis, tiled=True)
+    if pad:
+        full = full[:n]
+    return (full / L).reshape(shape).astype(dtype)

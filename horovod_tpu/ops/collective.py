@@ -110,7 +110,15 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
     elif op == Product:
         out = jnp.prod(lax.all_gather(tensor, axis_name), axis=0)
     elif op == Adasum:
-        out = adasum_allreduce(tensor, axis_name)
+        if isinstance(axis_name, (tuple, list)) and len(axis_name) == 2:
+            # Hierarchical Adasum over (local, cross) mesh axes
+            # (reference adasum_gpu_operations.cc:38-…): intra-axis
+            # reduce-scatter, cross-axis VHDD, intra-axis all-gather.
+            from .adasum import adasum_allreduce_hierarchical
+            out = adasum_allreduce_hierarchical(tensor, axis_name[0],
+                                                axis_name[1])
+        else:
+            out = adasum_allreduce(tensor, axis_name)
     else:
         raise ValueError(f"unknown reduce op {op}")
     if postscale_factor != 1.0:

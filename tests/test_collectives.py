@@ -214,6 +214,54 @@ def test_adasum_vhdd_ladder_matches_tree():
                                    atol=1e-5)
 
 
+def test_adasum_hierarchical_2x4_matches_node_mean_oracle():
+    """Hierarchical Adasum on a 2 (cross) x 4 (local) mesh: intra-axis
+    psum_scatter → cross-axis VHDD with full-vector coefficients →
+    intra-axis all-gather (reference adasum_gpu_operations.cc:38-…).
+    Numerics oracle: Adasum coefficients are scale-invariant, so the
+    result equals the coefficient tree over per-node *means*."""
+    from horovod_tpu.ops.adasum import adasum_tree
+    devices = jax.devices()[:8]
+    mesh = jax.sharding.Mesh(np.array(devices).reshape(2, 4),
+                             ("cross", "local"))
+    rng = np.random.RandomState(3)
+    # 21 elements: not divisible by local=4 — exercises both pad paths.
+    stack = rng.randn(8, 21).astype(np.float32)
+    x = jnp.asarray(stack)
+
+    out = jax.jit(shard_map(
+        lambda t: hvd.allreduce(t, op=hvd.Adasum,
+                                axis_name=("local", "cross")),
+        mesh=mesh, in_specs=P(("cross", "local")),
+        out_specs=P(("cross", "local")), check_vma=False))(x)
+
+    node_means = np.stack([stack[:4].mean(0), stack[4:].mean(0)])
+    expected = np.asarray(adasum_tree(jnp.asarray(node_means)))
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_hierarchical_degenerate_axes():
+    """local=1 degrades to flat cross-axis Adasum; cross=1 to the local
+    mean."""
+    from horovod_tpu.ops.adasum import adasum_tree
+    devices = jax.devices()[:8]
+    mesh = jax.sharding.Mesh(np.array(devices).reshape(8, 1),
+                             ("cross", "local"))
+    rng = np.random.RandomState(5)
+    stack = rng.randn(8, 12).astype(np.float32)
+    out = jax.jit(shard_map(
+        lambda t: hvd.allreduce(t, op=hvd.Adasum,
+                                axis_name=("local", "cross")),
+        mesh=mesh, in_specs=P(("cross", "local")),
+        out_specs=P(("cross", "local")), check_vma=False))(
+            jnp.asarray(stack))
+    expected = np.asarray(adasum_tree(jnp.asarray(stack)))
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_adasum_vhdd_bf16_input():
     """bf16 inputs accumulate in fp32 through the ladder."""
     mesh = _mesh()
