@@ -516,7 +516,9 @@ int LastAllgatherSchedule() { return g_allgather_schedule.load(); }
 Status RingAllgatherv(Network& net, uint8_t* buf,
                       const std::vector<int64_t>& bytes,
                       const std::vector<int64_t>& offsets) {
-  g_allgather_schedule.store(0);
+  // No schedule-marker store here: internal users (Adasum gather+tree,
+  // VHDD reassembly) must not clobber the user-level allgather hook —
+  // HierarchicalAllgatherv is the marker-setting entry point.
   std::vector<int> all(net.size());
   for (int i = 0; i < net.size(); ++i) all[i] = i;
   return RingAllgathervGroup(net, buf, bytes, offsets, all);
@@ -528,8 +530,10 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
                               int local_size) {
   const int size = net.size();
   const int rank = net.rank();
-  if (local_size <= 1 || size % local_size != 0 || size == local_size)
+  if (local_size <= 1 || size % local_size != 0 || size == local_size) {
+    g_allgather_schedule.store(0);
     return RingAllgatherv(net, buf, bytes, offsets);
+  }
   g_allgather_schedule.store(1);
   const int node = rank / local_size;
   const int leader = node * local_size;
@@ -896,6 +900,14 @@ Status HierarchicalAdasum(Network& net, void* vbuf, int64_t count,
   // fan-out, with local averaging folded in (operations.cc:968-975; the
   // Adasum coefficients are scale-invariant, so Adasum(node sums)/L ==
   // Adasum(node means)).
+  // Validate dtype BEFORE phase 1: the intra-node sum would succeed on
+  // every rank while phase-2 AdasumGroup failed on leaders only, leaving
+  // non-leaders stalled in the fan-out — all ranks must fail
+  // symmetrically, like the flat path does.
+  if (dtype != DataType::FLOAT16 && dtype != DataType::BFLOAT16 &&
+      dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64)
+    return Status::InvalidArgument(
+        "eager Adasum supports float16/bfloat16/float32/float64");
   const int size = net.size();
   const int rank = net.rank();
   const int n_nodes = local_size > 0 ? size / local_size : 0;

@@ -525,10 +525,11 @@ void Runtime::ExecuteAllgather(const Response& resp,
   if (entry && entry->input)
     memcpy(out->data() + offsets[rank], entry->input, bytes[rank]);
   if (entry) timeline_.Record(entry->name, "B", "RING_ALLGATHER");
-  Status st = (hierarchical_allgather_ && local_size_ > 1)
-                  ? HierarchicalAllgatherv(*net_, out->data(), bytes,
-                                           offsets, local_size_)
-                  : RingAllgatherv(*net_, out->data(), bytes, offsets);
+  // Always route through HierarchicalAllgatherv: it owns the schedule
+  // marker and degrades to the flat ring itself when local_size == 1.
+  Status st = HierarchicalAllgatherv(
+      *net_, out->data(), bytes, offsets,
+      (hierarchical_allgather_ && local_size_ > 1) ? local_size_ : 1);
   if (entry) {
     timeline_.Record(entry->name, "E", "RING_ALLGATHER");
     entry->var_output = out;

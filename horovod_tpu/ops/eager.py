@@ -15,6 +15,16 @@ TPU there are three eager regimes, dispatched here in priority order:
    and read back replicated.
 3. **Single process**: the communicator has one member; ops are identities
    (sum over one contribution), matching Horovod semantics for size()==1.
+
+Ordering contract: regime 2 (no controller) is *SPMD end to end* — every
+process must issue the same eager collectives in the same order (both the
+device plane and the host-numpy path lower to the same jitted mesh
+collectives).  Divergent per-process op order deadlocks inside the XLA
+collective with no stall warning; there is no cheap detection point because
+the divergence happens inside compiled code.  When dynamic per-rank op
+order is needed, run under the launcher: regime 1's controller negotiates
+names (host tensors over TCP, HBM tensors via the negotiated device plane),
+and its stall inspector covers the negotiation plane.
 """
 
 from __future__ import annotations
@@ -319,17 +329,25 @@ def _flatten01(a):
 def _device_allgather(tensor, ctl):
     """Device-plane allgather for equal per-rank dim-0 (the SPMD common
     case): the payload never leaves HBM.  Unequal dims return None — the
-    host plane does the pad/displacement dance."""
+    host plane does the pad/displacement dance.
+
+    The defensive per-call sizes exchange costs one extra (tiny) device
+    collective; SPMD training code whose gather shapes are equal by
+    construction can skip it with ``HVD_TPU_EAGER_EQUAL_ALLGATHER=1``
+    (ragged inputs under that knob produce a shape error or wrong rows,
+    not silent corruption of other tensors)."""
     if getattr(tensor, "ndim", 0) < 1:
         return None
+    import os
     import jax.numpy as jnp
-    rows = int(tensor.shape[0])
-    sizes = _device_allreduce(
-        jnp.asarray(_one_hot_sizes(rows)), _sum0, ctl)
-    if sizes is None:
-        return None
-    if not bool((np.asarray(sizes) == rows).all()):
-        return None  # ragged: host plane
+    if os.environ.get("HVD_TPU_EAGER_EQUAL_ALLGATHER", "0") != "1":
+        rows = int(tensor.shape[0])
+        sizes = _device_allreduce(
+            jnp.asarray(_one_hot_sizes(rows)), _sum0, ctl)
+        if sizes is None:
+            return None
+        if not bool((np.asarray(sizes) == rows).all()):
+            return None  # ragged: host plane
     return _device_allreduce(tensor, _flatten01, ctl)
 
 

@@ -23,9 +23,24 @@ from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments, slot_env
 
 @dataclass
 class PlacementPlan:
-    """num_workers actors → bundle list, one bundle per host group."""
+    """num_workers actors → bundle list, one bundle per host group.
+
+    ``workers_per_bundle[i]`` says how many actors bundle i hosts; actors
+    are pinned to their bundle by index (reference strategy.py colocators
+    schedule workers into specific bundles the same way)."""
     bundles: List[Dict[str, float]]
     strategy: str  # "PACK" | "SPREAD" | "STRICT_PACK" | "STRICT_SPREAD"
+    workers_per_bundle: List[int]
+    cpus_per_worker: float = 1.0
+    gpus_per_worker: float = 0.0
+
+    def bundle_index(self, worker: int) -> int:
+        b, seen = 0, 0
+        for b, k in enumerate(self.workers_per_bundle):
+            if worker < seen + k:
+                return b
+            seen += k
+        return b
 
 
 def plan_placement(num_workers: int, cpus_per_worker: float = 1.0,
@@ -38,16 +53,25 @@ def plan_placement(num_workers: int, cpus_per_worker: float = 1.0,
         resources["GPU"] = gpus_per_worker or 1.0
     if workers_per_host:
         n_hosts = (num_workers + workers_per_host - 1) // workers_per_host
-        bundles = []
+        bundles, per_bundle = [], []
         remaining = num_workers
         for _ in range(n_hosts):
             k = min(workers_per_host, remaining)
             bundles.append({r: v * k for r, v in resources.items()})
+            per_bundle.append(k)
             remaining -= k
         return PlacementPlan(bundles=bundles, strategy="STRICT_PACK"
-                             if n_hosts == 1 else "PACK")
+                             if n_hosts == 1 else "PACK",
+                             workers_per_bundle=per_bundle,
+                             cpus_per_worker=cpus_per_worker,
+                             gpus_per_worker=gpus_per_worker if use_gpu
+                             else 0.0)
     return PlacementPlan(bundles=[dict(resources)] * num_workers,
-                         strategy="SPREAD")
+                         strategy="SPREAD",
+                         workers_per_bundle=[1] * num_workers,
+                         cpus_per_worker=cpus_per_worker,
+                         gpus_per_worker=gpus_per_worker if use_gpu
+                         else 0.0)
 
 
 def assign_ranks(hostnames: List[str]) -> List[SlotInfo]:
@@ -95,10 +119,28 @@ class RayExecutor:
         pg = ray.util.placement_group(self.plan.bundles,
                                       strategy=self.plan.strategy)
         ray.get(pg.ready())
-        self._workers = [
-            _Worker.options(placement_group=pg).remote()
-            for _ in range(self.num_workers)
-        ]
+        self._pg = pg
+        # Pin each actor to its bundle (reference strategy.py colocators):
+        # without the index, Ray may place all actors in one bundle and
+        # the PACK/SPREAD intent is lost.
+        self._workers = []
+        for i in range(self.num_workers):
+            bundle = self.plan.bundle_index(i)
+            try:
+                from ray.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy)
+                opts = {"scheduling_strategy":
+                        PlacementGroupSchedulingStrategy(
+                            placement_group=pg,
+                            placement_group_bundle_index=bundle)}
+            except ImportError:  # older ray: legacy options
+                opts = {"placement_group": pg,
+                        "placement_group_bundle_index": bundle}
+            if self.plan.gpus_per_worker:
+                opts["num_gpus"] = self.plan.gpus_per_worker
+            self._workers.append(
+                _Worker.options(num_cpus=self.plan.cpus_per_worker,
+                                **opts).remote())
         self._hostnames = ray.get(
             [w.hostname.remote() for w in self._workers])
 
@@ -119,6 +161,13 @@ class RayExecutor:
         for w in self._workers:
             ray.kill(w)
         self._workers = []
+        pg = getattr(self, "_pg", None)
+        if pg is not None:
+            try:
+                ray.util.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — cluster may be going down
+                pass
+            self._pg = None
 
 
 class RayHostDiscovery:

@@ -114,9 +114,12 @@ class ElasticDriver:
         self._blacklist: Set[str] = set()
         self._current_hosts: List[HostInfo] = []
         self._workers: Dict[str, exec_mod.WorkerProcess] = {}  # slot_id →
-        # Slots the driver itself terminated on scale-down: their exits
-        # are expected, not failures.
-        self._expected_exits: Set[str] = set()
+        # Slots the driver itself terminated on scale-down, keyed by the
+        # spawn generation of the terminated worker: the marker matches
+        # exactly one process's exit, so a replacement's real failure can
+        # never be misread as an expected scale-down exit (and a stale
+        # exit can never consume the replacement's marker).
+        self._expected_exits: Dict[str, int] = {}
         # Spawn generation per slot: exit events carry the generation they
         # belong to, so a stale callback from a superseded process can
         # never untrack or fail its replacement.
@@ -195,13 +198,26 @@ class ElasticDriver:
     def _slot_id(self, s: SlotInfo) -> str:
         return f"{s.hostname}:{s.local_rank}"
 
+    def _controller_port(self, hostname: str) -> int:
+        """A fresh controller port for this round.  The rank-0 worker binds
+        it on ``hostname``; when that is this machine, probe a genuinely
+        free port (two concurrent elastic jobs on one host must not
+        collide — the old ``base_port + round`` scheme did).  For a remote
+        rank-0 host a local probe proves nothing, so fall back to the
+        configured base plus a round offset; a bind failure there surfaces
+        as a worker failure and the next round picks a different port."""
+        if exec_mod._is_local(hostname):
+            from .chips import _free_port
+            return _free_port()
+        return self._base_port + (self._round % 1000)
+
     def _start_round(self, hosts: List[HostInfo]):
         with self._lock:
             self._round += 1
             self._current_hosts = hosts
             np_ = sum(h.slots for h in hosts)
             slots = get_host_assignments(hosts, np_)
-            port = self._base_port + (self._round % 1000)
+            port = self._controller_port(hosts[0].hostname)
             controller_addr = f"{hosts[0].hostname}:{port}"
             if hosts[0].hostname in ("localhost",):
                 controller_addr = f"127.0.0.1:{port}"
@@ -232,7 +248,7 @@ class ElasticDriver:
             removed = []
             for sid, w in list(self._workers.items()):
                 if sid not in wanted and w.proc.poll() is None:
-                    self._expected_exits.add(sid)
+                    self._expected_exits[sid] = self._gen.get(sid, 0)
                     removed.append(w)
                     if self._verbose:
                         print(f"[elastic] slot {sid} removed by "
@@ -257,6 +273,9 @@ class ElasticDriver:
         env["HVD_TPU_HOSTNAME"] = s.hostname
         env["HOROVOD_HOSTNAME"] = s.hostname
         self._gen[sid] = gen = self._gen.get(sid, 0) + 1
+        # Any scale-down marker belongs to a superseded generation; the
+        # replacement's exits are real events.
+        self._expected_exits.pop(sid, None)
         ws = exec_mod.launch_workers(
             [s], self._command, controller_addr="elastic",
             extra_env=env,
@@ -275,19 +294,21 @@ class ElasticDriver:
         with self._lock:
             if self._gen.get(sid) != gen:
                 # A superseded process's exit (the slot respawned since):
-                # must not untrack or fail its replacement.
-                self._expected_exits.discard(sid)
+                # must not untrack or fail its replacement.  Only its OWN
+                # generation's marker may be consumed here.
+                if self._expected_exits.get(sid) == gen:
+                    self._expected_exits.pop(sid, None)
                 if self._succeeded and not self._workers:
                     self._set_result(0)
                 return
             self._workers.pop(sid, None)
             self._finished[sid] = code
-            if sid in self._expected_exits:
+            if self._expected_exits.get(sid) == gen:
                 # Scale-down termination the driver requested: no
                 # blacklist, no new round, and never a job failure — but
                 # the completion check must still run (this exit may be
                 # the last one the driver was waiting on).
-                self._expected_exits.discard(sid)
+                self._expected_exits.pop(sid, None)
                 if self._succeeded and not self._workers:
                     self._set_result(0)
                 return

@@ -23,7 +23,8 @@ import sys
 import tempfile
 from typing import Any, Callable, List, Optional, Tuple
 
-from .store import Store, LocalStore                      # noqa: F401
+from .store import (Store, LocalStore, FsspecStore,       # noqa: F401
+                    GCSStore)
 from .estimator import (KerasEstimator, KerasModel,       # noqa: F401
                         TorchEstimator, TorchModel,
                         LightningEstimator, LightningModel)

@@ -259,10 +259,10 @@ def _torch_train_loop(spec) -> None:
     store = spec["store"]  # user Store subclass travels to workers intact
     # Shard by the eager communicator (participating processes), not
     # hvd.size() — chip-level size can exceed the process count on a
-    # multi-device host, which would silently drop data.  Per-rank rows are
-    # truncated to the common length within each chunk: ragged shards would
-    # desynchronize the blocking per-gradient allreduces (every rank reads
-    # the same files, so the chunk schedule is identical everywhere).
+    # multi-device host, which would silently drop data.  The store's
+    # sharded reader guarantees an identical fixed-size chunk schedule on
+    # every rank (truncated to the common per-rank row count), so the
+    # blocking per-gradient allreduces stay in lockstep.
     from ..ops.collective import communicator_size
     size = communicator_size()
     rank = hvd_torch.rank() % size if size > 1 else 0
@@ -278,12 +278,14 @@ def _torch_train_loop(spec) -> None:
     g = torch.Generator().manual_seed(13)
     chunk_rows = int(spec.get("chunk_rows") or 65536)
     for _ in range(spec["epochs"]):
+        # The store yields rank-local chunks (per-rank sharded reads with
+        # an identical chunk schedule on every rank — see
+        # Store.iter_array_batches), so no slicing happens here.
         for x, y in store.iter_array_batches(
                 spec["train_path"], spec["feature_cols"],
-                spec["label_cols"], chunk_rows=chunk_rows):
-            n_local = len(x) // size if size > 1 else len(x)
-            if size > 1:
-                x, y = x[rank::size][:n_local], y[rank::size][:n_local]
+                spec["label_cols"], chunk_rows=chunk_rows,
+                rank=rank, size=size):
+            n_local = len(x)
             if n_local == 0:
                 continue
             xt, yt = torch.from_numpy(x), torch.from_numpy(y)
@@ -401,10 +403,8 @@ def _lightning_train_loop(spec) -> None:
     for _ in range(spec["epochs"]):
         for x, y in store.iter_array_batches(
                 spec["train_path"], spec["feature_cols"],
-                spec["label_cols"]):
-            n_local = len(x) // size if size > 1 else len(x)
-            if size > 1:
-                x, y = x[rank::size][:n_local], y[rank::size][:n_local]
+                spec["label_cols"], rank=rank, size=size):
+            n_local = len(x)
             if n_local == 0:
                 continue
             xt, yt = torch.from_numpy(x), torch.from_numpy(y)

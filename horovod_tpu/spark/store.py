@@ -1,13 +1,23 @@
 """Storage abstraction for estimator data/checkpoints.
 
-Capability parity with the reference horovod/spark/common/store.py:32-154:
+Capability parity with the reference horovod/spark/common/store.py:32-520:
 a ``Store`` owns three sub-trees (intermediate train/val data, checkpoints,
 logs) under a prefix path, knows how to materialize a DataFrame to Parquet
 and read it back, and is subclassed per filesystem.  The reference ships
-LocalStore/HDFSStore/DBFSLocalStore; TPU-VM jobs live on local SSD or GCS
-FUSE mounts, both of which are plain filesystem paths — so ``LocalStore``
-(any mounted path, including ``/gcs/...``) is the primary implementation
-and ``Store.create`` picks by prefix.
+LocalStore/HDFSStore/DBFSLocalStore; the TPU-native analogs are
+``LocalStore`` (local disk, NFS, GCS-FUSE mounts) and ``FsspecStore`` /
+``GCSStore`` (remote object stores addressed by URL — ``gs://`` on TPU VMs,
+any fsspec protocol in general).  ``Store.create`` picks by prefix like the
+reference's ``Store.create`` (store.py:46-58).
+
+The worker feed (``iter_array_batches``) streams parquet row groups without
+materializing the dataset (the reference's Petastorm reader role,
+spark/keras/remote.py:102) and shards *reads* per rank: with enough row
+groups each rank reads only its own ~1/size of the files.  Chunks are
+re-batched to a fixed size and truncated to the common per-rank row count
+so every rank executes an identical optimizer-step schedule — the blocking
+per-gradient allreduces stay in lockstep (the reference equalizes with
+steps_per_epoch = rows / batch / np the same way).
 """
 
 from __future__ import annotations
@@ -27,24 +37,33 @@ class Store:
 
     @staticmethod
     def create(prefix_path: str) -> "Store":
+        if "://" in prefix_path:
+            if prefix_path.startswith("gs://"):
+                return GCSStore(prefix_path)
+            return FsspecStore(prefix_path)  # file://, s3://, memory://, …
         # GCS FUSE and local paths are both filesystem paths on TPU VMs.
         return LocalStore(prefix_path)
 
     # -- path layout (reference store.py:60-101) --
     def get_train_data_path(self, idx: Optional[str] = None) -> str:
-        return os.path.join(self.prefix_path, "intermediate_train_data",
-                            idx or "")
+        return self._join(self.prefix_path, "intermediate_train_data",
+                          idx or "")
 
     def get_val_data_path(self, idx: Optional[str] = None) -> str:
-        return os.path.join(self.prefix_path, "intermediate_val_data",
-                            idx or "")
+        return self._join(self.prefix_path, "intermediate_val_data",
+                          idx or "")
 
     def get_checkpoint_path(self, run_id: str) -> str:
-        return os.path.join(self.prefix_path, "runs", run_id, "checkpoint")
+        return self._join(self.prefix_path, "runs", run_id, "checkpoint")
 
     def get_logs_path(self, run_id: str) -> str:
-        return os.path.join(self.prefix_path, "runs", run_id, "logs")
+        return self._join(self.prefix_path, "runs", run_id, "logs")
 
+    @staticmethod
+    def _join(*parts: str) -> str:
+        return "/".join(p.rstrip("/") for p in parts if p)
+
+    # -- filesystem primitives (overridden per backend) --
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -53,6 +72,16 @@ class Store:
 
     def delete(self, path: str) -> None:
         raise NotImplementedError
+
+    # Local-filesystem defaults, NOT abstract: pre-existing user Store
+    # subclasses implemented only exists/makedirs/delete (the reference's
+    # abstract surface) and must keep working when the base data paths
+    # call these.
+    def _open(self, path: str, mode: str):
+        return open(path, mode)
+
+    def _listdir(self, path: str):
+        return [os.path.join(path, f) for f in os.listdir(path)]
 
     # -- data materialization --
     def write_dataframe(self, df, path: str) -> int:
@@ -69,54 +98,136 @@ class Store:
             df.write.mode("overwrite").parquet(path)
             try:
                 import pyarrow.parquet as pq
-                return sum(pq.ParquetFile(p).metadata.num_rows
-                           for p in self._parquet_parts(path))
+                total = 0
+                for p in self._parquet_parts(path):
+                    with self._open(p, "rb") as f:
+                        total += pq.ParquetFile(f).metadata.num_rows
+                return total
             except Exception:
-                return -1  # non-local store path; count unknown
+                return -1  # listing unsupported; count unknown
         self.makedirs(path)
-        target = os.path.join(path, "part-00000.parquet")
-        df.to_parquet(target)
+        target = self._join(path, "part-00000.parquet")
+        with self._open(target, "wb") as f:
+            df.to_parquet(f)
         return len(df)
 
     def _parquet_parts(self, path: str):
-        return sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
+        return sorted(p for p in self._listdir(path)
+                      if p.endswith(".parquet"))
 
     def read_dataframe(self, path: str):
         import pandas as pd
-        return pd.concat([pd.read_parquet(p)
-                          for p in self._parquet_parts(path)],
-                         ignore_index=True)
+        frames = []
+        for p in self._parquet_parts(path):
+            with self._open(p, "rb") as f:
+                frames.append(pd.read_parquet(f))
+        return pd.concat(frames, ignore_index=True)
 
     def iter_array_batches(self, path: str, feature_cols, label_cols,
-                           chunk_rows: int = 65536):
+                           chunk_rows: int = 65536, rank: int = 0,
+                           size: int = 1):
         """Stream (X, y) float32 chunks from the parquet files under
-        ``path`` without loading the dataset into memory — the worker-side
-        analog of the reference's Petastorm batch feed
-        (spark/keras/remote.py:102)."""
+        ``path`` without loading the dataset into memory.
+
+        With ``size > 1`` the stream is *rank-local*: row groups are
+        sharded ``rank::size`` when there are at least ``size`` of them
+        (each rank reads only its own files — the remote-store fast path),
+        falling back to a strided row split over shared reads otherwise.
+        Either way every rank yields chunks of identical sizes (fixed
+        ``chunk_rows``, truncated to the common per-rank row count), so
+        per-batch blocking collectives across ranks stay in lockstep.
+        """
         import pyarrow.parquet as pq
-        for part in self._parquet_parts(path):
-            pf = pq.ParquetFile(part)
-            for rb in pf.iter_batches(batch_size=chunk_rows):
-                yield dataframe_to_arrays(rb.to_pandas(), feature_cols,
-                                          label_cols)
+        parts = self._parquet_parts(path)
+        if size <= 1:
+            for part in parts:
+                with self._open(part, "rb") as f:
+                    pf = pq.ParquetFile(f)
+                    for rb in pf.iter_batches(batch_size=chunk_rows):
+                        yield dataframe_to_arrays(rb.to_pandas(),
+                                                  feature_cols, label_cols)
+            return
+
+        # Deterministic unit table (identical on every rank: same listing,
+        # same metadata) — the shard plan needs no communication.
+        units = []  # (part, row_group, rows)
+        for part in parts:
+            with self._open(part, "rb") as f:
+                md = pq.ParquetFile(f).metadata
+                for rg in range(md.num_row_groups):
+                    units.append((part, rg, md.row_group(rg).num_rows))
+
+        if len(units) >= size:
+            mine = units[rank::size]
+            common = min(sum(u[2] for u in units[r::size])
+                         for r in range(size))
+
+            def frames():
+                for part, rg, _ in mine:
+                    with self._open(part, "rb") as f:
+                        yield pq.ParquetFile(f).read_row_group(
+                            rg).to_pandas()
+        else:
+            total = sum(u[2] for u in units)
+            common = min(len(range(r, total, size)) for r in range(size))
+
+            def frames():
+                offset = 0
+                for part in parts:
+                    with self._open(part, "rb") as f:
+                        pf = pq.ParquetFile(f)
+                        for rb in pf.iter_batches(batch_size=chunk_rows):
+                            df = rb.to_pandas()
+                            sel = [i for i in range(len(df))
+                                   if (offset + i) % size == rank]
+                            offset += len(df)
+                            yield df.iloc[sel]
+
+        # Re-batch to fixed-size chunks truncated at the common row count:
+        # identical chunk schedule on every rank.
+        pend_x = pend_y = None
+        emitted = 0
+        for df in frames():
+            if not len(df):
+                continue
+            x, y = dataframe_to_arrays(df, feature_cols, label_cols)
+            pend_x = x if pend_x is None else np.concatenate([pend_x, x])
+            pend_y = y if pend_y is None else np.concatenate([pend_y, y])
+            while len(pend_x) >= chunk_rows and \
+                    emitted + chunk_rows <= common:
+                yield pend_x[:chunk_rows], pend_y[:chunk_rows]
+                pend_x = pend_x[chunk_rows:]
+                pend_y = pend_y[chunk_rows:]
+                emitted += chunk_rows
+            # Stop reading once enough rows are buffered for the tail:
+            # a skewed shard must not keep downloading surplus row groups
+            # that would only be discarded.
+            if emitted + len(pend_x) >= common:
+                break
+        tail = common - emitted
+        if tail > 0 and pend_x is not None and len(pend_x) >= tail:
+            yield pend_x[:tail], pend_y[:tail]
 
     def save_checkpoint(self, run_id: str, payload: bytes) -> str:
         path = self.get_checkpoint_path(run_id)
-        self.makedirs(os.path.dirname(path))
-        with open(path, "wb") as f:
+        self.makedirs(self._dirname(path))
+        with self._open(path, "wb") as f:
             f.write(payload)
         return path
 
     def load_checkpoint(self, run_id: str) -> bytes:
-        with open(self.get_checkpoint_path(run_id), "rb") as f:
+        with self._open(self.get_checkpoint_path(run_id), "rb") as f:
             return f.read()
+
+    @staticmethod
+    def _dirname(path: str) -> str:
+        return path.rsplit("/", 1)[0] if "/" in path else path
 
 
 class LocalStore(Store):
     """Filesystem store (reference LocalStore, store.py:105-132); covers
-    local disk, NFS and GCS-FUSE mounts on TPU VMs."""
+    local disk, NFS and GCS-FUSE mounts on TPU VMs.  _open/_listdir come
+    from the base's local-filesystem defaults."""
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
@@ -129,6 +240,65 @@ class LocalStore(Store):
             shutil.rmtree(path)
         elif os.path.exists(path):
             os.remove(path)
+
+
+class FsspecStore(Store):
+    """URL-addressed remote store over any fsspec filesystem (the
+    reference's HDFSStore role, store.py:337-471, generalized): ``gs://``,
+    ``s3://``, ``memory://`` (tests), ...  Workers re-resolve the
+    filesystem lazily so Store objects stay picklable across process
+    boundaries."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(prefix_path.rstrip("/"))
+        self._protocol = prefix_path.split("://", 1)[0]
+        self.__fs = None
+
+    @property
+    def _fs(self):
+        if self.__fs is None:
+            import fsspec
+            self.__fs = fsspec.filesystem(self._protocol)
+        return self.__fs
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_FsspecStore__fs"] = None  # filesystems may hold sockets
+        return state
+
+    def _with_protocol(self, path: str) -> str:
+        return path if "://" in path else f"{self._protocol}://{path}"
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        if self._fs.exists(path):
+            self._fs.rm(path, recursive=True)
+
+    def _open(self, path: str, mode: str):
+        return self._fs.open(path, mode)
+
+    def _listdir(self, path: str):
+        # fs.ls returns protocol-less paths; keep them addressable.
+        return [self._with_protocol(p)
+                for p in self._fs.ls(path, detail=False)]
+
+
+class GCSStore(FsspecStore):
+    """Google Cloud Storage store for TPU-VM estimator jobs (the
+    TPU-native analog of the reference's HDFSStore, store.py:337): a
+    ``gs://bucket/prefix`` path served by gcsfs.  Credentials come from
+    the VM's application-default service account (the standard TPU-VM
+    setup); pass nothing here."""
+
+    def __init__(self, prefix_path: str):
+        if not prefix_path.startswith("gs://"):
+            raise ValueError("GCSStore requires a gs:// prefix path")
+        super().__init__(prefix_path)
 
 
 def dataframe_to_arrays(df, feature_cols, label_cols):

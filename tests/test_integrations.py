@@ -82,6 +82,144 @@ def test_ray_host_discovery_with_fake_ray(monkeypatch):
     assert [(h.hostname, h.slots) for h in two_per] == [("a", 1), ("b", 2)]
 
 
+def _make_fake_ray(monkeypatch, record):
+    """A fake ray module mirroring the real placement-group API shape:
+    ray.remote actor classes, ray.util.placement_group, and
+    ray.util.scheduling_strategies.PlacementGroupSchedulingStrategy."""
+    import sys, types
+
+    class _FakePG:
+        def __init__(self, bundles, strategy):
+            self.bundles = bundles
+            self.strategy = strategy
+
+        def ready(self):
+            return "pg-ready"
+
+    class _Future:
+        def __init__(self, value):
+            self.value = value
+
+    class _ActorHandle:
+        def __init__(self, cls, opts):
+            self._inst = cls()
+            self._opts = opts
+
+        def __getattr__(self, name):
+            method = getattr(self._inst, name)
+
+            class _Remote:
+                @staticmethod
+                def remote(*a, **kw):
+                    return _Future(method(*a, **kw))
+            return _Remote()
+
+    class _ActorClass:
+        def __init__(self, cls):
+            self._cls = cls
+
+        def options(self, **opts):
+            record.setdefault("actor_opts", []).append(opts)
+
+            class _Factory:
+                @staticmethod
+                def remote():
+                    return _ActorHandle(self._cls, opts)
+            _Factory.remote = staticmethod(
+                lambda: _ActorHandle(self._cls, opts))
+            return _Factory()
+
+    ray = types.ModuleType("ray")
+    ray.remote = lambda cls: _ActorClass(cls)
+    ray.get = lambda x: ([f.value for f in x] if isinstance(x, list)
+                         else getattr(x, "value", x))
+    ray.kill = lambda w: record.setdefault("killed", []).append(w)
+
+    util = types.ModuleType("ray.util")
+
+    def placement_group(bundles, strategy):
+        pg = _FakePG(bundles, strategy)
+        record["pg"] = pg
+        return pg
+
+    util.placement_group = placement_group
+    util.remove_placement_group = \
+        lambda pg: record.__setitem__("pg_removed", pg)
+    ray.util = util
+
+    sched = types.ModuleType("ray.util.scheduling_strategies")
+
+    class PlacementGroupSchedulingStrategy:
+        def __init__(self, placement_group, placement_group_bundle_index):
+            self.placement_group = placement_group
+            self.placement_group_bundle_index = placement_group_bundle_index
+    sched.PlacementGroupSchedulingStrategy = PlacementGroupSchedulingStrategy
+
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    monkeypatch.setitem(sys.modules, "ray.util", util)
+    monkeypatch.setitem(sys.modules, "ray.util.scheduling_strategies", sched)
+    return ray
+
+
+def test_ray_executor_placement_group_api(monkeypatch):
+    """RayExecutor.start() builds a placement group with the planned
+    bundles/strategy and pins each actor to its bundle index via
+    PlacementGroupSchedulingStrategy (reference strategy.py:11); run()
+    executes ranks with the launcher env; shutdown removes the group."""
+    record = {}
+    _make_fake_ray(monkeypatch, record)
+    from horovod_tpu.ray import RayExecutor
+
+    # Fake actors run in-process and _Worker.run does os.environ.update:
+    # keep the launcher vars from leaking into later tests.
+    import os
+    snapshot = dict(os.environ)
+    try:
+        ex = RayExecutor(num_workers=4, cpus_per_worker=2.0,
+                         workers_per_host=2)
+        ex.start()
+        assert record["pg"].strategy == "PACK"
+        assert record["pg"].bundles == [{"CPU": 4.0}, {"CPU": 4.0}]
+        idxs = [o["scheduling_strategy"].placement_group_bundle_index
+                for o in record["actor_opts"]]
+        assert idxs == [0, 0, 1, 1]
+        assert all(o["num_cpus"] == 2.0 for o in record["actor_opts"])
+
+        def fn():
+            return (int(os.environ["HVD_TPU_RANK"]),
+                    int(os.environ["HVD_TPU_SIZE"]))
+
+        results = ex.run(fn)
+        assert sorted(results) == [(r, 4) for r in range(4)]
+        ex.shutdown()
+        assert len(record["killed"]) == 4
+        assert record["pg_removed"] is record["pg"]
+    finally:
+        os.environ.clear()
+        os.environ.update(snapshot)
+
+
+def test_ray_executor_real_cluster_smoke():
+    """Local-mode smoke on a REAL ray cluster (skipped when ray is not
+    installed): actual placement group + actors (VERDICT r2 #10)."""
+    ray = pytest.importorskip("ray")
+    from horovod_tpu.ray import RayExecutor
+    ray.init(num_cpus=2, include_dashboard=False,
+             ignore_reinit_error=True)
+    try:
+        ex = RayExecutor(num_workers=2, cpus_per_worker=1.0)
+        ex.start()
+
+        def fn():
+            import os
+            return int(os.environ["HVD_TPU_RANK"])
+
+        assert sorted(ex.run(fn)) == [0, 1]
+        ex.shutdown()
+    finally:
+        ray.shutdown()
+
+
 def test_elastic_ray_executor_gated():
     from horovod_tpu.ray import ElasticRayExecutor
     with pytest.raises(ImportError, match="ray"):
