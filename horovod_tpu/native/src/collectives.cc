@@ -335,6 +335,38 @@ Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
 
 }  // namespace
 
+namespace {
+template <typename T>
+void FloorDivT(T* p, int64_t count, int64_t d) {
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t v = static_cast<int64_t>(p[i]);
+    int64_t q = v / d;
+    if (v % d != 0 && v < 0) q -= 1;  // d (world size) is positive
+    p[i] = static_cast<T>(q);
+  }
+}
+}  // namespace
+
+bool FloorAverageInt(void* buf, int64_t count, DataType dtype,
+                     int64_t divisor) {
+  switch (dtype) {
+    case DataType::UINT8:
+      FloorDivT(static_cast<uint8_t*>(buf), count, divisor);
+      return true;
+    case DataType::INT8:
+      FloorDivT(static_cast<int8_t*>(buf), count, divisor);
+      return true;
+    case DataType::INT32:
+      FloorDivT(static_cast<int32_t*>(buf), count, divisor);
+      return true;
+    case DataType::INT64:
+      FloorDivT(static_cast<int64_t*>(buf), count, divisor);
+      return true;
+    default:
+      return false;
+  }
+}
+
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
   if (factor == 1.0) return;
   switch (dtype) {

@@ -89,6 +89,15 @@ int64_t AdasumScratchPeak();
 void ResetAdasumScratchPeak();
 
 // Elementwise scale in place (used for prescale/postscale/average).
+// Integer dtypes truncate toward zero (double multiply + C cast).
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
+
+// Integer Average: exact floor-divide in the integer domain, matching the
+// compiled path's contract (ops/collective.py _compiled_allreduce — float
+// widening cannot promise exactness, and truncation disagrees with floor
+// for negative sums).  No-op for non-integer dtypes.  Returns true if it
+// handled the dtype.
+bool FloorAverageInt(void* buf, int64_t count, DataType dtype,
+                     int64_t divisor);
 
 }  // namespace hvdtpu

@@ -485,8 +485,12 @@ void Runtime::ExecuteAllreduce(
 
   if (st.ok()) bytes_processed_ += total_bytes;
   if (st.ok()) {
-    if (resp.op == ReduceOp::AVERAGE)
-      ScaleBuffer(fb, total_elems, resp.dtype, 1.0 / net_->size());
+    if (resp.op == ReduceOp::AVERAGE) {
+      // Integer Average floor-divides in the integer domain (compiled-
+      // path contract); float dtypes scale.
+      if (!FloorAverageInt(fb, total_elems, resp.dtype, net_->size()))
+        ScaleBuffer(fb, total_elems, resp.dtype, 1.0 / net_->size());
+    }
     if (resp.postscale != 1.0)
       ScaleBuffer(fb, total_elems, resp.dtype, resp.postscale);
     if (!in_place) {

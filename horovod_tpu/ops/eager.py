@@ -333,9 +333,11 @@ def _device_allgather(tensor, ctl):
 
     The defensive per-call sizes exchange costs one extra (tiny) device
     collective; SPMD training code whose gather shapes are equal by
-    construction can skip it with ``HVD_TPU_EAGER_EQUAL_ALLGATHER=1``
-    (ragged inputs under that knob produce a shape error or wrong rows,
-    not silent corruption of other tensors)."""
+    construction can skip it with ``HVD_TPU_EAGER_EQUAL_ALLGATHER=1``.
+    WARNING: under that knob, genuinely ragged inputs make each process
+    compile a different global shape and the mesh collective can HANG
+    (no stall warning — see the module's ordering-contract note); only
+    set it when equal shapes are guaranteed."""
     if getattr(tensor, "ndim", 0) < 1:
         return None
     import os

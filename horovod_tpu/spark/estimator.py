@@ -88,6 +88,34 @@ class _EstimatorParams:
         return dataframe_to_arrays(df, self.feature_cols, self.label_cols)
 
 
+def _rank_local_batches(store, path, feature_cols, label_cols, rank, size,
+                        chunk_rows=65536):
+    """Rank-local (X, y) chunks from the store feed.  Stores implementing
+    the sharded reader (rank=/size= kwargs) yield rank-local data with a
+    lockstep chunk schedule; legacy user Store subclasses overriding the
+    old iter_array_batches signature fall back to shared reads + strided
+    row slicing (the pre-sharding behavior)."""
+    import inspect
+    try:
+        params = inspect.signature(store.iter_array_batches).parameters
+    except (TypeError, ValueError):  # builtins / exotic callables
+        params = {}
+    if "rank" in params and "size" in params:
+        yield from store.iter_array_batches(
+            path, feature_cols, label_cols, chunk_rows=chunk_rows,
+            rank=rank, size=size)
+        return
+    # Legacy override: pass only the kwargs its signature accepts.
+    legacy_kw = {"chunk_rows": chunk_rows} if "chunk_rows" in params else {}
+    for x, y in store.iter_array_batches(path, feature_cols, label_cols,
+                                         **legacy_kw):
+        n_local = len(x) // size if size > 1 else len(x)
+        if size > 1:
+            x, y = x[rank::size][:n_local], y[rank::size][:n_local]
+        if n_local:
+            yield x, y
+
+
 class KerasEstimator(_EstimatorParams):
     """Fit a tf.keras model on a DataFrame (reference
     spark/keras/estimator.py KerasEstimator)."""
@@ -278,13 +306,12 @@ def _torch_train_loop(spec) -> None:
     g = torch.Generator().manual_seed(13)
     chunk_rows = int(spec.get("chunk_rows") or 65536)
     for _ in range(spec["epochs"]):
-        # The store yields rank-local chunks (per-rank sharded reads with
-        # an identical chunk schedule on every rank — see
-        # Store.iter_array_batches), so no slicing happens here.
-        for x, y in store.iter_array_batches(
-                spec["train_path"], spec["feature_cols"],
-                spec["label_cols"], chunk_rows=chunk_rows,
-                rank=rank, size=size):
+        # The feed yields rank-local chunks (per-rank sharded reads with
+        # an identical chunk schedule on every rank; legacy Store
+        # overrides fall back to shared reads + strided rows).
+        for x, y in _rank_local_batches(
+                store, spec["train_path"], spec["feature_cols"],
+                spec["label_cols"], rank, size, chunk_rows=chunk_rows):
             n_local = len(x)
             if n_local == 0:
                 continue
@@ -401,9 +428,9 @@ def _lightning_train_loop(spec) -> None:
     g = torch.Generator().manual_seed(13)
     batch_idx = 0
     for _ in range(spec["epochs"]):
-        for x, y in store.iter_array_batches(
-                spec["train_path"], spec["feature_cols"],
-                spec["label_cols"], rank=rank, size=size):
+        for x, y in _rank_local_batches(
+                store, spec["train_path"], spec["feature_cols"],
+                spec["label_cols"], rank, size):
             n_local = len(x)
             if n_local == 0:
                 continue

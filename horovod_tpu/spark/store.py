@@ -149,13 +149,10 @@ class Store:
             return
 
         # Deterministic unit table (identical on every rank: same listing,
-        # same metadata) — the shard plan needs no communication.
-        units = []  # (part, row_group, rows)
-        for part in parts:
-            with self._open(part, "rb") as f:
-                md = pq.ParquetFile(f).metadata
-                for rg in range(md.num_row_groups):
-                    units.append((part, rg, md.row_group(rg).num_rows))
+        # same metadata) — the shard plan needs no communication.  Cached
+        # per path: estimator epochs re-iterate the same materialized
+        # dataset, and footer reads are round trips on remote stores.
+        units = self._row_group_units(path, parts)
 
         if len(units) >= size:
             mine = units[rank::size]
@@ -163,10 +160,20 @@ class Store:
                          for r in range(size))
 
             def frames():
-                for part, rg, _ in mine:
+                from itertools import groupby
+                # Strided selection keeps same-part units adjacent: open
+                # each file once and read its row groups from one handle,
+                # streamed in chunk_rows batches (a single row group can
+                # be the whole file — materializing it would break the
+                # bounded-memory contract the unsharded path keeps).
+                for part, group in groupby(mine, key=lambda u: u[0]):
                     with self._open(part, "rb") as f:
-                        yield pq.ParquetFile(f).read_row_group(
-                            rg).to_pandas()
+                        pf = pq.ParquetFile(f)
+                        for _, rg, _rows in group:
+                            for rb in pf.iter_batches(
+                                    batch_size=chunk_rows,
+                                    row_groups=[rg]):
+                                yield rb.to_pandas()
         else:
             total = sum(u[2] for u in units)
             common = min(len(range(r, total, size)) for r in range(size))
@@ -207,6 +214,25 @@ class Store:
         tail = common - emitted
         if tail > 0 and pend_x is not None and len(pend_x) >= tail:
             yield pend_x[:tail], pend_y[:tail]
+
+    def _row_group_units(self, path: str, parts):
+        """(part, row_group, rows) table for ``path``, cached on the
+        instance (datasets under a run id are written once)."""
+        import pyarrow.parquet as pq
+        cache = getattr(self, "_unit_cache", None)
+        if cache is None:
+            cache = self._unit_cache = {}
+        key = (path, tuple(parts))
+        if key not in cache:
+            units = []
+            for part in parts:
+                with self._open(part, "rb") as f:
+                    md = pq.ParquetFile(f).metadata
+                    for rg in range(md.num_row_groups):
+                        units.append((part, rg,
+                                      md.row_group(rg).num_rows))
+            cache[key] = units
+        return cache[key]
 
     def save_checkpoint(self, run_id: str, payload: bytes) -> str:
         path = self.get_checkpoint_path(run_id)

@@ -157,6 +157,19 @@ def _negotiated_worker(rank, size, ctl_port, jax_port, q):
             out = hvd.allreduce(x, op=hvd.Sum, name="cached.t")
             assert float(np.asarray(out)[0]) == 3.0
 
+        # 5. Host + device tensors in flight together: placement-keyed
+        # fusion must not mix the planes; both complete correctly.
+        hh = ctl.allreduce_submit(
+            np.full((5,), float(rank + 1), dtype=np.float32), op=1,
+            name="mix.host")
+        hd = ctl.allreduce_device_submit(
+            jnp.full((5,), float(rank + 1), dtype=jnp.float32), op=1,
+            name="mix.dev")
+        host_out = ctl.allreduce_finish(hh[0], hh[2])
+        dev_out = ctl.device_finish(*hd)
+        assert float(host_out[0]) == 3.0
+        assert float(np.asarray(dev_out)[0]) == 3.0
+
         ctl.shutdown()
         q.put((rank, "ok", None))
     except Exception as e:  # noqa: BLE001

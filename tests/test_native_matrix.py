@@ -28,9 +28,10 @@ def _free_port():
     return port
 
 
-def _worker(rank, size, port, fn_name, out_queue):
+def _worker(rank, size, port, fn_name, out_queue, env=None):
     sys.path.insert(0, REPO)
     os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ.update(env or {})
     from horovod_tpu.native.controller import NativeController
     ctl = NativeController(rank, size, f"127.0.0.1:{port}")
     try:
@@ -42,11 +43,12 @@ def _worker(rank, size, port, fn_name, out_queue):
         ctl.shutdown()
 
 
-def _run(fn_name, size=4):
+def _run(fn_name, size=4, env=None):
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
-    procs = [ctx.Process(target=_worker, args=(r, size, port, fn_name, q))
+    procs = [ctx.Process(target=_worker,
+                         args=(r, size, port, fn_name, q, env))
              for r in range(size)]
     for p in procs:
         p.start()
@@ -190,6 +192,103 @@ def body_error_then_recover(ctl, rank, size):
     return True
 
 
+def body_prescale_mismatch_error(ctl, rank, size):
+    # Reference controller.cc:482-706 validates scale factors across
+    # ranks; the ERROR must reach every rank's callback (this body runs on
+    # all ranks and _run asserts all of them report ok).
+    x = np.zeros((4,), dtype=np.float32)
+    try:
+        ctl.allreduce(x, op=1, prescale=1.0 if rank == 0 else 2.0,
+                      name="bad.scale")
+    except Exception as e:  # noqa: BLE001
+        assert "scale" in str(e)
+        return True
+    raise AssertionError("expected prescale-mismatch error")
+
+
+def body_device_placement_mismatch_error(ctl, rank, size):
+    # Rank 0 announces a device-resident tensor, the rest host tensors:
+    # cross-rank placement validation must deliver ERROR to every rank
+    # (reference device-consistency validation; the TPU device plane adds
+    # the same check for HBM vs host entries).
+    if rank == 0:
+        class _FakeDeviceArray:
+            dtype = np.dtype(np.float32)
+            ndim = 1
+            shape = (4,)
+        try:
+            h, nm = ctl.allreduce_device_submit(_FakeDeviceArray(), op=1,
+                                                name="bad.place")
+            ctl.device_finish(h, nm)
+        except Exception as e:  # noqa: BLE001
+            assert "device" in str(e) or "placement" in str(e), e
+            return True
+        raise AssertionError("expected placement-mismatch error on rank 0")
+    x = np.zeros((4,), dtype=np.float32)
+    try:
+        ctl.allreduce(x, op=1, name="bad.place")
+    except Exception as e:  # noqa: BLE001
+        assert "device" in str(e) or "placement" in str(e), e
+        return True
+    raise AssertionError("expected placement-mismatch error")
+
+
+_A2A_DTYPES = [np.uint8, np.int32, np.int64, np.float16, np.float32,
+               np.float64]
+
+
+def body_alltoall_dtype_matrix(ctl, rank, size):
+    # Uneven splits: rank r sends d+1 rows to destination d, scaled by
+    # the source rank (reference test_torch.py alltoall matrix).
+    for i, dt in enumerate(_A2A_DTYPES):
+        rows = sum(d + 1 for d in range(size))
+        x = np.concatenate(
+            [np.full((d + 1, 2), rank, dtype=dt) for d in range(size)])
+        assert x.shape == (rows, 2)
+        splits = [d + 1 for d in range(size)]
+        out, recv = ctl.alltoall(x, splits=splits, name=f"a2a.{i}")
+        assert out.dtype == np.dtype(dt)
+        # Every source sends (rank+1) rows to me, stamped with its rank.
+        np.testing.assert_array_equal(
+            recv, np.full((size,), rank + 1, dtype=recv.dtype))
+        expected = np.concatenate(
+            [np.full((rank + 1, 2), src, dtype=dt) for src in range(size)])
+        np.testing.assert_array_equal(out, expected)
+    return True
+
+
+def body_minmaxprod_dtype_matrix(ctl, rank, size):
+    # Min/Max/Product across integer and 16-bit float dtypes (reference
+    # dtype x op sweeps, test_torch.py:72ff).
+    dts = [np.int32, np.int64, np.float16, np.float32]
+    if _BF16 is not None:
+        dts.append(_BF16)
+    for i, dt in enumerate(dts):
+        x = np.full((6,), rank + 1, dtype=dt)
+        mn = ctl.allreduce(x, op=3, name=f"mm.min.{i}")
+        mx = ctl.allreduce(x, op=4, name=f"mm.max.{i}")
+        pr = ctl.allreduce(x, op=5, name=f"mm.prod.{i}")
+        assert mn.dtype == np.dtype(dt)
+        np.testing.assert_allclose(mn.astype(np.float64), 1.0)
+        np.testing.assert_allclose(mx.astype(np.float64), float(size))
+        np.testing.assert_allclose(
+            pr.astype(np.float64),
+            float(np.prod([r + 1.0 for r in range(size)])))
+    # Integer Average: exact floor-divide in the integer domain (the
+    # compiled-path contract, ops/collective.py), including negative
+    # sums where floor and C-style truncation disagree.
+    xi = np.full((5,), rank + 1, dtype=np.int64)
+    avg = ctl.allreduce(xi, op=0, name="mm.iavg")
+    assert avg.dtype == np.int64
+    np.testing.assert_array_equal(avg, sum(range(1, size + 1)) // size)
+    xn = np.full((5,), -(rank + 1), dtype=np.int32)
+    avg_n = ctl.allreduce(xn, op=0, name="mm.iavg.neg")
+    # sum = -10 at size 4: floor(-10/4) = -3 (truncation would give -2).
+    np.testing.assert_array_equal(
+        avg_n, (-sum(range(1, size + 1))) // size)
+    return True
+
+
 def body_reducescatter(ctl, rank, size):
     import horovod_tpu as hvd
     from horovod_tpu.core.state import global_state
@@ -213,10 +312,37 @@ def body_reducescatter(ctl, rank, size):
     "body_op_matrix", "body_prescale_postscale", "body_grouped_allreduce",
     "body_duplicate_name_error", "body_dtype_mismatch_error",
     "body_op_mismatch_error", "body_root_mismatch_error",
-    "body_error_then_recover",
+    "body_error_then_recover", "body_prescale_mismatch_error",
+    "body_device_placement_mismatch_error", "body_alltoall_dtype_matrix",
+    "body_minmaxprod_dtype_matrix",
 ])
 def test_native_matrix_4proc(body):
     _run(body, size=4)
+
+
+def body_cache_eviction_churn(ctl, rank, size):
+    """Cache-bit determinism across eviction: a 4-slot response cache
+    churned by 10 names/epoch with mixed hit/miss sequences (hot names
+    repeat, cold names rotate).  The coordinator's LRU and every worker's
+    mirror must stay coherent — divergence shows up as wrong numerics, a
+    hang, or a resend storm (reference controller.cc:368-378 peek-vs-get
+    determinism subtlety)."""
+    for epoch in range(6):
+        for j in range(10):
+            hot = j < 3  # identical every epoch: hit after re-insert
+            name = f"hot.{j}" if hot else f"cold.{epoch}.{j}"
+            x = np.full((7,), float((rank + 1) * (j + 1)),
+                        dtype=np.float32)
+            out = ctl.allreduce(x, op=1, name=name)
+            np.testing.assert_allclose(
+                out, (j + 1) * sum(range(1, size + 1)))
+    return True
+
+
+@pytest.mark.timeout(180)
+def test_cache_bit_determinism_across_eviction():
+    _run("body_cache_eviction_churn", size=4,
+         env={"HVD_TPU_CACHE_CAPACITY": "4"})
 
 
 @pytest.mark.parametrize("body", [

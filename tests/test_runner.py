@@ -123,6 +123,55 @@ def test_static_launch_2proc(tmp_path):
         assert data == {"rank": r, "size": 2}
 
 
+JOIN_WORKER = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    # Genuinely uneven data: rank r has (r + 1) batches.  Ranks that run
+    # out call join(); survivors' allreduces complete with zero proxies
+    # from the joined ranks (reference Join op, operations.cc:1202-1226).
+    n_batches = rank + 1
+    sums = []
+    for b in range(size):
+        if b >= n_batches:
+            break
+        out = hvd.allreduce(
+            np.full((4,), float(rank + 1), dtype=np.float32),
+            op=hvd.Sum, name=f"batch.{{b}}")
+        sums.append(float(np.asarray(out)[0]))
+    last = hvd.join()
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"rank": rank, "sums": sums, "last": last}}, f)
+    hvd.shutdown()
+""")
+
+
+def test_join_uneven_batches_under_launcher(tmp_path):
+    """Join with genuinely uneven batch counts under the real launcher
+    (not just API smoke): rank r contributes to batches 0..r only; batch
+    b's allreduce sums ranks r >= b (others are joined / zero-proxied)."""
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "join")
+    script = tmp_path / "worker.py"
+    script.write_text(JOIN_WORKER.format(repo=REPO, outfile=outfile))
+    size = 3
+    rc = main(["-np", str(size), sys.executable, str(script)])
+    assert rc == 0
+    results = {r: json.load(open(f"{outfile}.{r}")) for r in range(size)}
+    for r in range(size):
+        assert len(results[r]["sums"]) == r + 1
+        for b, got in enumerate(results[r]["sums"]):
+            # Batch b: ranks with more than b batches contribute rank+1;
+            # joined ranks contribute zeros.
+            expected = sum(rr + 1 for rr in range(size) if rr >= b)
+            assert got == expected, (r, b, got, expected)
+        # join() returns the last joined rank; every rank eventually joins.
+        assert results[r]["last"] >= 0
+
+
 def test_static_launch_failfast(tmp_path):
     from horovod_tpu.runner.launch import main
     script = tmp_path / "worker.py"

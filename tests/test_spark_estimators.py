@@ -133,6 +133,36 @@ def test_sharded_reader_disjoint_reads_equal_schedule(tmp_path):
     assert len(set(all_rows.tolist())) == len(all_rows)  # disjoint
 
 
+def test_legacy_store_feed_override_still_works(tmp_path):
+    """A user Store subclass overriding iter_array_batches with the OLD
+    signature (no rank/size kwargs) must keep working: the train loop
+    detects the legacy signature and falls back to shared reads +
+    strided row slicing."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalStore, TorchEstimator
+
+    calls = []
+
+    class LegacyStore(LocalStore):
+        def iter_array_batches(self, path, feature_cols, label_cols,
+                               chunk_rows=65536):
+            calls.append(path)
+            yield from Store.iter_array_batches(
+                self, path, feature_cols, label_cols,
+                chunk_rows=chunk_rows)
+
+    df = _regression_df(96)
+    est = TorchEstimator(model=torch.nn.Linear(3, 1), lr=0.1, epochs=10,
+                         batch_size=24, store=LegacyStore(str(tmp_path)),
+                         feature_cols=["features"], label_cols=["label"])
+    model = est.fit(df)
+    out = model.transform(df)
+    mse = float(np.mean((out["label__output"].values -
+                         df["label"].values) ** 2))
+    assert mse < 0.5, mse
+    assert calls, "legacy override was never invoked"
+
+
 def test_torch_estimator_distributed_fit_url_store(tmp_path):
     """Estimator fit from a URL store path (gs://-style; file:// locally)
     with per-rank sharded reads across two real worker processes."""
