@@ -130,6 +130,14 @@ def load_library():
     lib.hvd_native_broadcast_device.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_allgather_device.restype = ctypes.c_int64
+    lib.hvd_native_allgather_device.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int]
+    lib.hvd_native_alltoall_device.restype = ctypes.c_int64
+    lib.hvd_native_alltoall_device.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
     lib.hvd_native_set_device_executor.argtypes = [_DEVICE_EXEC_FN]
     _lib = lib
     return lib
@@ -267,7 +275,18 @@ class NativeController:
                 prescale, postscale, err, err_cap):
             try:
                 names = [names_p[i].decode() for i in range(n)]
-                sizes = [int(sizes_p[i]) for i in range(n)]
+                # sizes length depends on the request type (matches the
+                # Response.sizes layout): allreduce/broadcast = element
+                # counts per name; allgather = per-rank dims + row_elems;
+                # alltoall = P x P split matrix + row_elems.
+                P = controller.size()
+                if rtype == 1:
+                    n_sizes = P + 1
+                elif rtype == 3:
+                    n_sizes = P * P + 1
+                else:
+                    n_sizes = n
+                sizes = [int(sizes_p[i]) for i in range(n_sizes)]
                 np_dtype = _CODE_TO_DTYPE[dtype_code]
                 with controller._device_lock:
                     inputs = {nm: controller._device_inputs[nm]
@@ -331,6 +350,53 @@ class NativeController:
                 self._device_inputs.pop(nm, None)
             raise NativeError(self._last_error())
         return h, nm
+
+    def allgather_device_submit(self, arr, name: Optional[str] = None
+                                ) -> Tuple[int, str]:
+        nm = self._auto_name("allgather", name).decode()
+        with self._device_lock:
+            self._device_inputs[nm] = arr
+        ndim, shape = self._device_shape_arg(arr)
+        h = self._lib.hvd_native_allgather_device(
+            nm.encode(), ndim, shape, self._device_dtype_code(arr))
+        if h < 0:
+            with self._device_lock:
+                self._device_inputs.pop(nm, None)
+            raise NativeError(self._last_error())
+        return h, nm
+
+    def alltoall_device_submit(self, arr,
+                               splits: Optional[Sequence[int]] = None,
+                               name: Optional[str] = None
+                               ) -> Tuple[int, str]:
+        size = self.size()
+        if splits is None:
+            if arr.shape[0] % size != 0:
+                raise ValueError("alltoall dim0 not divisible by size")
+            splits = [arr.shape[0] // size] * size
+        nm = self._auto_name("alltoall", name).decode()
+        with self._device_lock:
+            self._device_inputs[nm] = arr
+        sp = (ctypes.c_int64 * len(splits))(*splits)
+        ndim, shape = self._device_shape_arg(arr)
+        h = self._lib.hvd_native_alltoall_device(
+            nm.encode(), ndim, shape, self._device_dtype_code(arr), sp,
+            len(splits))
+        if h < 0:
+            with self._device_lock:
+                self._device_inputs.pop(nm, None)
+            raise NativeError(self._last_error())
+        return h, nm
+
+    def allgather_device(self, arr, name: Optional[str] = None):
+        h, nm = self.allgather_device_submit(arr, name=name)
+        return self.device_finish(h, nm)
+
+    def alltoall_device(self, arr, splits: Optional[Sequence[int]] = None,
+                        name: Optional[str] = None):
+        """Returns (received, received_splits) like the host path."""
+        h, nm = self.alltoall_device_submit(arr, splits=splits, name=name)
+        return self.device_finish(h, nm)
 
     def device_finish(self, h: int, name: str):
         """Wait for a *_device_submit handle and collect the on-device

@@ -105,6 +105,24 @@ int64_t hvd_native_broadcast_device(const char* name, int ndim,
   return EnqueueChecked(std::move(e));
 }
 
+int64_t hvd_native_allgather_device(const char* name, int ndim,
+                                    const int64_t* shape, int dtype) {
+  auto e = MakeEntry(name, RequestType::ALLGATHER, nullptr, nullptr, ndim,
+                     shape, dtype);
+  e->device = true;
+  return EnqueueChecked(std::move(e));
+}
+
+int64_t hvd_native_alltoall_device(const char* name, int ndim,
+                                   const int64_t* shape, int dtype,
+                                   const int64_t* splits, int nsplits) {
+  auto e = MakeEntry(name, RequestType::ALLTOALL, nullptr, nullptr, ndim,
+                     shape, dtype);
+  e->splits.assign(splits, splits + nsplits);
+  e->device = true;
+  return EnqueueChecked(std::move(e));
+}
+
 void hvd_native_set_device_executor(DeviceExecutorFn fn) {
   Runtime::Get().SetDeviceExecutor(fn);
 }

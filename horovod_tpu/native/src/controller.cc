@@ -284,6 +284,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       int64_t row_elems = 1;
       for (size_t d = 1; d < q.shape.size(); ++d) row_elems *= q.shape[d];
       resp.sizes.push_back(row_elems);
+      resp.device = q.device;
       resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;
@@ -316,6 +317,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       int64_t a2a_row_elems = 1;
       for (size_t d = 1; d < q.shape.size(); ++d) a2a_row_elems *= q.shape[d];
       resp.sizes.push_back(a2a_row_elems);
+      resp.device = q.device;
       resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;

@@ -406,10 +406,33 @@ void Runtime::ExecuteDeviceCollective(
     if (rc != 0) {
       st = Status::Error(err[0] ? err : "device executor failed");
     } else {
+      const int P = net_->size();
       int64_t total_elems = 0;
-      for (size_t i = 0; i < resp.names.size() && i < resp.sizes.size();
-           ++i)
-        total_elems += resp.sizes[i];
+      switch (resp.type) {
+        case RequestType::ALLGATHER:
+          // sizes = per-rank first dims + trailing row_elems.
+          if (resp.sizes.size() == static_cast<size_t>(P) + 1) {
+            int64_t rows = 0;
+            for (int r = 0; r < P; ++r) rows += resp.sizes[r];
+            total_elems = rows * resp.sizes[P];
+          }
+          break;
+        case RequestType::ALLTOALL:
+          // sizes = P x P split matrix + trailing row_elems.
+          if (resp.sizes.size() ==
+              static_cast<size_t>(P) * P + 1) {
+            int64_t rows = 0;
+            for (size_t i = 0; i < static_cast<size_t>(P) * P; ++i)
+              rows += resp.sizes[i];
+            total_elems = rows * resp.sizes[static_cast<size_t>(P) * P];
+          }
+          break;
+        default:  // allreduce (fused) / broadcast: element counts
+          for (size_t i = 0;
+               i < resp.names.size() && i < resp.sizes.size(); ++i)
+            total_elems += resp.sizes[i];
+          break;
+      }
       bytes_processed_ += total_elems * DataTypeSize(resp.dtype);
     }
   }
@@ -510,6 +533,11 @@ void Runtime::ExecuteAllreduce(
 
 void Runtime::ExecuteAllgather(const Response& resp,
                                std::shared_ptr<TensorEntry> entry) {
+  if (resp.device) {
+    std::vector<std::shared_ptr<TensorEntry>> entries{entry};
+    ExecuteDeviceCollective(resp, entries);
+    return;
+  }
   const int size = net_->size();
   const int rank = net_->rank();
   const size_t elem = DataTypeSize(resp.dtype);
@@ -568,6 +596,11 @@ void Runtime::ExecuteBroadcast(const Response& resp,
 
 void Runtime::ExecuteAlltoall(const Response& resp,
                               std::shared_ptr<TensorEntry> entry) {
+  if (resp.device) {
+    std::vector<std::shared_ptr<TensorEntry>> entries{entry};
+    ExecuteDeviceCollective(resp, entries);
+    return;
+  }
   const int size = net_->size();
   const int rank = net_->rank();
   const size_t elem = DataTypeSize(resp.dtype);

@@ -142,62 +142,123 @@ def _negotiated_device_ready(ctl) -> bool:
 def _negotiated_executor(ctl):
     """Build the device-plane executor for one controller: executes a
     negotiated (possibly fused) Response entirely on device.  Runs on the
-    native background thread in coordinator response order."""
+    native background thread in coordinator response order.
+
+    Design invariant: the *global* (collective-bearing) program depends
+    only on coordinator-provided response data (op, scales, root, sizes,
+    dtype) — identical on every rank including joined zero-proxy ranks —
+    so SPMD programs always line up.  Per-tensor split/reshape/assembly
+    happens locally afterwards: replicated outputs are locally
+    materializable, so rank-divergent post-processing (only ranks with a
+    local entry do it) needs no cross-process rendezvous."""
 
     def impl(rtype, names, sizes, np_dtype, op, root, prescale, postscale,
              inputs):
         import jax.numpy as jnp
         from .collective import _eager_op_fn
         dtype = jnp.dtype(np_dtype)
-        arrays, shapes = [], []
-        for nm, sz in zip(names, sizes):
+        P = ctl.size()
+
+        if rtype in (0, 2):  # ALLREDUCE (possibly fused) / BROADCAST
+            arrays, shapes = [], []
+            for nm, sz in zip(names, sizes):
+                a = inputs.get(nm)
+                if a is None:
+                    # Joined-rank zero proxy (reference GetTensorEntries-
+                    # FromResponse zero tensors, tensor_queue.cc).
+                    a = jnp.zeros((sz,), dtype=dtype)
+                arrays.append(a)
+                shapes.append(a.shape)
+            # Fused dispatch: one flat payload -> one device collective
+            # per Response (the fusion-buffer analog; packing is D2D).
+            if len(arrays) == 1:
+                fused = jnp.ravel(arrays[0])
+            else:
+                fused = jnp.concatenate([jnp.ravel(a) for a in arrays])
+            base = (_eager_op_fn(int(op), float(prescale),
+                                 float(postscale))
+                    if rtype == 0 else _take_fn(int(root)))
+            out = _device_allreduce(fused, base, ctl)
+            if out is None:
+                raise RuntimeError(
+                    "device plane unavailable (no spanning JAX world)")
+            results = {}
+            off = 0
+            for nm, sz, shp in zip(names, sizes, shapes):
+                if nm in inputs:
+                    results[nm] = out[off: off + sz].reshape(shp)
+                off += sz
+            return results
+
+        if rtype == 1:  # ALLGATHER: sizes = per-rank dims[P] + row_elems
+            dims = [int(d) for d in sizes[:P]]
+            row_elems = int(sizes[P])
+            nm = names[0]
             a = inputs.get(nm)
+            max_rows = max(dims) if dims else 0
+            L = max_rows * row_elems
+            flat = jnp.zeros((L,), dtype=dtype)
+            if a is not None and a.size:
+                flat = flat.at[: a.size].set(jnp.ravel(a))
+            gathered = _device_allreduce(flat, _identity, ctl)  # (P, L)
+            if gathered is None:
+                raise RuntimeError(
+                    "device plane unavailable (no spanning JAX world)")
             if a is None:
-                # Joined-rank zero proxy (reference GetTensorEntries-
-                # FromResponse zero tensors, tensor_queue.cc).
-                a = jnp.zeros((sz,), dtype=dtype)
-            arrays.append(a)
-            shapes.append(a.shape)
-        # Fused dispatch: one flat payload -> one device collective per
-        # Response (the fusion-buffer analog; packing is D2D only).
-        if len(arrays) == 1:
-            fused = jnp.ravel(arrays[0])
-        else:
-            fused = jnp.concatenate([jnp.ravel(a) for a in arrays])
-        if rtype == 0:  # ALLREDUCE
-            base = _eager_op_fn(int(op), float(prescale), float(postscale))
-        elif rtype == 2:  # BROADCAST
-            base = _take_fn(int(root))
-        else:
-            raise ValueError(
-                f"device plane does not execute request type {rtype}")
-        # Split + reshape inside the jitted computation: eager indexing of
-        # a non-fully-addressable global array is not portable across
-        # multi-process JAX versions.
-        fn = _fused_split_fn(base, tuple(sizes), tuple(shapes))
-        parts = _device_allreduce(fused, fn, ctl)
-        if parts is None:
-            raise RuntimeError(
-                "device plane unavailable (no spanning JAX world)")
-        return {nm: parts[i] for i, nm in enumerate(names) if nm in inputs}
+                return {}
+            parts = [gathered[r, : dims[r] * row_elems]
+                     for r in range(P) if dims[r]]
+            out = jnp.concatenate(parts) if parts else \
+                jnp.zeros((0,), dtype=dtype)
+            out = out.reshape((sum(dims),) + tuple(a.shape[1:]))
+            return {nm: out}
+
+        if rtype == 3:  # ALLTOALL: sizes = split matrix[P*P] + row_elems
+            import jax
+            mat = [int(v) for v in sizes[: P * P]]
+            row_elems = int(sizes[P * P])
+            nm = names[0]
+            a = inputs.get(nm)
+            me = jax.process_index()
+            max_seg = max(mat) if mat else 0
+            L = P * max_seg * row_elems
+            flat = jnp.zeros((L,), dtype=dtype)
+            if a is not None and a.size:
+                av = jnp.ravel(a)
+                off_in = 0
+                for d in range(P):
+                    seg = mat[me * P + d] * row_elems
+                    if seg:
+                        flat = flat.at[d * max_seg * row_elems:
+                                       d * max_seg * row_elems + seg].set(
+                            av[off_in: off_in + seg])
+                        off_in += seg
+            gathered = _device_allreduce(flat, _identity, ctl)  # (P, L)
+            if gathered is None:
+                raise RuntimeError(
+                    "device plane unavailable (no spanning JAX world)")
+            if a is None:
+                return {}
+            parts = []
+            for src in range(P):
+                seg = mat[src * P + me] * row_elems
+                if seg:
+                    parts.append(
+                        gathered[src,
+                                 me * max_seg * row_elems:
+                                 me * max_seg * row_elems + seg])
+            out = jnp.concatenate(parts) if parts else \
+                jnp.zeros((0,), dtype=dtype)
+            total = sum(mat[src * P + me] for src in range(P))
+            out = out.reshape((total,) + tuple(a.shape[1:]))
+            recv_splits = np.array(
+                [mat[src * P + me] for src in range(P)], dtype=np.int32)
+            return {nm: (out, recv_splits)}
+
+        raise ValueError(
+            f"device plane does not execute request type {rtype}")
 
     return impl
-
-
-@functools.lru_cache(maxsize=512)
-def _fused_split_fn(base_fn, sizes, shapes):
-    """Reduce the fused flat payload with ``base_fn`` then split it back
-    into per-tensor views, all in one compiled program (the fusion-buffer
-    unpack, on device)."""
-    def fn(stack):
-        out = base_fn(stack)
-        res = []
-        off = 0
-        for sz, shp in zip(sizes, shapes):
-            res.append(out[off: off + sz].reshape(shp))
-            off += sz
-        return tuple(res)
-    return fn
 
 
 def _ctl(fn, *args, **kwargs):
@@ -356,15 +417,21 @@ def _device_allgather(tensor, ctl):
 def allgather(tensor, name: Optional[str] = None):
     """Concatenate along dim 0 across processes (unequal dim-0 allowed)."""
     ctl = _controller()
-    if _is_device_array(tensor) and ctl is None:
-        # Direct SPMD device plane (no controller).  With a controller
-        # attached, allgather goes through negotiation on the host plane:
-        # issuing direct mesh collectives from the caller thread would race
-        # the negotiated device responses executing on the background
-        # thread over the same process mesh.
-        out = _device_allgather(tensor, ctl)
-        if out is not None:
-            return out
+    if _is_device_array(tensor):
+        if ctl is not None:
+            # Negotiated device plane (unequal dims come from the
+            # coordinator's size table, so no extra sizes exchange).
+            if getattr(tensor, "ndim", 0) >= 1 and \
+                    _negotiated_device_ready(ctl):
+                return _ctl(ctl.allgather_device, tensor, name=name)
+        else:
+            # Direct SPMD device plane (no controller).  With a controller
+            # attached, direct mesh collectives from the caller thread
+            # would race the negotiated device responses executing on the
+            # background thread over the same process mesh.
+            out = _device_allgather(tensor, ctl)
+            if out is not None:
+                return out
     if ctl is not None:
         return _ctl(ctl.allgather, _np(tensor), name=name)
     if global_state.process_count == 1:
@@ -418,6 +485,10 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
     (operations.cc:1136-1198)."""
     ctl = _controller()
     if ctl is not None:
+        if _is_device_array(tensor) and getattr(tensor, "ndim", 0) >= 1 \
+                and _negotiated_device_ready(ctl):
+            return _ctl(ctl.alltoall_device, tensor, splits=splits,
+                        name=name)
         return _ctl(ctl.alltoall, _np(tensor), splits=splits, name=name)
     x = _np(tensor)
     p = global_state.process_count

@@ -157,7 +157,30 @@ def _negotiated_worker(rank, size, ctl_port, jax_port, q):
             out = hvd.allreduce(x, op=hvd.Sum, name="cached.t")
             assert float(np.asarray(out)[0]) == 3.0
 
-        # 5. Host + device tensors in flight together: placement-keyed
+        # 5a. Negotiated device allgather with UNEQUAL first dims: the
+        # coordinator's size table replaces the sizes exchange; payload
+        # stays on device.
+        g = hvd.allgather(
+            jnp.full((rank + 1, 3), float(rank), dtype=jnp.float32))
+        assert isinstance(g, jax.Array), type(g)
+        ga = np.asarray(g)
+        assert ga.shape == (3, 3)  # 1 + 2 rows
+        assert float(ga[0, 0]) == 0.0 and float(ga[1, 0]) == 1.0
+
+        # 5b. Negotiated device alltoall with uneven splits.
+        x2 = jnp.concatenate([
+            jnp.full((d + 1, 2), float(rank), dtype=jnp.float32)
+            for d in range(size)])
+        out2, recv = hvd.alltoall(x2, splits=[d + 1 for d in range(size)])
+        assert isinstance(out2, jax.Array)
+        np.testing.assert_array_equal(np.asarray(recv),
+                                      np.full((size,), rank + 1))
+        expected = np.concatenate(
+            [np.full((rank + 1, 2), float(src), dtype=np.float32)
+             for src in range(size)])
+        np.testing.assert_array_equal(np.asarray(out2), expected)
+
+        # 6. Host + device tensors in flight together: placement-keyed
         # fusion must not mix the planes; both complete correctly.
         hh = ctl.allreduce_submit(
             np.full((5,), float(rank + 1), dtype=np.float32), op=1,
