@@ -86,6 +86,31 @@ def init(mesh=None,
         global_state.cross_size = _env_int("CROSS_SIZE") or 1
         global_state.process_rank = env_rank
         global_state.process_count = env_size
+        # If a spanning jax.distributed world already exists, its process
+        # ids must match the env-provided ranks: eager device-plane
+        # collectives place shards in JAX process-index order and read
+        # them back in rank order (broadcast root, gather concatenation),
+        # so a permuted world silently misroutes data.  Fail fast here —
+        # every rank passes through init(), making this the one
+        # synchronous point where the misconfiguration is visible before
+        # any collective can hang aligned peers.  The distributed state is
+        # read directly (NOT jax.process_index(), which initializes the
+        # XLA backend — forbidden here per the note above).
+        try:
+            from jax._src import distributed as _jd
+            _ds = _jd.global_state
+            jax_pid = _ds.process_id if _ds.client is not None else None
+            jax_np = _ds.num_processes
+        except Exception:
+            jax_pid = jax_np = None
+        if jax_pid is not None and jax_np == env_size \
+                and jax_pid != env_rank:
+            raise RuntimeError(
+                f"horovod_tpu.init(): jax.distributed process_id "
+                f"{jax_pid} != rank {env_rank} from the environment. "
+                "Initialize jax.distributed with process_id == rank "
+                "(the launcher does this), or unset the rank env vars "
+                "to derive ranks from JAX.")
     else:
         # Derive from JAX: rank = chip-rank of this process's first device.
         import jax

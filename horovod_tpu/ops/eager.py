@@ -92,6 +92,10 @@ def _device_allreduce(tensor, op_fn, ctl):
             # device-plane reduce would silently drop remote ranks.  Host
             # plane handles it.
             return None
+        if ctl is None:
+            # With a controller attached, _negotiated_device_ready
+            # guarantees alignment before the executor reaches here.
+            _check_rank_aligned()
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = _cached_process_mesh()
         me = mesh.devices.flat[jax.process_index()]
@@ -115,9 +119,17 @@ def _negotiated_device_ready(ctl) -> bool:
     nccl_operations.cc:126-184).
 
     Requires a spanning JAX world (jax.process_count() == communicator
-    size) — the coordinator's response order is identical on every rank, so
-    the executor's SPMD collectives line up even when per-rank enqueue
-    order diverged.  Attaches the executor to the controller on first use.
+    size) **and** rank alignment (jax.process_index() == ctl.rank()) —
+    the executor maps coordinator rank-indexed tables (allgather dims[r],
+    the alltoall split-matrix row, the broadcast root shard) onto the
+    'proc' mesh ordered by JAX process index, so a user-initialized JAX
+    world whose process ids are ordered differently from controller ranks
+    would silently misroute segments and pick the wrong broadcast root.
+    On mismatch the host plane handles the tensor (and the controller's
+    device-placement validation fails mixed placements cleanly).  The
+    coordinator's response order is identical on every rank, so the
+    executor's SPMD collectives line up even when per-rank enqueue order
+    diverged.  Attaches the executor to the controller on first use.
     """
     import os
     if os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE", "1") == "0":
@@ -126,7 +138,25 @@ def _negotiated_device_ready(ctl) -> bool:
         return True
     try:
         import jax
-        ok = jax.process_count() == ctl.size()
+        spanning = jax.process_count() == ctl.size()
+        aligned = jax.process_index() == ctl.rank()
+        ok = spanning and aligned
+        if spanning and not aligned and \
+                not getattr(ctl, "_warned_rank_misalign", False):
+            # One-time heads-up: this rank routes HBM tensors to the host
+            # plane.  If *other* ranks are aligned they submit device
+            # requests for the same names and the coordinator's placement
+            # validation delivers a clean cross-rank ERROR (reference
+            # semantics for inconsistent submissions, controller.cc
+            # validation) — set HVD_TPU_EAGER_DEVICE_PLANE=0 on all ranks
+            # for uniform host-plane behavior instead.
+            from ..utils import logging as _logging
+            _logging.warning(
+                "jax.process_index() %d != controller rank %d; HBM "
+                "tensors use the host plane on this rank. For uniform "
+                "behavior across ranks set HVD_TPU_EAGER_DEVICE_PLANE=0.",
+                jax.process_index(), ctl.rank())
+            ctl._warned_rank_misalign = True
     except Exception:
         ok = False
     if ok:
@@ -274,6 +304,28 @@ def _ctl(fn, *args, **kwargs):
         raise HorovodInternalError(str(e)) from e
 
 
+def _check_rank_aligned():
+    """Regime-2 (no-controller) collectives place shards over the process
+    mesh and read results back in communicator-rank order (broadcast root
+    selection, gather concatenation): a jax.distributed world whose
+    process ids are permuted relative to communicator ranks would either
+    silently misroute data (device path, placed by process index) or
+    device_put to a non-addressable device (host path, placed by rank).
+    init() already rejects this when jax.distributed came up first; this
+    covers worlds formed after init().  Fail loudly — no fallback exists
+    in this regime."""
+    import jax
+    if jax.process_index() != global_state.process_rank:
+        raise RuntimeError(
+            "eager collectives: jax.process_index() "
+            f"{jax.process_index()} != communicator rank "
+            f"{global_state.process_rank}; a jax.distributed world "
+            "ordered differently from the communicator cannot run "
+            "rank-indexed collectives. Initialize jax.distributed "
+            "with process_id == rank (the launcher does this) or "
+            "run under the launcher.")
+
+
 def _process_mesh():
     """A 1-D mesh with exactly one device per process, for process-level
     eager collectives (regime 2)."""
@@ -296,6 +348,7 @@ def _global_over_processes(x: np.ndarray):
     """Build a (P, *x.shape) global array, shard p = process p's x."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+    _check_rank_aligned()
     mesh = _cached_process_mesh()
     sharding = NamedSharding(mesh, P("proc"))
     p = global_state.process_count

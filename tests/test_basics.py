@@ -53,6 +53,29 @@ def test_env_rank_override(monkeypatch):
     assert hvd.cross_size() == 4
 
 
+def test_init_rejects_rank_permuted_jax_world(monkeypatch):
+    """Env-provided ranks must match an existing jax.distributed world's
+    process ids: device-plane collectives place shards in process-index
+    order but read them back in rank order, so a permuted world silently
+    misroutes broadcast roots / gather order.  init() is the synchronous
+    fail-fast point (every rank passes through it before any collective)."""
+    from jax._src import distributed as _jd
+
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    monkeypatch.setattr(_jd.global_state, "client", object())
+    monkeypatch.setattr(_jd.global_state, "process_id", 0)
+    monkeypatch.setattr(_jd.global_state, "num_processes", 2)
+    with pytest.raises(RuntimeError, match="process_id 0 != rank 1"):
+        hvd.init(use_controller=False)
+    assert not hvd.is_initialized()
+
+    # Aligned world initializes fine.
+    monkeypatch.setattr(_jd.global_state, "process_id", 1)
+    hvd.init(use_controller=False)
+    assert hvd.rank() == 1
+
+
 def test_shutdown_resets():
     hvd.init()
     hvd.shutdown()

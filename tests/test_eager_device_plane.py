@@ -42,6 +42,41 @@ def test_device_array_stays_on_device(monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
 
 
+def test_negotiated_device_ready_requires_rank_alignment(monkeypatch):
+    """A user-initialized jax.distributed world whose process ids are
+    ordered differently from controller ranks must NOT engage the
+    negotiated device plane: the executor maps coordinator rank-indexed
+    tables (allgather dims[r], alltoall split rows, broadcast root) onto
+    the process-index-ordered mesh, so misalignment would silently
+    misroute data.  Mismatch → host plane fallback."""
+    import jax
+    from horovod_tpu.ops import eager
+
+    class _Ctl:
+        def __init__(self, size, rank):
+            self._s, self._r = size, rank
+
+        def size(self):
+            return self._s
+
+        def rank(self):
+            return self._r
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    aligned = _Ctl(2, 0)
+    assert eager._negotiated_device_ready(aligned)
+    assert aligned._negotiated_device_ok
+
+    misaligned = _Ctl(2, 1)  # jax process 0 but controller rank 1
+    assert not eager._negotiated_device_ready(misaligned)
+    assert not getattr(misaligned, "_negotiated_device_ok", False)
+
+    # Non-spanning world still rejected as before.
+    small = _Ctl(4, 0)
+    assert not eager._negotiated_device_ready(small)
+
+
 def test_numpy_input_uses_host_plane():
     import horovod_tpu as hvd
     hvd.init()
