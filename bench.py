@@ -345,22 +345,232 @@ def bench_resnet():
     })
 
 
-def _eager_bench_worker(rank, size, port, nbytes, n_iters, q):
+def _eager_sweep_worker(rank, size, port, env, specs, q):
+    """Run a list of measurement specs inside one controller session.
+    Reports per-spec wall time; the parent takes the max across ranks (a
+    collective is done when the slowest rank is)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ.update(env)
+    os.environ.setdefault("HVD_TPU_CYCLE_TIME", "1")
     import numpy as np
-    from horovod_tpu.native.controller import NativeController
-    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
-    x = np.ones(nbytes // 4, dtype=np.float32)
-    h = ctl.allreduce_async_(x, x, op=1, name="warm")
-    ctl.wait(h)
-    t0 = time.perf_counter()
-    for i in range(n_iters):
-        h = ctl.allreduce_async_(x, x, op=1, name=f"b.{i % 4}")
-        ctl.wait(h)
-    dt = time.perf_counter() - t0
-    q.put((rank, nbytes * n_iters / dt / 1e9))
-    ctl.shutdown()
+    try:
+        from horovod_tpu.native.controller import NativeController
+        ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+        results = []
+        for spec in specs:
+            kind = spec["kind"]
+            iters = spec["iters"]
+            tag = spec["name"].replace("/", "_")
+            if kind in ("allreduce", "adasum"):
+                op = 2 if kind == "adasum" else 1
+                x = np.ones(spec["nbytes"] // 4, dtype=np.float32)
+                h = ctl.allreduce_async_(x, x, op=op, name=f"w.{tag}")
+                ctl.wait(h)
+                ctl.barrier()
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    h = ctl.allreduce_async_(x, x, op=op,
+                                             name=f"{tag}.{i % 4}")
+                    ctl.wait(h)
+                dt = time.perf_counter() - t0
+            elif kind == "many_small":
+                # The fusion-threshold workload: ntensors concurrent small
+                # allreduces per step; under a large threshold the runtime
+                # fuses them into few ring launches, under threshold 0
+                # each rides its own.
+                n_t = spec["ntensors"]
+                each = spec["nbytes"] // n_t // 4
+                bufs = [np.ones(each, dtype=np.float32)
+                        for _ in range(n_t)]
+                hs = [ctl.allreduce_async_(b, b, op=1, name=f"w.{tag}.{j}")
+                      for j, b in enumerate(bufs)]
+                for h in hs:
+                    ctl.wait(h)
+                ctl.barrier()
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    hs = [ctl.allreduce_async_(b, b, op=1,
+                                               name=f"{tag}.{i % 2}.{j}")
+                          for j, b in enumerate(bufs)]
+                    for h in hs:
+                        ctl.wait(h)
+                dt = time.perf_counter() - t0
+            else:
+                raise ValueError(kind)
+            results.append((spec["name"], dt))
+        ctl.barrier()
+        try:
+            ctl.shutdown()
+        except Exception:  # noqa: BLE001 — measurements already complete
+            pass
+        q.put((rank, "ok", results))
+    except Exception:  # noqa: BLE001
+        import traceback
+        q.put((rank, "error", traceback.format_exc()[-2000:]))
+
+
+def _run_eager_config(np_procs, env, specs, timeout=900):
+    """Spawn np_procs workers, run all specs, return {name: max_dt}."""
+    import multiprocessing as mp
+    import socket as socket_mod
+
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_eager_sweep_worker,
+                         args=(r, np_procs, port, env, specs, q))
+             for r in range(np_procs)]
+    for p in procs:
+        p.start()
+    per_rank = {}
+    try:
+        deadline = time.monotonic() + timeout
+        while len(per_rank) < np_procs:
+            # Short-poll the queue and check worker liveness so a rank
+            # that dies in native code (no q.put ever comes) fails fast
+            # with its exit code instead of a silent full-timeout wait.
+            try:
+                rank, status, payload = q.get(timeout=5)
+            except Exception:  # queue.Empty
+                dead = [(p_rank, p.exitcode)
+                        for p_rank, p in enumerate(procs)
+                        if not p.is_alive() and p.exitcode not in (0, None)
+                        and p_rank not in per_rank]
+                if dead:
+                    raise RuntimeError(
+                        f"worker(s) died without reporting: "
+                        f"{[(r, f'exit={c}') for r, c in dead]}")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"eager bench timed out after {timeout}s; "
+                        f"reported: {sorted(per_rank)}")
+                continue
+            if status != "ok":
+                raise RuntimeError(f"rank {rank} failed: {payload}")
+            per_rank[rank] = dict(payload)
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+    return {name: max(per_rank[r][name] for r in per_rank)
+            for name in per_rank[0]}
+
+
+def bench_eager_sweep():
+    """The committed eager-plane performance artifact (VERDICT r3 #1b):
+    allreduce bandwidth vs payload at np=4/8, flat vs hierarchical,
+    shm+CMA vs TCP-only, fusion on vs off, Adasum VHDD vs gather+tree —
+    all on the native C++ data plane, no TPU needed.  Writes
+    BENCH_EAGER.json and prints a one-line summary.
+
+    Bandwidth convention: alg_gbps = payload_bytes x iters / max_rank_dt
+    (algorithm bandwidth per rank); bus_gbps = alg_gbps x 2(P-1)/P (ring
+    wire traffic, the NCCL busbw convention) — comparable across np."""
+    def iters_for(mb):
+        return 8 if mb <= 8 else (4 if mb <= 64 else 2)
+
+    payloads = [0.0625, 1, 8, 64, 256]  # 64KB .. 256MB
+
+    def mb_name(mb):
+        return f"{mb}MB" if mb >= 1 else f"{int(mb * 1024)}KB"
+
+    def ar_specs(mbs):
+        return [{"name": f"allreduce/{mb_name(mb)}", "kind": "allreduce",
+                 "nbytes": int(mb * (1 << 20)),
+                 "iters": iters_for(mb)} for mb in mbs]
+
+    base_env = {"HVD_TPU_CYCLE_TIME": "1"}
+    rows = []
+
+    def record(config, np_procs, specs, env):
+        dts = _run_eager_config(np_procs, env, specs)
+        for spec in specs:
+            dt = dts[spec["name"]]
+            nbytes = spec["nbytes"]
+            alg = nbytes * spec["iters"] / dt / 1e9
+            rows.append({
+                "config": config, "np": np_procs,
+                "op": spec["name"].split("/")[0],
+                "payload_bytes": nbytes,
+                "iters": spec["iters"],
+                "sec_per_op": round(dt / spec["iters"], 5),
+                "alg_gbps": round(alg, 3),
+                "bus_gbps": round(alg * 2 * (np_procs - 1) / np_procs, 3),
+            })
+            sys.stderr.write(
+                f"  {config} np={np_procs} {spec['name']}: "
+                f"{alg:.3f} GB/s alg\n")
+
+    # 1. Payload sweep, default plane (shm+CMA same-host, flat ring).
+    for np_procs in (4, 8):
+        sys.stderr.write(f"[eager sweep] flat shm np={np_procs}\n")
+        record("flat_shm", np_procs, ar_specs(payloads), dict(base_env))
+
+    # 2. TCP-only (shm/CMA disabled) — the cross-host wire path.
+    sys.stderr.write("[eager sweep] flat tcp np=4\n")
+    record("flat_tcp", 4, ar_specs([1, 64, 256]),
+           dict(base_env, HVD_TPU_DISABLE_SHM="1"))
+
+    # 3. Hierarchical allreduce (2 simulated nodes x 2 local ranks).
+    sys.stderr.write("[eager sweep] hierarchical np=4\n")
+    record("hierarchical_shm", 4, ar_specs([1, 64, 256]),
+           dict(base_env, HVD_TPU_HIERARCHICAL_ALLREDUCE="1",
+                HVD_TPU_LOCAL_SIZE="2"))
+
+    # 4. Fusion on/off: 128 x 64KB concurrent tensors (8MB total).
+    many = [{"name": "many_small/128x64KB", "kind": "many_small",
+             "nbytes": 8 << 20, "ntensors": 128, "iters": 4}]
+    sys.stderr.write("[eager sweep] fusion on np=4\n")
+    record("fusion_on", 4, many, dict(base_env))
+    sys.stderr.write("[eager sweep] fusion off np=4\n")
+    record("fusion_off", 4, many,
+           dict(base_env, HVD_TPU_FUSION_THRESHOLD="0"))
+
+    # 5. Adasum: VHDD vs gather+tree at the same np.
+    ad = [{"name": f"adasum/{mb}MB", "kind": "adasum",
+           "nbytes": mb << 20, "iters": 4} for mb in (8, 64)]
+    sys.stderr.write("[eager sweep] adasum vhdd np=4\n")
+    record("adasum_vhdd", 4, ad, dict(base_env))
+    sys.stderr.write("[eager sweep] adasum tree np=4\n")
+    record("adasum_tree", 4, ad,
+           dict(base_env, HVD_TPU_ADASUM_ALGO="tree"))
+
+    artifact = {
+        "schema": "horovod_tpu eager data-plane sweep v1",
+        "environment": {
+            "host_cores": os.cpu_count(),
+            "note": ("single-host localhost; all ranks share "
+                     f"{os.cpu_count()} CPU core(s), so absolute GB/s is "
+                     "memcpy/scheduler-contention-bound; the configuration "
+                     "RATIOS (shm vs tcp, fused vs unfused, vhdd vs tree) "
+                     "are the meaningful signal"),
+        },
+        "rows": rows,
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_EAGER.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    # One-line summary: the large-payload default-plane bandwidth.
+    big = [r for r in rows
+           if r["config"] == "flat_shm" and r["np"] == 4
+           and r["payload_bytes"] == 256 << 20][0]
+    _emit({
+        "metric": "eager_allreduce_algorithm_bandwidth_256MB",
+        "value": big["alg_gbps"],
+        "unit": "GB/s/rank (np=4, 256MB fp32, shm+CMA)",
+        "vs_baseline": round(big["alg_gbps"] / 0.78, 3),
+        "rows": len(rows),
+        "artifact": "BENCH_EAGER.json",
+    })
 
 
 def bench_eager():
@@ -369,33 +579,19 @@ def bench_eager():
     channels + TCP) — the plane that carries torch/TF front-end traffic.
     Baseline: the reference's published sample implies ~0.78 GB/s/GPU of
     allreduce algorithm bandwidth (103.55 img/s x ~100MB ResNet-101 fp32
-    grads x 2(n-1)/n at n=16 — docs/benchmarks.rst:27-41)."""
-    import multiprocessing as mp
-    import socket as socket_mod
+    grads x 2(n-1)/n at n=16 — docs/benchmarks.rst:27-41).
 
+    Bandwidth = payload x iters / max-rank wall time (a collective is done
+    when its slowest rank is)."""
     np_procs = int(os.environ.get("BENCH_EAGER_NP", "4"))
     mb = int(os.environ.get("BENCH_EAGER_MB", "32"))
     iters = int(os.environ.get("BENCH_ITERS", "8"))
 
-    def _free_port():
-        s = socket_mod.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
-
-    port = _free_port()
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [ctx.Process(target=_eager_bench_worker,
-                         args=(r, np_procs, port, mb << 20, iters, q))
-             for r in range(np_procs)]
-    for p in procs:
-        p.start()
-    rates = [q.get(timeout=300)[1] for _ in range(np_procs)]
-    for p in procs:
-        p.join(timeout=30)
-    gbps = sum(rates) / len(rates)
+    spec = [{"name": "allreduce/inplace", "kind": "allreduce",
+             "nbytes": mb << 20, "iters": iters}]
+    dts = _run_eager_config(np_procs, {"HVD_TPU_CYCLE_TIME": "1"}, spec,
+                            timeout=300)
+    gbps = (mb << 20) * iters / dts["allreduce/inplace"] / 1e9
     _emit({
         "metric": "eager_allreduce_algorithm_bandwidth",
         "value": round(gbps, 3),
@@ -427,6 +623,8 @@ def main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     if mode == "eager":
         return bench_eager()  # never touches the accelerator
+    if mode == "eager_sweep":
+        return bench_eager_sweep()  # never touches the accelerator
     if mode in ("resnet", "bert") and not _tpu_transport_alive():
         # Emit the DP scaling-efficiency metric (virtual CPU mesh) so the
         # round still records a number, with the degradation visible.

@@ -845,10 +845,22 @@ Status AdasumGatherTree(Network& net, T* data, int64_t count) {
   return Status::OK();
 }
 
+// HVD_TPU_ADASUM_ALGO=tree forces the gather+tree fallback at any world
+// size so the two algorithms can be benchmarked head-to-head at the same
+// np (the reference exposes no such knob; pow2 worlds always take VHDD).
+inline bool ForceAdasumTree() {
+  static const bool force = [] {
+    const char* v = getenv("HVD_TPU_ADASUM_ALGO");
+    return v && std::string(v) == "tree";
+  }();
+  return force;
+}
+
 template <typename T>
 Status AdasumTyped(Network& net, T* data, int64_t count) {
   const int P = net.size();
-  if (P & (P - 1)) return AdasumGatherTree<T>(net, data, count);
+  if (ForceAdasumTree() || (P & (P - 1)))
+    return AdasumGatherTree<T>(net, data, count);
   std::vector<int> all(P);
   for (int i = 0; i < P; ++i) all[i] = i;
   return AdasumVHDDImpl<T>(net, data, count, all);

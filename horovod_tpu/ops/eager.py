@@ -129,7 +129,8 @@ def _negotiated_device_ready(ctl) -> bool:
     device-placement validation fails mixed placements cleanly).  The
     coordinator's response order is identical on every rank, so the
     executor's SPMD collectives line up even when per-rank enqueue order
-    diverged.  Attaches the executor to the controller on first use.
+    diverged.  (The executor itself is registered at controller
+    construction — see NativeController.__init__.)
     """
     import os
     if os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE", "1") == "0":

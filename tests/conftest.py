@@ -106,3 +106,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): per-test wall-clock limit "
         "(enforced by conftest SIGALRM)")
+    config.addinivalue_line(
+        "markers", "slow: multi-minute performance/regression tests "
+        "(deselect with -m 'not slow')")
