@@ -39,9 +39,14 @@ _CODE_TO_DTYPE = {v: k for k, v in _DTYPE_CODES.items()}
 
 # Device-executor callback signature (runtime.h DeviceExecutorFn): executes
 # one negotiated, possibly-fused device-resident Response on the background
-# thread, in coordinator response order.
+# thread, in coordinator response order.  Two-phase (runtime.h
+# DeviceExecPhase): PREPARE(0) stages inputs + runs every locally-
+# detectable check, EXECUTE(1) dispatches the SPMD collective, ABORT(2)
+# drops staged state when a peer's prepare failed.
+_PHASE_PREPARE, _PHASE_EXECUTE, _PHASE_ABORT = 0, 1, 2
 _DEVICE_EXEC_FN = ctypes.CFUNCTYPE(
     ctypes.c_int,                        # return: 0 ok
+    ctypes.c_int,                        # phase (DeviceExecPhase)
     ctypes.c_int, ctypes.c_int,          # request_type, n
     ctypes.POINTER(ctypes.c_char_p),     # names
     ctypes.POINTER(ctypes.c_int64),      # sizes (element counts)
@@ -190,6 +195,7 @@ class NativeController:
         self._device_results = {}  # name -> executed result
         self._device_cb = None     # keep the CFUNCTYPE alive (GC hazard)
         self._device_exec_impl = None
+        self._device_plan = None   # staged by PREPARE, consumed by EXECUTE
         # Register the executor NOW, not lazily on first device op: every
         # rank of the communicator must be able to participate in a device
         # Response (joined ranks contribute zero proxies) even if it never
@@ -271,30 +277,52 @@ class NativeController:
             return
         controller = self
 
-        def _cb(rtype, n, names_p, sizes_p, dtype_code, op, root,
+        def _cb(phase, rtype, n, names_p, sizes_p, dtype_code, op, root,
                 prescale, postscale, err, err_cap):
             try:
-                names = [names_p[i].decode() for i in range(n)]
-                # sizes length depends on the request type (matches the
-                # Response.sizes layout): allreduce/broadcast = element
-                # counts per name; allgather = per-rank dims + row_elems;
-                # alltoall = P x P split matrix + row_elems.
-                P = controller.size()
-                if rtype == 1:
-                    n_sizes = P + 1
-                elif rtype == 3:
-                    n_sizes = P * P + 1
-                else:
-                    n_sizes = n
-                sizes = [int(sizes_p[i]) for i in range(n_sizes)]
-                np_dtype = _CODE_TO_DTYPE[dtype_code]
-                with controller._device_lock:
-                    inputs = {nm: controller._device_inputs[nm]
-                              for nm in names
-                              if nm in controller._device_inputs}
-                results = controller._device_exec_impl(
-                    rtype, names, sizes, np_dtype, op, root, prescale,
-                    postscale, inputs)
+                if phase == _PHASE_ABORT:
+                    # A peer's prepare failed: drop the staged plan (the
+                    # inputs stay in _device_inputs until device_finish
+                    # pops them on the error path).
+                    controller._device_plan = None
+                    return 0
+                if phase == _PHASE_PREPARE:
+                    names = [names_p[i].decode() for i in range(n)]
+                    # sizes length depends on the request type (matches
+                    # the Response.sizes layout): allreduce/broadcast =
+                    # element counts per name; allgather = per-rank dims
+                    # + row_elems; alltoall = P x P matrix + row_elems.
+                    P = controller.size()
+                    if rtype == 1:
+                        n_sizes = P + 1
+                    elif rtype == 3:
+                        n_sizes = P * P + 1
+                    else:
+                        n_sizes = n
+                    sizes = [int(sizes_p[i]) for i in range(n_sizes)]
+                    np_dtype = _CODE_TO_DTYPE[dtype_code]
+                    with controller._device_lock:
+                        inputs = {nm: controller._device_inputs[nm]
+                                  for nm in names
+                                  if nm in controller._device_inputs}
+                    # Every check that can fail without touching the SPMD
+                    # plane runs here, so a doomed rank is discovered
+                    # BEFORE peers enter the unabortable collective.
+                    validate = getattr(controller._device_exec_impl,
+                                       "validate", None)
+                    if validate is not None:
+                        validate(rtype, names, sizes, np_dtype, op, root)
+                    controller._device_plan = (
+                        rtype, names, sizes, np_dtype, op, root,
+                        prescale, postscale, inputs)
+                    return 0
+                # EXECUTE: unanimous OK was agreed across ranks.
+                plan = controller._device_plan
+                controller._device_plan = None
+                if plan is None:
+                    raise RuntimeError(
+                        "device executor: EXECUTE without a prepared plan")
+                results = controller._device_exec_impl(*plan)
                 with controller._device_lock:
                     controller._device_results.update(results)
                 return 0

@@ -636,6 +636,37 @@ Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
   return Status::OK();
 }
 
+Status AgreeAllRanks(Network& net, int32_t* ok, int32_t* first_bad_rank) {
+  *first_bad_rank = (*ok != 0) ? -1 : net.rank();
+  if (net.size() == 1) return Status::OK();
+  // Star over the mesh sockets (raw 8-byte exchange, no framing): rank 0
+  // gathers [ok, rank], ANDs, broadcasts [all_ok, first_bad].  Safe on
+  // the shared sockets because every rank reaches this call at the same
+  // point of the identical coordinator response schedule.
+  int32_t msg[2] = {*ok, *first_bad_rank};
+  if (net.rank() == 0) {
+    for (int r = 1; r < net.size(); ++r) {
+      int32_t peer[2];
+      Status st = net.peer(r)->RecvAll(peer, sizeof(peer));
+      if (!st.ok()) return st;
+      if (peer[0] == 0 && (msg[1] < 0 || peer[1] < msg[1])) msg[1] = peer[1];
+      msg[0] &= peer[0];
+    }
+    for (int r = 1; r < net.size(); ++r) {
+      Status st = net.peer(r)->SendAll(msg, sizeof(msg));
+      if (!st.ok()) return st;
+    }
+  } else {
+    Status st = net.coordinator()->SendAll(msg, sizeof(msg));
+    if (!st.ok()) return st;
+    st = net.coordinator()->RecvAll(msg, sizeof(msg));
+    if (!st.ok()) return st;
+  }
+  *ok = msg[0];
+  *first_bad_rank = msg[1];
+  return Status::OK();
+}
+
 Status PairwiseAlltoallv(Network& net, const uint8_t* send,
                          const std::vector<int64_t>& send_bytes,
                          uint8_t* recv,

@@ -61,6 +61,15 @@ int LastAllgatherSchedule();
 // In-place broadcast of buf from root (chain schedule).
 Status ChainBroadcast(Network& net, void* buf, int64_t nbytes, int root);
 
+// Cross-rank status agreement: *ok in/out (1 = this rank OK); after the
+// call *ok is the AND over all ranks and *first_bad_rank the lowest rank
+// that reported failure (-1 when unanimous OK).  Star exchange over the
+// mesh sockets; callers must invoke it at the same point of the same
+// response schedule on every rank (the runtime does, in coordinator
+// response order).  The TPU-side analog of the reference's NCCL
+// async-error agreement (nccl_operations.cc:96-109).
+Status AgreeAllRanks(Network& net, int32_t* ok, int32_t* first_bad_rank);
+
 // send: concatenated segments for each destination (send_bytes[d] each);
 // recv: filled with segments from each source (recv_bytes[s] each).
 Status PairwiseAlltoallv(Network& net, const uint8_t* send,

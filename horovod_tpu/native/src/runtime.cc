@@ -39,6 +39,10 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
   loop_error_ = Status::OK();
   counter_start_ = std::chrono::steady_clock::now();
   bytes_processed_ = 0;
+  stall_warning_s_ = stall_warning_s;
+  watchdog_stop_ = false;
+  device_exec_start_ms_ = 0;
+  watchdog_ = std::thread([this] { DeviceWatchdog(); });
   background_ = std::thread([this] { BackgroundLoop(); });
   initialized_ = true;
   return Status::OK();
@@ -61,6 +65,9 @@ void Runtime::Shutdown() {
   stop_ = true;
   enqueue_cv_.notify_all();
   if (background_.joinable()) background_.join();
+  watchdog_stop_ = true;
+  watch_cv_.notify_all();
+  if (watchdog_.joinable()) watchdog_.join();
   timeline_.Stop();
   // Fail any remaining entries (FinalizeTensorQueue semantics,
   // tensor_queue.cc).
@@ -378,33 +385,104 @@ void Runtime::ExecuteDeviceCollective(
   // nccl_operations.cc:126-184).  Invoked in coordinator response order,
   // identical across ranks, so the executor's SPMD collectives line up
   // even when per-rank enqueue order diverged.
+  //
+  // Failure protocol (reference: NCCL async-error abort,
+  // nccl_operations.cc:96-109 — an XLA collective cannot be aborted, so
+  // failures must be caught BEFORE the SPMD dispatch): PREPARE runs every
+  // locally-detectable check; the per-rank status is agreed across all
+  // ranks over the wire; only unanimous OK proceeds to EXECUTE.  A second
+  // agreement after EXECUTE converts any late failure into an ERROR on
+  // every rank.  Either way every rank's entries resolve and the runtime
+  // stays usable (like the coordinator's validation-error path).
   DeviceExecutorFn fn = device_executor_.load();
   Status st;
+  std::vector<const char*> names(resp.names.size());
+  for (size_t i = 0; i < resp.names.size(); ++i)
+    names[i] = resp.names[i].c_str();
+  char err[512];
+  err[0] = '\0';
+
+  // Watchdog marker covers the whole prepare/agree/execute/agree span:
+  // a peer stuck in any of them leaves this rank blocked here too, and
+  // the negotiation-plane stall inspector cannot see it.
+  {
+    std::lock_guard<std::mutex> lk(watch_mu_);
+    device_exec_name_ = resp.names[0];
+    device_exec_warned_ = false;
+    device_exec_start_ms_ =
+        std::chrono::duration_cast<std::chrono::milliseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+  watch_cv_.notify_all();
+
+  int32_t ok = 1;
   if (fn == nullptr) {
-    st = Status::PreconditionError(
-        "device-resident response but no device executor registered");
-    // Surface this even when this rank holds no local entries (e.g. a
-    // joined rank): its non-participation strands peers inside the SPMD
-    // collective, and a silent drop would look like a hang.
-    fprintf(stderr,
-            "[hvdtpu rank %d] ERROR: device response '%s' has no device "
-            "executor; peer ranks will stall in the device collective\n",
-            net_ ? net_->rank() : -1, resp.names[0].c_str());
+    ok = 0;
+    snprintf(err, sizeof(err),
+             "no device executor registered on rank %d",
+             net_ ? net_->rank() : -1);
   } else {
-    std::vector<const char*> names(resp.names.size());
-    for (size_t i = 0; i < resp.names.size(); ++i)
-      names[i] = resp.names[i].c_str();
-    char err[512];
-    err[0] = '\0';
+    int rc = fn(kDevicePrepare, static_cast<int>(resp.type),
+                static_cast<int>(names.size()), names.data(),
+                resp.sizes.data(), static_cast<int>(resp.dtype),
+                static_cast<int>(resp.op), resp.root_rank, resp.prescale,
+                resp.postscale, err, sizeof(err));
+    ok = (rc == 0);
+  }
+  int32_t first_bad = -1;
+  Status ag = AgreeAllRanks(*net_, &ok, &first_bad);
+  if (!ag.ok()) {
+    device_exec_start_ms_ = 0;
+    for (auto& e : entries)
+      if (e) Finish(e, ag);
+    return;
+  }
+  if (!ok) {
+    if (fn != nullptr) {
+      // Drop any state PREPARE staged (a rank whose own prepare failed
+      // has nothing staged; abort is idempotent).
+      char abort_err[64];
+      fn(kDeviceAbort, static_cast<int>(resp.type),
+         static_cast<int>(names.size()), names.data(), resp.sizes.data(),
+         static_cast<int>(resp.dtype), static_cast<int>(resp.op),
+         resp.root_rank, resp.prescale, resp.postscale, abort_err,
+         sizeof(abort_err));
+    }
+    // Own error text only when this rank IS the (first) failing rank —
+    // appending a local message to a peer's rank id would misattribute
+    // one rank's error to another.
+    st = (first_bad == net_->rank() && err[0] != '\0')
+             ? Status::Error(err)
+             : Status::Error("device executor failed on rank " +
+                             std::to_string(first_bad));
+    device_exec_start_ms_ = 0;
+    for (auto& e : entries)
+      if (e) Finish(e, st);
+    return;
+  }
+
+  {
     timeline_.Record(resp.names[0], "B", "DEVICE_COLLECTIVE");
-    int rc = fn(static_cast<int>(resp.type),
+    int rc = fn(kDeviceExecute, static_cast<int>(resp.type),
                 static_cast<int>(names.size()), names.data(),
                 resp.sizes.data(), static_cast<int>(resp.dtype),
                 static_cast<int>(resp.op), resp.root_rank, resp.prescale,
                 resp.postscale, err, sizeof(err));
     timeline_.Record(resp.names[0], "E", "DEVICE_COLLECTIVE");
-    if (rc != 0) {
-      st = Status::Error(err[0] ? err : "device executor failed");
+    int32_t exec_ok = (rc == 0);
+    int32_t exec_bad = -1;
+    ag = AgreeAllRanks(*net_, &exec_ok, &exec_bad);
+    if (!ag.ok()) {
+      device_exec_start_ms_ = 0;
+      for (auto& e : entries)
+        if (e) Finish(e, ag);
+      return;
+    }
+    if (!exec_ok) {
+      st = rc != 0 ? Status::Error(err[0] ? err : "device executor failed")
+                   : Status::Error("device executor failed on rank " +
+                                   std::to_string(exec_bad));
     } else {
       const int P = net_->size();
       int64_t total_elems = 0;
@@ -436,8 +514,39 @@ void Runtime::ExecuteDeviceCollective(
       bytes_processed_ += total_elems * DataTypeSize(resp.dtype);
     }
   }
+  device_exec_start_ms_ = 0;
   for (auto& e : entries)
     if (e) Finish(e, st);
+}
+
+void Runtime::DeviceWatchdog() {
+  std::unique_lock<std::mutex> lk(watch_mu_);
+  while (!watchdog_stop_) {
+    if (device_exec_start_ms_.load() == 0) {
+      // Idle: block until a device response starts or shutdown — zero
+      // wakeups for host-plane-only workloads.
+      watch_cv_.wait(lk, [this] {
+        return watchdog_stop_.load() || device_exec_start_ms_.load() != 0;
+      });
+      continue;
+    }
+    watch_cv_.wait_for(lk, std::chrono::milliseconds(200),
+                       [this] { return watchdog_stop_.load(); });
+    int64_t start = device_exec_start_ms_.load();
+    if (start == 0 || device_exec_warned_.load()) continue;
+    int64_t now = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      std::chrono::steady_clock::now().time_since_epoch())
+                      .count();
+    if ((now - start) / 1000.0 > stall_warning_s_) {
+      device_exec_warned_ = true;
+      fprintf(stderr,
+              "[hvdtpu rank %d] WARNING: device response '%s' in flight "
+              "for %.0fs; a peer rank may be stuck or dead inside the "
+              "device collective\n",
+              net_ ? net_->rank() : -1, device_exec_name_.c_str(),
+              (now - start) / 1000.0);
+    }
+  }
 }
 
 void Runtime::ExecuteAllreduce(

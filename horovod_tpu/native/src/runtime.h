@@ -35,11 +35,28 @@ namespace hvdtpu {
 // inside the negotiated runtime (reference nccl_operations.cc:126-184).
 // Invoked on the background thread in coordinator response order (identical
 // on every rank, so SPMD-dispatched device collectives line up).
+//
+// Two-phase protocol (the analog of the reference's async-error abort,
+// nccl_operations.cc:96-109, which this plane cannot replicate because an
+// XLA collective in flight cannot be aborted): PREPARE runs every check
+// that can fail *before* any SPMD dispatch (executor wiring, spanning JAX
+// world, dtype, staged inputs); the runtime then agrees the per-rank
+// PREPARE status across all ranks over the wire, and only a unanimous OK
+// proceeds to EXECUTE — so a rank that would fail can no longer strand its
+// peers inside the device collective.  ABORT drops state staged by a
+// PREPARE whose agreement failed.  A second agreement after EXECUTE turns
+// any late failure into an ERROR on every rank.
 // Returns 0 on success; nonzero with a message written into err.
-typedef int (*DeviceExecutorFn)(int request_type, int n, const char** names,
-                                const int64_t* sizes, int dtype, int op,
-                                int root_rank, double prescale,
-                                double postscale, char* err, int err_cap);
+enum DeviceExecPhase {
+  kDevicePrepare = 0,
+  kDeviceExecute = 1,
+  kDeviceAbort = 2,
+};
+typedef int (*DeviceExecutorFn)(int phase, int request_type, int n,
+                                const char** names, const int64_t* sizes,
+                                int dtype, int op, int root_rank,
+                                double prescale, double postscale, char* err,
+                                int err_cap);
 
 struct HandleState {
   std::atomic<bool> done{false};
@@ -149,6 +166,22 @@ class Runtime {
   std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;
   Status loop_error_;
+
+  // Device-response stall watchdog: the negotiation-plane stall inspector
+  // (controller.cc) cannot see a device Response stuck inside the
+  // executor (e.g. one rank's jit blocked on a dead peer's collective),
+  // because the background thread itself is the one blocked.  A separate
+  // thread watches the in-flight marker and warns after stall_warning_s
+  // (reference: the stall inspector watches the full op lifetime).
+  void DeviceWatchdog();
+  std::thread watchdog_;
+  std::atomic<bool> watchdog_stop_{false};
+  std::atomic<int64_t> device_exec_start_ms_{0};  // 0 = none in flight
+  std::atomic<bool> device_exec_warned_{false};
+  std::mutex watch_mu_;
+  std::condition_variable watch_cv_;
+  std::string device_exec_name_;  // guarded by watch_mu_
+  double stall_warning_s_ = 60.0;
 };
 
 }  // namespace hvdtpu

@@ -289,6 +289,40 @@ def _negotiated_executor(ctl):
         raise ValueError(
             f"device plane does not execute request type {rtype}")
 
+    def validate(rtype, names, sizes, np_dtype, op, root):
+        """PREPARE-phase check (runs before the cross-rank status
+        agreement): every condition that would make ``impl`` fail without
+        entering the SPMD collective must be detected here, so a doomed
+        rank turns into a clean cross-rank ERROR instead of stranding
+        peers inside an unabortable device collective (the reference
+        aborts NCCL comms on async errors, nccl_operations.cc:96-109;
+        XLA offers no abort, so the check must happen up front)."""
+        import os
+        if os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE", "1") == "0":
+            raise RuntimeError(
+                "device plane disabled on this rank "
+                "(HVD_TPU_EAGER_DEVICE_PLANE=0)")
+        import jax
+        if jax.process_count() != ctl.size() or \
+                jax.process_index() != ctl.rank():
+            raise RuntimeError(
+                "device plane unavailable (no spanning/aligned JAX "
+                f"world: processes {jax.process_count()}/{ctl.size()}, "
+                f"index {jax.process_index()} vs rank {ctl.rank()})")
+        import jax.numpy as jnp
+        # Real dtype probe: jax silently downcasts dtypes it lacks (e.g.
+        # float64 with x64 disabled), which would desync the SPMD dispatch
+        # — reject here, before the cross-rank OK agreement.
+        probe = jnp.zeros((0,), dtype=np_dtype)
+        if probe.dtype != np.dtype(np_dtype):
+            raise TypeError(
+                f"device plane lacks dtype {np.dtype(np_dtype)} "
+                f"(jax yields {probe.dtype}; e.g. x64 disabled)")
+        if rtype not in (0, 1, 2, 3):
+            raise ValueError(
+                f"device plane does not execute request type {rtype}")
+
+    impl.validate = validate
     return impl
 
 
