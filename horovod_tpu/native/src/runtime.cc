@@ -65,7 +65,13 @@ void Runtime::Shutdown() {
   stop_ = true;
   enqueue_cv_.notify_all();
   if (background_.joinable()) background_.join();
-  watchdog_stop_ = true;
+  {
+    // Store + notify under the lock: an unlocked store can race the
+    // watchdog's predicate evaluation and lose the wakeup (untimed idle
+    // wait would then block join forever).
+    std::lock_guard<std::mutex> lk(watch_mu_);
+    watchdog_stop_ = true;
+  }
   watch_cv_.notify_all();
   if (watchdog_.joinable()) watchdog_.join();
   timeline_.Stop();
@@ -433,6 +439,17 @@ void Runtime::ExecuteDeviceCollective(
   int32_t first_bad = -1;
   Status ag = AgreeAllRanks(*net_, &ok, &first_bad);
   if (!ag.ok()) {
+    if (fn != nullptr && ok) {
+      // Drop the staged plan on transport failure too (symmetry with
+      // the peer-failure path below), or the staged HBM inputs stay
+      // referenced until the next device PREPARE.
+      char abort_err[64];
+      fn(kDeviceAbort, static_cast<int>(resp.type),
+         static_cast<int>(names.size()), names.data(), resp.sizes.data(),
+         static_cast<int>(resp.dtype), static_cast<int>(resp.op),
+         resp.root_rank, resp.prescale, resp.postscale, abort_err,
+         sizeof(abort_err));
+    }
     device_exec_start_ms_ = 0;
     for (auto& e : entries)
       if (e) Finish(e, ag);

@@ -221,67 +221,105 @@ def _negotiated_executor(ctl):
                 off += sz
             return results
 
+        # Variable-size collectives stage at EXACT concatenated offsets
+        # and combine with a one-hot SUM (each position gets exactly one
+        # rank's contribution), so staged memory is bounded by the total
+        # payload — not by P x max-segment padding, which under skewed
+        # splits (one rank 1000x the others) wasted quadratic-ish HBM
+        # (VERDICT r3 #7).  The wire is the same-width unsigned-int
+        # BITCAST of the payload: integer one-hot sum is bit-exact for
+        # every pattern (float +x would lose -0.0: (-0.0)+(+0.0)=+0.0),
+        # and the bitcast is free on device.  bool rides a uint8 cast.
+        _UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+                          8: jnp.uint64}
+        if dtype == jnp.bool_:
+            wire_dtype = jnp.uint8
+
+            def _wire(x):
+                return x.astype(jnp.uint8)
+
+            def _unwire(x):
+                return x.astype(dtype)
+        elif jnp.issubdtype(dtype, jnp.floating):
+            import jax
+            wire_dtype = _UINT_OF_WIDTH[dtype.itemsize]
+
+            def _wire(x):
+                return jax.lax.bitcast_convert_type(x, wire_dtype)
+
+            def _unwire(x):
+                return jax.lax.bitcast_convert_type(x, dtype)
+        else:
+            wire_dtype = dtype
+
+            def _wire(x):
+                return x
+
+            def _unwire(x):
+                return x
+
         if rtype == 1:  # ALLGATHER: sizes = per-rank dims[P] + row_elems
             dims = [int(d) for d in sizes[:P]]
             row_elems = int(sizes[P])
             nm = names[0]
             a = inputs.get(nm)
-            max_rows = max(dims) if dims else 0
-            L = max_rows * row_elems
-            flat = jnp.zeros((L,), dtype=dtype)
+            me = ctl.rank()
+            offs = np.concatenate(
+                [[0], np.cumsum([d * row_elems for d in dims])])
+            L = int(offs[-1])
+            flat = jnp.zeros((max(L, 1),), dtype=wire_dtype)
             if a is not None and a.size:
-                flat = flat.at[: a.size].set(jnp.ravel(a))
-            gathered = _device_allreduce(flat, _identity, ctl)  # (P, L)
-            if gathered is None:
+                flat = flat.at[int(offs[me]):
+                               int(offs[me]) + a.size].set(
+                    _wire(jnp.ravel(a)))
+            summed = _device_allreduce(flat, _sum0, ctl)  # (L,) exact
+            if summed is None:
                 raise RuntimeError(
                     "device plane unavailable (no spanning JAX world)")
+            ctl._device_staged_bytes = flat.nbytes + summed.nbytes
             if a is None:
                 return {}
-            parts = [gathered[r, : dims[r] * row_elems]
-                     for r in range(P) if dims[r]]
-            out = jnp.concatenate(parts) if parts else \
-                jnp.zeros((0,), dtype=dtype)
-            out = out.reshape((sum(dims),) + tuple(a.shape[1:]))
+            out = _unwire(summed[:L]).reshape(
+                (sum(dims),) + tuple(a.shape[1:]))
             return {nm: out}
 
         if rtype == 3:  # ALLTOALL: sizes = split matrix[P*P] + row_elems
-            import jax
             mat = [int(v) for v in sizes[: P * P]]
             row_elems = int(sizes[P * P])
             nm = names[0]
             a = inputs.get(nm)
-            me = jax.process_index()
-            max_seg = max(mat) if mat else 0
-            L = P * max_seg * row_elems
-            flat = jnp.zeros((L,), dtype=dtype)
+            me = ctl.rank()
+            # Global layout grouped by destination: block d holds
+            # [seg(src0->d), seg(src1->d), ...]; every rank extracts its
+            # own (contiguous) destination block after the sum.
+            seg = [[mat[s * P + d] * row_elems for s in range(P)]
+                   for d in range(P)]
+            block_off = np.concatenate(
+                [[0], np.cumsum([sum(seg[d]) for d in range(P)])])
+            L = int(block_off[-1])
+            flat = jnp.zeros((max(L, 1),), dtype=wire_dtype)
             if a is not None and a.size:
-                av = jnp.ravel(a)
+                av = _wire(jnp.ravel(a))
                 off_in = 0
                 for d in range(P):
-                    seg = mat[me * P + d] * row_elems
-                    if seg:
-                        flat = flat.at[d * max_seg * row_elems:
-                                       d * max_seg * row_elems + seg].set(
-                            av[off_in: off_in + seg])
-                        off_in += seg
-            gathered = _device_allreduce(flat, _identity, ctl)  # (P, L)
-            if gathered is None:
+                    n_el = seg[d][me]
+                    if n_el:
+                        pos = int(block_off[d]) + sum(seg[d][:me])
+                        flat = flat.at[pos: pos + n_el].set(
+                            av[off_in: off_in + n_el])
+                        off_in += n_el
+            summed = _device_allreduce(flat, _sum0, ctl)  # (L,) exact
+            if summed is None:
                 raise RuntimeError(
                     "device plane unavailable (no spanning JAX world)")
+            ctl._device_staged_bytes = flat.nbytes + summed.nbytes
             if a is None:
                 return {}
-            parts = []
-            for src in range(P):
-                seg = mat[src * P + me] * row_elems
-                if seg:
-                    parts.append(
-                        gathered[src,
-                                 me * max_seg * row_elems:
-                                 me * max_seg * row_elems + seg])
-            out = jnp.concatenate(parts) if parts else \
-                jnp.zeros((0,), dtype=dtype)
+            start = int(block_off[me])
             total = sum(mat[src * P + me] for src in range(P))
-            out = out.reshape((total,) + tuple(a.shape[1:]))
+            out = _unwire(
+                summed[start: start + total * row_elems]).reshape(
+                (total,) + tuple(a.shape[1:]))
             recv_splits = np.array(
                 [mat[src * P + me] for src in range(P)], dtype=np.int32)
             return {nm: (out, recv_splits)}

@@ -354,6 +354,95 @@ def test_device_executor_failure_fails_all_ranks_no_hang():
                 p.join(timeout=10)
 
 
+def _skew_staging_worker(rank, size, ctl_port, jax_port, q):
+    """Skewed splits (rank 0 sends 1000x what rank 1 does): the device
+    alltoall/allgather staging must stay within ~2x the total payload —
+    exact-offset one-hot-sum staging, not P x max-segment padding
+    (VERDICT r3 #7)."""
+    sys.path.insert(0, REPO)
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{jax_port}",
+            num_processes=size, process_id=rank)
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import eager
+
+        os.environ["HVD_TPU_CONTROLLER_ADDR"] = f"127.0.0.1:{ctl_port}"
+        os.environ["HVD_TPU_RANK"] = str(rank)
+        os.environ["HVD_TPU_SIZE"] = str(size)
+        hvd.init()
+        ctl = eager._controller()
+
+        row_elems = 8
+        # Allgather skew: rank 0 contributes 1000 rows, rank 1 one row.
+        rows = 1000 if rank == 0 else 1
+        g = hvd.allgather(
+            jnp.full((rows, row_elems), float(rank), dtype=jnp.float32))
+        assert np.asarray(g).shape == (1001, row_elems)
+        assert float(np.asarray(g)[0, 0]) == 0.0
+        assert float(np.asarray(g)[1000, 0]) == 1.0
+        payload = 1001 * row_elems * 4
+        staged = ctl._device_staged_bytes
+        assert staged <= 2.5 * payload, (staged, payload)
+
+        # Alltoall skew: rank 0 sends 1000 rows to every dest, rank 1
+        # sends 1 row to every dest.
+        per_dest = 1000 if rank == 0 else 1
+        x = jnp.concatenate([
+            jnp.full((per_dest, row_elems), float(rank * 10 + d),
+                     dtype=jnp.float32) for d in range(size)])
+        out, recv = hvd.alltoall(x, splits=[per_dest] * size)
+        np.testing.assert_array_equal(np.asarray(recv), [1000, 1])
+        oa = np.asarray(out)
+        assert oa.shape == (1001, row_elems)
+        assert float(oa[0, 0]) == float(0 * 10 + rank)    # from rank 0
+        assert float(oa[1000, 0]) == float(1 * 10 + rank)  # from rank 1
+        total_payload = (1000 + 1) * size * row_elems * 4
+        staged = ctl._device_staged_bytes
+        assert staged <= 2.5 * total_payload, (staged, total_payload)
+
+        # Bit-exactness through the one-hot-sum wire: -0.0 must survive
+        # (float sum would fold it into +0.0; the uint bitcast wire
+        # cannot).
+        z = jnp.full((rank + 1, 2), -0.0, dtype=jnp.float32)
+        gz = np.asarray(hvd.allgather(z, name="negzero"))
+        assert gz.shape == (3, 2)
+        assert np.signbit(gz).all(), gz
+
+        ctl.shutdown()
+        q.put((rank, "ok", None))
+    except Exception:  # noqa: BLE001
+        import traceback
+        q.put((rank, "error", traceback.format_exc()[-2000:]))
+
+
+@pytest.mark.timeout(240)
+def test_skewed_splits_staging_bounded():
+    size = 2
+    ctl_port, jax_port = _free_port(), _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_skew_staging_worker,
+                         args=(r, size, ctl_port, jax_port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    try:
+        for _ in range(size):
+            rank, status, payload = q.get(timeout=180)
+            assert status == "ok", f"rank {rank}: {payload}"
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+
+
 def _watchdog_worker(rank, size, ctl_port, jax_port, stderr_path, q):
     """Rank 1 sleeps inside EXECUTE past the stall-warning window; rank 0
     (blocked in the post-execute agreement) must print the device-plane
