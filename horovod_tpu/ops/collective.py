@@ -212,8 +212,37 @@ def grouped_allreduce(tensors: Sequence,
     first = tensors[0] if tensors else None
     ctl = global_state.controller
     if first is not None and not _is_tracer(first) and ctl is not None:
+        from .eager import _ctl as _ctl_call, _is_device_array, \
+            _negotiated_device_ready
+        if all(_is_device_array(t) for t in tensors) and \
+                _negotiated_device_ready(ctl):
+            # Grouped DEVICE allreduce: all members enqueue together on
+            # the negotiated device plane, so placement-keyed fusion
+            # batches them into one fused HBM Response — no host copy.
+            base = name or ctl._auto_name("grouped", None).decode()
+
+            def _grouped_device():
+                handles = []
+                try:
+                    for i, t in enumerate(tensors):
+                        handles.append(ctl.allreduce_device_submit(
+                            t, op=int(op), prescale=prescale_factor,
+                            postscale=postscale_factor,
+                            name=f"{base}.{i}"))
+                    return [ctl.device_finish(*h) for h in handles]
+                except BaseException:
+                    # A submit failed mid-group (e.g. unsupported dtype
+                    # at member i): drain the already-submitted handles
+                    # so their native handles release and their staged
+                    # HBM inputs unpin, then re-raise the original.
+                    for h in handles:
+                        try:
+                            ctl.device_finish(*h)
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
+                    raise
+            return _ctl_call(_grouped_device)
         import numpy as _np
-        from .eager import _ctl as _ctl_call
         return _ctl_call(ctl.grouped_allreduce,
                          [_np.asarray(t) for t in tensors], op=int(op),
                          prescale=prescale_factor,

@@ -272,7 +272,7 @@ def _negotiated_executor(ctl):
                 flat = flat.at[int(offs[me]):
                                int(offs[me]) + a.size].set(
                     _wire(jnp.ravel(a)))
-            summed = _device_allreduce(flat, _sum0, ctl)  # (L,) exact
+            summed = _device_allreduce(flat, _sum0_samedtype, ctl)
             if summed is None:
                 raise RuntimeError(
                     "device plane unavailable (no spanning JAX world)")
@@ -308,7 +308,7 @@ def _negotiated_executor(ctl):
                         flat = flat.at[pos: pos + n_el].set(
                             av[off_in: off_in + n_el])
                         off_in += n_el
-            summed = _device_allreduce(flat, _sum0, ctl)  # (L,) exact
+            summed = _device_allreduce(flat, _sum0_samedtype, ctl)
             if summed is None:
                 raise RuntimeError(
                     "device plane unavailable (no spanning JAX world)")
@@ -468,6 +468,15 @@ def _identity(a):
 
 def _sum0(a):
     return a.sum(0)
+
+
+def _sum0_samedtype(a):
+    """Dtype-preserving stack sum for one-hot staging wires: jnp.sum
+    promotes narrow ints (uint16 -> uint32), and un-bitcasting a widened
+    wire would split every element in two.  The cast back is exact here
+    because each position holds exactly one rank's value (zeros
+    elsewhere), so the sum never exceeds the wire dtype."""
+    return a.sum(0).astype(a.dtype)
 
 
 def _run_global(fn, garr):

@@ -165,13 +165,21 @@ class HostPlatformPlan:
     """Resolved platform decision for one host's workers."""
     mode: str                      # "inherit" | "partition" | "cpu"
     chips: int = 0
-    # Per-launch jax.distributed coordinator port (partition mode only):
-    # allocated fresh so concurrent launches on a host don't join each
-    # other's worlds.
+    # Per-launch jax.distributed coordinator port (partition mode, or cpu
+    # mode with cpu_jax_world): allocated fresh so concurrent launches on
+    # a host don't join each other's worlds.
     jax_coord_port: int = 0
+    # HVD_TPU_CPU_JAX_WORLD=1: CPU-pinned workers also form a spanning
+    # jax.distributed world (one CPU device per process), so the eager
+    # negotiated device plane and compiled multi-process programs run
+    # without TPU hardware — the launcher-level analog of the test
+    # suite's hand-spawned jax.distributed worlds.  Single-host launches
+    # only (the world is sized to this host's local_size).
+    cpu_jax_world: bool = False
 
     def __post_init__(self):
-        if self.mode == "partition" and not self.jax_coord_port:
+        if not self.jax_coord_port and \
+                (self.mode == "partition" or self.cpu_jax_world):
             self.jax_coord_port = _free_port()
 
     def slot_env(self, local_rank: int, local_size: int,
@@ -185,8 +193,16 @@ class HostPlatformPlan:
             # spawn, e.g. elastic respawn): CPU-pin rather than letting N
             # workers contend for the same chips.
         if self.mode in ("cpu", "partition"):
-            return {"HVD_TPU_WORKER_PLATFORM": "cpu",
-                    "HVD_TPU_WORKER_CPU_DEVICES": "1"}
+            env = {"HVD_TPU_WORKER_PLATFORM": "cpu",
+                   "HVD_TPU_WORKER_CPU_DEVICES": "1"}
+            if self.cpu_jax_world:
+                env.update({
+                    "HVD_TPU_JAX_COORD_ADDR":
+                        f"{hostname}:{self.jax_coord_port}",
+                    "HVD_TPU_JAX_NUM_PROCS": str(local_size),
+                    "HVD_TPU_JAX_PROC_ID": str(local_rank),
+                })
+            return env
         return {}
 
 
@@ -200,8 +216,10 @@ def plan_host_platform(local_size: int, policy: str = "auto",
     workers), "tpu" (force inherit — the user takes responsibility for
     contention, e.g. an externally partitioned environment).
     """
+    import os
+    cpu_world = os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1"
     if policy == "cpu":
-        return HostPlatformPlan("cpu")
+        return HostPlatformPlan("cpu", cpu_jax_world=cpu_world)
     if chips is None or partitionable is None:
         chips, partitionable = local_chip_inventory()
     if policy == "tpu":
@@ -212,8 +230,11 @@ def plan_host_platform(local_size: int, policy: str = "auto",
         return HostPlatformPlan("inherit", chips)
     if (partitionable and chips >= local_size and
             partition_env(0, local_size, chips) is not None):
-        return HostPlatformPlan("partition", chips)
-    return HostPlatformPlan("cpu", chips)
+        # Carry the CPU-world opt-in: if the partition degrades to CPU
+        # pinning at spawn time (slot_env fallback), the user still gets
+        # the spanning jax world they asked for.
+        return HostPlatformPlan("partition", chips, cpu_jax_world=cpu_world)
+    return HostPlatformPlan("cpu", chips, cpu_jax_world=cpu_world)
 
 
 def needs_bootstrap(env: Dict[str, str]) -> bool:

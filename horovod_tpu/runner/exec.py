@@ -89,6 +89,15 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
             plans[slot.hostname] = chips_mod.plan_host_platform(
                 slot.local_size, platform_policy,
                 chips=chips, partitionable=part)
+    if len(plans) > 1 and os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1":
+        # The CPU jax world is sized per host (plan_host_platform has no
+        # cross-host view): on a multi-host launch each host would form
+        # its own world and compiled multi-process programs would reduce
+        # over one host's ranks only — silently wrong gradients.  Refuse.
+        raise RuntimeError(
+            "HVD_TPU_CPU_JAX_WORLD=1 supports single-host launches only "
+            f"(got {len(plans)} hosts); unset it, or use TPU partition "
+            "mode for a multi-host JAX world")
     workers = []
     for slot in slots:
         platform = plans[slot.hostname].slot_env(
