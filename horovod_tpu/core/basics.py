@@ -69,6 +69,16 @@ def init(mesh=None,
         global_state.local_size = elastic_assignment["local_size"]
         global_state.cross_rank = elastic_assignment["cross_rank"]
         global_state.cross_size = elastic_assignment["cross_size"]
+        # Elastic device plane: the driver publishes a fresh jax
+        # coordinator per round; every worker (survivor or respawn)
+        # rebuilds its jax.distributed world to the round's topology so
+        # HBM-resident eager tensors keep riding the negotiated device
+        # plane across failures (SURVEY §7.3 "Elastic on TPU").
+        jax_addr = elastic_assignment.get("jax_coord_addr")
+        if jax_addr:
+            from ..runner.bootstrap import rebuild_jax_world
+            rebuild_jax_world(jax_addr, global_state.size,
+                              global_state.rank)
 
     env_rank = _env_int("RANK")
     env_size = _env_int("SIZE")

@@ -67,6 +67,131 @@ def apply_jax_distributed() -> None:
         raise SystemExit(1)
 
 
+# True when the current jax world's client was built by _raw_init_world
+# (shutdown_on_destruction=False: dropping the client is silent).
+_RAW_WORLD = False
+
+
+def _raw_init_world(addr: str, num_processes: int, process_id: int,
+                    timeout: int = 60) -> bool:
+    """Build the jax distributed client/service directly with ELASTIC
+    semantics the public initialize() does not expose:
+    ``shutdown_on_destruction=False`` (a worker whose coordinator died
+    must exit silently, not LOG(FATAL) from the client destructor's
+    ShutdownTask RPC) and a no-op missed-heartbeat callback (heartbeat
+    loss is the elastic NORMAL case, surfaced via collective errors and
+    handled by restore + re-init — not grounds for process suicide).
+    Returns False when the private jaxlib API has drifted (caller falls
+    back to the public path)."""
+    global _RAW_WORLD
+    from jax._src import distributed as _jd
+    try:
+        from jaxlib import _jax as _jaxlib
+        # Client first: constructing the service binds the coordinator
+        # port, and leaking a bound service on client-construction API
+        # drift would make the public-API fallback fail with
+        # address-in-use on rank 0.
+        client = _jaxlib.get_distributed_runtime_client(
+            addr, process_id, init_timeout=timeout,
+            use_compression=True,
+            shutdown_on_destruction=False, recoverable=True)
+        service = None
+        if process_id == 0:
+            bind = "[::]:" + addr.rsplit(":", 1)[1]
+            service = _jaxlib.get_distributed_runtime_service(
+                bind, num_processes)
+    except (AttributeError, TypeError):
+        return False
+    st = _jd.global_state
+    st.coordinator_address = addr
+    st.process_id = process_id
+    st.num_processes = num_processes
+    st.service = service
+    st.client = client
+    client.connect()  # real errors (peers missing, port taken) propagate
+    _RAW_WORLD = True
+    return True
+
+
+def rebuild_jax_world(addr: str, num_processes: int,
+                      process_id: int) -> None:
+    """(Re)build this process's jax.distributed world for an elastic round
+    — the SURVEY §7.3 hard part: the reference's cheap ``shutdown();
+    init()`` reset becomes a backend re-initialization here.
+
+    Fresh processes just initialize.  Survivors of a previous round tear
+    down the old world first: drop the distributed client WITHOUT a
+    shutdown RPC (the old world's coordinator may be the dead peer; a
+    failed ShutdownTask RPC is a C++ LOG(FATAL)), clear the backend cache
+    (device list and process count are baked into the old backend), the
+    compiled-computation cache, and the eager plane's process-mesh/jit
+    caches (their out_shardings bake in the old mesh).  CPU/TPU both go
+    through the same path; on TPU the backend rebuild is the expensive
+    step the reference never pays (libtpu re-init).
+    """
+    global _RAW_WORLD
+    import jax
+    from jax._src import distributed as _jd
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except Exception:
+        pass  # older jax: no such flag (only matters for the fallback)
+    st = _jd.global_state
+    if st.client is not None:
+        if _RAW_WORLD:
+            # Ordered teardown.  The client's error-poll thread
+            # LOG(FATAL)s the process the moment its gRPC channel to the
+            # coordinator breaks, so: (1) every process explicitly
+            # disconnects its client FIRST, while the old service is
+            # still up (clean ShutdownTask; stops the poll thread); a
+            # failure here means the old coordinator is already dead and
+            # this process is doomed anyway — swallow and hope the reset
+            # outruns the poll thread.  (2) The old coordinator delays
+            # its service teardown so peers' disconnects land before the
+            # service starts cancelling calls.  Coordinator death itself
+            # is NOT survivable in-process (the poll fatal fires within
+            # ~1s); the driver's cascade leniency respawns the round.
+            try:
+                st.client.shutdown()
+            except Exception as e:  # noqa: BLE001 — coordinator gone
+                print(f"[hvd_tpu bootstrap] old jax client shutdown: {e}",
+                      file=sys.stderr)
+            st.client = None
+            if st.service is not None:
+                import time as _time
+                _time.sleep(1.0)  # let peers' ShutdownTask RPCs land
+                st.service.shutdown()
+                st.service = None
+            st.coordinator_address = None
+            st.process_id = None
+            st.num_processes = None
+            _RAW_WORLD = False
+        else:
+            # Public-API world: best effort — the shutdown RPC can
+            # LOG(FATAL) if the coordinator is unreachable.
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001 — half-dead world
+                print(f"[hvd_tpu bootstrap] old jax world shutdown: {e}",
+                      file=sys.stderr)
+        try:
+            from jax._src import xla_bridge as _xb
+            _xb._clear_backends()
+        except Exception as e:
+            raise RuntimeError(
+                "cannot rebuild the jax backend for the new elastic "
+                f"round (jax internals changed?): {e}") from e
+        jax.clear_caches()
+        from ..ops import eager
+        eager._cached_process_mesh.cache_clear()
+        eager._jitted_global.cache_clear()
+        eager._jitted_local.cache_clear()
+    if not _raw_init_world(addr, num_processes, process_id):
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=num_processes,
+            process_id=process_id, initialization_timeout=60)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--":

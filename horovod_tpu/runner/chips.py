@@ -208,7 +208,8 @@ class HostPlatformPlan:
 
 def plan_host_platform(local_size: int, policy: str = "auto",
                        chips: Optional[int] = None,
-                       partitionable: Optional[bool] = None
+                       partitionable: Optional[bool] = None,
+                       cpu_jax_world: Optional[bool] = None
                        ) -> HostPlatformPlan:
     """Decide how ``local_size`` workers on one host share its chips.
 
@@ -216,8 +217,8 @@ def plan_host_platform(local_size: int, policy: str = "auto",
     workers), "tpu" (force inherit — the user takes responsibility for
     contention, e.g. an externally partitioned environment).
     """
-    import os
-    cpu_world = os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1"
+    cpu_world = (os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1"
+                 if cpu_jax_world is None else cpu_jax_world)
     if policy == "cpu":
         return HostPlatformPlan("cpu", cpu_jax_world=cpu_world)
     if chips is None or partitionable is None:

@@ -126,6 +126,11 @@ class ElasticDriver:
         self._gen: Dict[str, int] = {}
         self._shutdown = threading.Event()
         self._finished: Dict[str, int] = {}
+        # Cascade-failure leniency (see _on_worker_exit): failures within
+        # this window of the previous failure respawn without blacklist.
+        self._last_failure_ts: Optional[float] = None
+        self._cascade_grace_s = float(os.environ.get(
+            "HVD_TPU_ELASTIC_CASCADE_GRACE", "10"))
         self._succeeded = False  # any worker exited 0: job is completing
         self._result: Optional[int] = None
         self._result_cv = threading.Condition()
@@ -231,6 +236,14 @@ class ElasticDriver:
                     "cross_rank": s.cross_rank, "cross_size": s.cross_size,
                 } for s in slots},
             }
+            # Elastic device plane (HVD_TPU_CPU_JAX_WORLD=1, all-local
+            # hosts): a fresh jax.distributed coordinator per round; the
+            # round's rank 0 binds it, every worker rebuilds its world to
+            # the round topology in init() (core/basics.py).
+            if os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1" and \
+                    all(exec_mod._is_local(h.hostname) for h in hosts):
+                from .chips import _free_port
+                assignment["jax_coord_addr"] = f"127.0.0.1:{_free_port()}"
             self._rendezvous.put("elastic", f"round.{self._round}",
                                  json.dumps(assignment).encode())
             self._rendezvous.put("elastic", "current_round",
@@ -272,6 +285,17 @@ class ElasticDriver:
         env["HVD_TPU_ELASTIC_SLOT"] = sid
         env["HVD_TPU_HOSTNAME"] = s.hostname
         env["HOROVOD_HOSTNAME"] = s.hostname
+        # The per-round jax world comes from the assignment (see
+        # _start_round), not from the launcher's static slot env — a
+        # static world sized at spawn time would be wrong after the
+        # first re-rendezvous.
+        env["HVD_TPU_CPU_JAX_WORLD"] = "0"
+        # An elastic CPU jax world implies CPU-pinned workers: with one
+        # slot per host the auto policy would let workers inherit the
+        # host platform (possibly a TPU tunnel), and the per-round world
+        # rebuild assumes a rebuildable backend.
+        policy = ("cpu" if os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1"
+                  else self._platform_policy)
         self._gen[sid] = gen = self._gen.get(sid, 0) + 1
         # Any scale-down marker belongs to a superseded generation; the
         # replacement's exits are real events.
@@ -281,10 +305,11 @@ class ElasticDriver:
             extra_env=env,
             on_exit=lambda slot, code, sid=sid, gen=gen:
                 self._on_worker_exit(sid, gen, slot, code),
-            platform_policy=self._platform_policy,
+            platform_policy=policy,
             ssh_identity_file=self._ssh_identity_file,
             output_dir=self._output_dir,
-            prefix_timestamp=self._prefix_timestamp)
+            prefix_timestamp=self._prefix_timestamp,
+            cpu_jax_world=False)
         self._workers[sid] = ws[0]
 
     def _on_worker_exit(self, sid: str, gen: int, slot: SlotInfo,
@@ -327,11 +352,28 @@ class ElasticDriver:
                     self._set_result(0)
                 return
             # Failure: blacklist the host (reference registration.py) and
-            # re-rendezvous with the survivors.
-            self._blacklist.add(slot.hostname)
+            # re-rendezvous with the survivors.  CASCADE exception: a
+            # failure arriving shortly after another failure is usually
+            # collateral damage of the first (a peer death can fatally
+            # terminate survivors whose jax coordination client observes
+            # the broken world before the elastic reset reaches them) —
+            # respawn the worker on its host without condemning the host.
+            now = time.monotonic()
+            cascade = (self._last_failure_ts is not None and
+                       now - self._last_failure_ts <
+                       self._cascade_grace_s)
+            if not cascade:
+                # Anchor the window at the blacklisting failure (a
+                # sliding window would let a fast crash-looper read as
+                # an endless cascade and never trip blacklist/min-np).
+                self._last_failure_ts = now
+                self._blacklist.add(slot.hostname)
             if self._verbose:
                 print(f"[elastic] worker {sid} failed (exit {code}); "
-                      f"blacklisting {slot.hostname}")
+                      + (f"cascade within "
+                         f"{self._cascade_grace_s:.0f}s - host kept"
+                         if cascade else
+                         f"blacklisting {slot.hostname}"))
             if self._bump_reset():
                 return
             try:

@@ -72,7 +72,9 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                    ssh_port: Optional[int] = None,
                    ssh_identity_file: Optional[str] = None,
                    output_dir: Optional[str] = None,
-                   prefix_timestamp: bool = False) -> List[WorkerProcess]:
+                   prefix_timestamp: bool = False,
+                   cpu_jax_world: Optional[bool] = None
+                   ) -> List[WorkerProcess]:
     """Start one process per slot; returns immediately with handles.
 
     ``platform_policy`` decides how each host's workers share its TPU chips
@@ -88,8 +90,9 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                 slot.hostname, _is_local(slot.hostname))
             plans[slot.hostname] = chips_mod.plan_host_platform(
                 slot.local_size, platform_policy,
-                chips=chips, partitionable=part)
-    if len(plans) > 1 and os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1":
+                chips=chips, partitionable=part,
+                cpu_jax_world=cpu_jax_world)
+    if len(plans) > 1 and any(p.cpu_jax_world for p in plans.values()):
         # The CPU jax world is sized per host (plan_host_platform has no
         # cross-host view): on a multi-host launch each host would form
         # its own world and compiled multi-process programs would reduce

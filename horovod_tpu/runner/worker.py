@@ -54,6 +54,8 @@ def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
                             "size": assignment["size"],
                             "controller_addr":
                                 assignment["controller_addr"],
+                            "jax_coord_addr":
+                                assignment.get("jax_coord_addr"),
                             **mine,
                         }
         time.sleep(poll_interval)

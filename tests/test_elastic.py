@@ -118,6 +118,95 @@ def test_elastic_completes_without_failures(tmp_path):
     assert all(e["size"] == 2 and e["sum"] == 3.0 for e in events)
 
 
+DEVICE_ELASTIC_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+    from horovod_tpu.ops import eager
+
+    LOG = {log!r}
+    FAIL_SLOT = {fail_slot!r}
+    FAIL_EPOCH = {fail_epoch}
+
+    hvd.init()
+
+    state = elastic.ObjectState(epoch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < {epochs}:
+            ctl = eager._controller()
+            engaged = ctl is not None and \\
+                eager._negotiated_device_ready(ctl) and \\
+                jax.process_count() == hvd.size()
+            if (FAIL_SLOT and
+                    os.environ.get("HVD_TPU_ELASTIC_SLOT") == FAIL_SLOT
+                    and state.epoch == FAIL_EPOCH):
+                os._exit(1)  # die with peers' device tensors in flight
+            x = jnp.full((4,), float(hvd.rank() + 1), dtype=jnp.float32)
+            out = hvd.allreduce(x, op=hvd.Sum,
+                                name=f"dev.{{state.epoch}}")
+            is_dev = isinstance(out, jax.Array)
+            state.total += float(np.asarray(out)[0])
+            with open(LOG + f".{{os.environ['HVD_TPU_ELASTIC_SLOT']}}",
+                      "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size(), "engaged": engaged,
+                    "device": is_dev, "jax_world": jax.process_count(),
+                    "sum": float(np.asarray(out)[0])}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+    train(state)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(300)
+def test_elastic_recovery_with_device_plane_engaged(tmp_path):
+    """VERDICT r3 #3: kill a worker while negotiated DEVICE tensors are in
+    flight; survivors get HorovodInternalError, state restores, the
+    relaunched world re-initializes jax.distributed at the new size (the
+    driver publishes a fresh jax coordinator per round), the device plane
+    re-engages, and device collectives resume."""
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(DEVICE_ELASTIC_WORKER.format(
+        repo=REPO, log=log, fail_slot="127.0.0.1:0", fail_epoch=1,
+        epochs=4))
+    hosts = [HostInfo("localhost", 1), HostInfo("127.0.0.1", 1),
+             HostInfo(__import__("socket").gethostname(), 1)]
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    os.environ["HVD_TPU_CPU_JAX_WORLD"] = "1"
+    try:
+        driver = ElasticDriver(
+            FixedHosts(hosts), [sys.executable, str(script)],
+            min_np=2, max_np=3, controller_base_port=28700, verbose=True)
+        rc = driver.run()
+    finally:
+        os.environ.pop("HVD_TPU_CPU_JAX_WORLD", None)
+    assert rc == 0
+    slots = [f"{h.hostname}:0" for h in hosts]
+    events = _read_logs(log, slots)
+    # Epoch 0 ran at size 3 with the device plane engaged.
+    ep0 = [e for e in events if e["epoch"] == 0]
+    assert ep0 and all(e["size"] == 3 and e["engaged"] and e["device"]
+                       and e["jax_world"] == 3 for e in ep0), ep0
+    # After the failure the world rebuilt at size 2 — jax.distributed
+    # re-initialized in-process on the survivors — and the device plane
+    # RE-engaged (still jax.Array outputs, spanning 2-world).
+    finals = [e for e in events if e["epoch"] == 3]
+    assert finals and all(
+        e["size"] == 2 and e["engaged"] and e["device"]
+        and e["jax_world"] == 2 for e in finals), finals
+    for e in finals:
+        assert e["sum"] == pytest.approx(3.0)  # ranks 0,1 -> 1+2
+
+
 SCALEUP_WORKER = textwrap.dedent("""
     import json, os, sys, time
     sys.path.insert(0, {repo!r})
