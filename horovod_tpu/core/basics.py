@@ -79,6 +79,13 @@ def init(mesh=None,
             from ..runner.bootstrap import rebuild_jax_world
             rebuild_jax_world(jax_addr, global_state.size,
                               global_state.rank)
+        else:
+            # The round declares no jax world (e.g. the host set stopped
+            # being all-local): a survivor must not keep a stale one —
+            # its process count is wrong and its error poller dies with
+            # old peers.  No-op when no world exists.
+            from ..runner.bootstrap import teardown_jax_world
+            teardown_jax_world()
 
     env_rank = _env_int("RANK")
     env_size = _env_int("SIZE")

@@ -125,6 +125,7 @@ def load_library():
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
     lib.hvd_native_adasum_scratch_peak.restype = ctypes.c_int64
+    lib.hvd_native_last_fused_names.restype = ctypes.c_int64
     lib.hvd_native_counters.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
     lib.hvd_native_allreduce_device.restype = ctypes.c_int64
@@ -638,6 +639,11 @@ class NativeController:
     def barrier(self):
         if self._lib.hvd_native_barrier() != 0:
             raise NativeError(self._last_error())
+
+    def last_fused_names(self) -> int:
+        """Names in the most recent (possibly fused) allreduce Response —
+        live evidence of the current fusion threshold (autotune)."""
+        return self._lib.hvd_native_last_fused_names()
 
     def last_allgather_schedule(self) -> int:
         """0 = flat ring, 1 = hierarchical (most recent allgather)."""

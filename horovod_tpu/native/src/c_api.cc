@@ -214,6 +214,12 @@ int hvd_native_last_allgather_schedule() {
 int64_t hvd_native_adasum_scratch_peak() { return AdasumScratchPeak(); }
 void hvd_native_adasum_scratch_reset() { ResetAdasumScratchPeak(); }
 
+// Names in the most recent (possibly fused) allreduce Response executed
+// by this rank — live evidence of the current fusion threshold.
+int64_t hvd_native_last_fused_names() {
+  return Runtime::Get().LastFusedNames();
+}
+
 void hvd_native_set_params(int64_t fusion_threshold, double cycle_time_ms) {
   Runtime::Get().SetParams(fusion_threshold, cycle_time_ms);
 }

@@ -569,6 +569,7 @@ void Runtime::DeviceWatchdog() {
 void Runtime::ExecuteAllreduce(
     const Response& resp,
     std::vector<std::shared_ptr<TensorEntry>>& entries) {
+  last_fused_names_ = static_cast<int64_t>(resp.names.size());
   if (resp.device) {
     ExecuteDeviceCollective(resp, entries);
     return;

@@ -97,6 +97,10 @@ class Runtime {
   void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();
+  // Test/observability hook: names in the most recent (possibly fused)
+  // allreduce Response this rank executed — shows the live fusion
+  // threshold's effect (autotune integration evidence).
+  int64_t LastFusedNames() const { return last_fused_names_.load(); }
 
  private:
   Runtime() = default;
@@ -163,6 +167,7 @@ class Runtime {
   bool hierarchical_allreduce_ = false;
   bool hierarchical_allgather_ = false;
   std::atomic<DeviceExecutorFn> device_executor_{nullptr};
+  std::atomic<int64_t> last_fused_names_{0};
   std::chrono::steady_clock::time_point counter_start_;
   Timeline timeline_;
   Status loop_error_;

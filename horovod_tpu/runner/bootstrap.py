@@ -100,42 +100,34 @@ def _raw_init_world(addr: str, num_processes: int, process_id: int,
             bind = "[::]:" + addr.rsplit(":", 1)[1]
             service = _jaxlib.get_distributed_runtime_service(
                 bind, num_processes)
-    except (AttributeError, TypeError):
-        return False
+    except (ImportError, AttributeError, TypeError):
+        return False  # private API drift: public fallback
+    # Connect BEFORE publishing into jax's global state: a failed connect
+    # (peer missing, port taken) must not leave a half-initialized world
+    # behind — dropping the locals unbinds the service and silently
+    # drops the never-connected client (shutdown_on_destruction=False).
+    client.connect()  # real errors propagate to the caller
     st = _jd.global_state
     st.coordinator_address = addr
     st.process_id = process_id
     st.num_processes = num_processes
     st.service = service
     st.client = client
-    client.connect()  # real errors (peers missing, port taken) propagate
     _RAW_WORLD = True
     return True
 
 
-def rebuild_jax_world(addr: str, num_processes: int,
-                      process_id: int) -> None:
-    """(Re)build this process's jax.distributed world for an elastic round
-    — the SURVEY §7.3 hard part: the reference's cheap ``shutdown();
-    init()`` reset becomes a backend re-initialization here.
-
-    Fresh processes just initialize.  Survivors of a previous round tear
-    down the old world first: drop the distributed client WITHOUT a
-    shutdown RPC (the old world's coordinator may be the dead peer; a
-    failed ShutdownTask RPC is a C++ LOG(FATAL)), clear the backend cache
-    (device list and process count are baked into the old backend), the
-    compiled-computation cache, and the eager plane's process-mesh/jit
-    caches (their out_shardings bake in the old mesh).  CPU/TPU both go
-    through the same path; on TPU the backend rebuild is the expensive
-    step the reference never pays (libtpu re-init).
-    """
+def teardown_jax_world() -> None:
+    """Tear down the current jax.distributed world (ordered
+    client/service teardown + backend and cache clears).  Safe no-op
+    when no world exists.  Used by the elastic init path both before a
+    rebuild and when a round no longer declares a jax world (e.g. the
+    host set stopped being all-local): survivors must NOT keep a stale
+    world — its process count is wrong and its error-poll thread would
+    LOG(FATAL) when old peers die."""
     global _RAW_WORLD
     import jax
     from jax._src import distributed as _jd
-    try:
-        jax.config.update("jax_enable_recoverability", True)
-    except Exception:
-        pass  # older jax: no such flag (only matters for the fallback)
     st = _jd.global_state
     if st.client is not None:
         if _RAW_WORLD:
@@ -186,6 +178,26 @@ def rebuild_jax_world(addr: str, num_processes: int,
         eager._cached_process_mesh.cache_clear()
         eager._jitted_global.cache_clear()
         eager._jitted_local.cache_clear()
+
+
+def rebuild_jax_world(addr: str, num_processes: int,
+                      process_id: int) -> None:
+    """(Re)build this process's jax.distributed world for an elastic round
+    — the SURVEY §7.3 hard part: the reference's cheap ``shutdown();
+    init()`` reset becomes a backend re-initialization here.
+
+    Fresh processes just initialize.  Survivors of a previous round run
+    ``teardown_jax_world`` first (ordered client/service teardown; the
+    device list and process count are baked into the old backend, and
+    the eager plane's mesh/jit caches bake in the old mesh).  CPU/TPU
+    both go through the same path; on TPU the backend rebuild is the
+    expensive step the reference never pays (libtpu re-init)."""
+    import jax
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except Exception:
+        pass  # older jax: no such flag (only matters for the fallback)
+    teardown_jax_world()
     if not _raw_init_world(addr, num_processes, process_id):
         jax.distributed.initialize(
             coordinator_address=addr, num_processes=num_processes,

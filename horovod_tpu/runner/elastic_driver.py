@@ -240,10 +240,20 @@ class ElasticDriver:
             # hosts): a fresh jax.distributed coordinator per round; the
             # round's rank 0 binds it, every worker rebuilds its world to
             # the round topology in init() (core/basics.py).
-            if os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1" and \
-                    all(exec_mod._is_local(h.hostname) for h in hosts):
-                from .chips import _free_port
-                assignment["jax_coord_addr"] = f"127.0.0.1:{_free_port()}"
+            if os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1":
+                if all(exec_mod._is_local(h.hostname) for h in hosts):
+                    from .chips import _free_port
+                    assignment["jax_coord_addr"] = \
+                        f"127.0.0.1:{_free_port()}"
+                else:
+                    # The opt-in cannot span remote hosts (the jax
+                    # coordinator is published on loopback); be loud —
+                    # a silent no-world would read as a 1-process jax
+                    # world on every rank.
+                    print("[elastic] WARNING: HVD_TPU_CPU_JAX_WORLD=1 "
+                          "ignored for this round: host set includes "
+                          "remote hosts; workers run without a "
+                          "spanning jax world", flush=True)
             self._rendezvous.put("elastic", f"round.{self._round}",
                                  json.dumps(assignment).encode())
             self._rendezvous.put("elastic", "current_round",
@@ -362,18 +372,32 @@ class ElasticDriver:
             cascade = (self._last_failure_ts is not None and
                        now - self._last_failure_ts <
                        self._cascade_grace_s)
-            if not cascade:
-                # Anchor the window at the blacklisting failure (a
-                # sliding window would let a fast crash-looper read as
-                # an endless cascade and never trip blacklist/min-np).
-                self._last_failure_ts = now
-                self._blacklist.add(slot.hostname)
+            if cascade:
+                # Collateral exit of the incident already being handled:
+                # no blacklist, no reset charge, no fresh round (each
+                # collateral exit publishing a new round would churn
+                # survivors mid-reconnect and burn the reset budget per
+                # worker of a single incident) — just respawn this slot
+                # into the CURRENT round, whose assignment still
+                # includes it.
+                if self._verbose:
+                    print(f"[elastic] worker {sid} failed (exit {code});"
+                          f" cascade within {self._cascade_grace_s:.0f}s"
+                          " - respawning into the current round")
+                np_ = sum(h.slots for h in self._current_hosts)
+                for s2 in get_host_assignments(self._current_hosts, np_):
+                    if self._slot_id(s2) == sid:
+                        self._spawn(s2)
+                        break
+                return
+            # Anchor the window at the blacklisting failure (a sliding
+            # window would let a fast crash-looper read as an endless
+            # cascade and never trip blacklist/min-np).
+            self._last_failure_ts = now
+            self._blacklist.add(slot.hostname)
             if self._verbose:
                 print(f"[elastic] worker {sid} failed (exit {code}); "
-                      + (f"cascade within "
-                         f"{self._cascade_grace_s:.0f}s - host kept"
-                         if cascade else
-                         f"blacklisting {slot.hostname}"))
+                      f"blacklisting {slot.hostname}")
             if self._bump_reset():
                 return
             try:

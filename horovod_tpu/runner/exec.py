@@ -92,7 +92,10 @@ def launch_workers(slots: List[SlotInfo], command: List[str],
                 slot.local_size, platform_policy,
                 chips=chips, partitionable=part,
                 cpu_jax_world=cpu_jax_world)
-    if len(plans) > 1 and any(p.cpu_jax_world for p in plans.values()):
+    want_cpu_world = (os.environ.get("HVD_TPU_CPU_JAX_WORLD") == "1"
+                      if cpu_jax_world is None else cpu_jax_world)
+    if len(plans) > 1 and (want_cpu_world or
+                           any(p.cpu_jax_world for p in plans.values())):
         # The CPU jax world is sized per host (plan_host_platform has no
         # cross-host view): on a multi-host launch each host would form
         # its own world and compiled multi-process programs would reduce
