@@ -33,7 +33,10 @@ class _EstimatorParams:
                  label_cols: Sequence[str] = ("label",),
                  validation: Optional[float] = None,
                  run_id: Optional[str] = None,
-                 verbose: int = 1):
+                 verbose: int = 1,
+                 shuffle: bool = True,
+                 shuffle_seed: int = 0,
+                 prefetch: int = 2):
         if store is None:
             raise ValueError("an Estimator requires a store= (Store.create "
                              "or LocalStore) for intermediate data and "
@@ -47,6 +50,12 @@ class _EstimatorParams:
         self.validation = validation
         self.run_id = run_id or "run_" + uuid.uuid4().hex[:8]
         self.verbose = verbose
+        # Feed behavior (the Petastorm roles, reference
+        # spark/keras/remote.py:102): per-epoch seeded row-group shuffle
+        # identical across ranks, and async read-ahead depth.
+        self.shuffle = bool(shuffle)
+        self.shuffle_seed = int(shuffle_seed)
+        self.prefetch = int(prefetch)
 
     def _materialize(self, df):
         """DataFrame → (train_path, val_path|None) parquet in the store
@@ -89,10 +98,12 @@ class _EstimatorParams:
 
 
 def _rank_local_batches(store, path, feature_cols, label_cols, rank, size,
-                        chunk_rows=65536):
+                        chunk_rows=65536, epoch=0, shuffle_seed=None,
+                        prefetch=0):
     """Rank-local (X, y) chunks from the store feed.  Stores implementing
     the sharded reader (rank=/size= kwargs) yield rank-local data with a
-    lockstep chunk schedule; legacy user Store subclasses overriding the
+    lockstep chunk schedule — plus per-epoch seeded shuffle and async
+    prefetch when supported; legacy user Store subclasses overriding the
     old iter_array_batches signature fall back to shared reads + strided
     row slicing (the pre-sharding behavior)."""
     import inspect
@@ -101,9 +112,13 @@ def _rank_local_batches(store, path, feature_cols, label_cols, rank, size,
     except (TypeError, ValueError):  # builtins / exotic callables
         params = {}
     if "rank" in params and "size" in params:
+        extra = {}
+        if "epoch" in params:
+            extra = {"epoch": epoch, "shuffle_seed": shuffle_seed,
+                     "prefetch": prefetch}
         yield from store.iter_array_batches(
             path, feature_cols, label_cols, chunk_rows=chunk_rows,
-            rank=rank, size=size)
+            rank=rank, size=size, **extra)
         return
     # Legacy override: pass only the kwargs its signature accepts.
     legacy_kw = {"chunk_rows": chunk_rows} if "chunk_rows" in params else {}
@@ -152,9 +167,12 @@ class KerasEstimator(_EstimatorParams):
                       metrics=self.metrics or None)
         callbacks = [hvd_keras.callbacks.
                      BroadcastGlobalVariablesCallback(0)]
+        # shuffle= honors the estimator-level feed knob (the in-memory
+        # keras path shuffles rows via model.fit itself; prefetch is
+        # moot here — the arrays are already resident).
         model.fit(x, y, batch_size=self.batch_size, epochs=self.epochs,
                   validation_data=val, verbose=self.verbose,
-                  callbacks=callbacks)
+                  shuffle=self.shuffle, callbacks=callbacks)
 
         import tempfile, os, pathlib
         with tempfile.TemporaryDirectory() as td:
@@ -239,6 +257,8 @@ class TorchEstimator(_EstimatorParams):
             "train_path": train_path,
             "feature_cols": self.feature_cols,
             "label_cols": self.label_cols,
+            "shuffle_seed": self.shuffle_seed if self.shuffle else None,
+            "prefetch": self.prefetch,
         }
         if self.num_proc and self.num_proc > 1:
             # Data-parallel fit: one local rank per process, batches
@@ -305,13 +325,16 @@ def _torch_train_loop(spec) -> None:
 
     g = torch.Generator().manual_seed(13)
     chunk_rows = int(spec.get("chunk_rows") or 65536)
-    for _ in range(spec["epochs"]):
+    for epoch in range(spec["epochs"]):
         # The feed yields rank-local chunks (per-rank sharded reads with
         # an identical chunk schedule on every rank; legacy Store
-        # overrides fall back to shared reads + strided rows).
+        # overrides fall back to shared reads + strided rows), traversed
+        # in a fresh seeded order each epoch with async read-ahead.
         for x, y in _rank_local_batches(
                 store, spec["train_path"], spec["feature_cols"],
-                spec["label_cols"], rank, size, chunk_rows=chunk_rows):
+                spec["label_cols"], rank, size, chunk_rows=chunk_rows,
+                epoch=epoch, shuffle_seed=spec.get("shuffle_seed"),
+                prefetch=spec.get("prefetch", 0)):
             n_local = len(x)
             if n_local == 0:
                 continue
@@ -380,6 +403,8 @@ class LightningEstimator(_EstimatorParams):
             "train_path": train_path,
             "feature_cols": self.feature_cols,
             "label_cols": self.label_cols,
+            "shuffle_seed": self.shuffle_seed if self.shuffle else None,
+            "prefetch": self.prefetch,
         }
         if self.num_proc and self.num_proc > 1:
             from ..runner import run as _run
@@ -427,10 +452,12 @@ def _lightning_train_loop(spec) -> None:
 
     g = torch.Generator().manual_seed(13)
     batch_idx = 0
-    for _ in range(spec["epochs"]):
+    for epoch in range(spec["epochs"]):
         for x, y in _rank_local_batches(
                 store, spec["train_path"], spec["feature_cols"],
-                spec["label_cols"], rank, size):
+                spec["label_cols"], rank, size,
+                epoch=epoch, shuffle_seed=spec.get("shuffle_seed"),
+                prefetch=spec.get("prefetch", 0)):
             n_local = len(x)
             if n_local == 0:
                 continue

@@ -28,6 +28,78 @@ from typing import Optional
 
 import numpy as np
 
+# pyarrow's bundled mimalloc segfaults in mi_thread_init when arrow is
+# first exercised from a freshly-created Python thread in processes with
+# certain loader states (observed: spawn workers of a pytest parent;
+# kernel log points the fault into libarrow's mi_thread_init).  The async
+# prefetch reader is exactly such a thread, so default arrow to the
+# system allocator before any pyarrow import can pick a pool.  Explicitly
+# set ARROW_DEFAULT_MEMORY_POOL env wins over this default.
+os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
+
+def _prefetch_iter(gen, depth: int):
+    """Run ``gen`` on a background thread through a bounded queue of
+    ``depth`` items: the next chunk's (possibly remote) store reads
+    overlap the consumer's compute.  Exceptions re-raise at the consuming
+    site.  Abandoning the iterator (consumer raised mid-epoch /
+    generator closed) stops the reader promptly via a cancellation flag
+    — a reader permanently parked on the bounded queue would leak the
+    thread plus ``depth`` buffered chunks per retried fit."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def reader():
+        try:
+            for item in gen:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            if not stop.is_set():
+                q.put(e)
+
+    t = threading.Thread(target=reader, daemon=True,
+                         name="hvd-store-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # Closing `gen` from this thread would race the reader executing
+        # it (generators are single-threaded); the flag makes the reader
+        # exit at its next put and the generator unwinds with its thread.
+        stop.set()
+
+
+_WARNED_NO_PREFETCH = False
+
+
+def _arrow_background_thread_safe() -> bool:
+    """True when arrow's default pool is not mimalloc (the module-import
+    env default took effect, or the user picked another pool): exercising
+    arrow from a fresh Python thread is then safe."""
+    try:
+        import pyarrow as pa
+        return pa.default_memory_pool().backend_name != "mimalloc"
+    except Exception:  # noqa: BLE001 — no arrow / no backend_name attr
+        return True
+
 
 class Store:
     """Base class: path layout + parquet materialization."""
@@ -125,7 +197,9 @@ class Store:
 
     def iter_array_batches(self, path: str, feature_cols, label_cols,
                            chunk_rows: int = 65536, rank: int = 0,
-                           size: int = 1):
+                           size: int = 1, epoch: int = 0,
+                           shuffle_seed: Optional[int] = None,
+                           prefetch: int = 0):
         """Stream (X, y) float32 chunks from the parquet files under
         ``path`` without loading the dataset into memory.
 
@@ -136,14 +210,58 @@ class Store:
         Either way every rank yields chunks of identical sizes (fixed
         ``chunk_rows``, truncated to the common per-rank row count), so
         per-batch blocking collectives across ranks stay in lockstep.
+
+        ``shuffle_seed`` enables a per-``epoch`` seeded permutation of the
+        row-group unit schedule (the Petastorm shuffle role,
+        reference spark/keras/remote.py:102): the permutation is a pure
+        function of (seed, epoch) over the deterministic unit table, so
+        it is identical on every rank with no communication — epochs
+        traverse the dataset in different orders while rank shards stay
+        disjoint and globally complete.  Row-group granularity (the
+        strided-row fallback for tiny datasets streams unshuffled;
+        estimators additionally shuffle rows within each chunk).
+
+        ``prefetch > 0`` reads ahead through a bounded background-thread
+        queue of that depth, overlapping the next chunk's store reads
+        with the caller's train step (the Petastorm pooled-reader role).
         """
+        # use_threads=False on the arrow calls below: the feed streams
+        # sequentially (arrow's pool buys nothing here) and the prefetch
+        # reader must not fan out further foreign threads on top of the
+        # mimalloc thread-init hazard handled at module import.
+        gen = self._iter_array_batches_impl(
+            path, feature_cols, label_cols, chunk_rows, rank, size,
+            epoch, shuffle_seed)
+        if prefetch > 0 and not _arrow_background_thread_safe():
+            # The allocator default at module import came too late (the
+            # caller touched pyarrow first and it picked mimalloc):
+            # running arrow from a fresh thread risks the mi_thread_init
+            # segfault documented above — degrade to synchronous reads.
+            global _WARNED_NO_PREFETCH
+            if not _WARNED_NO_PREFETCH:
+                import sys
+                print("[horovod_tpu] warning: pyarrow initialized with "
+                      "the mimalloc pool before horovod_tpu.spark was "
+                      "imported; disabling feed prefetch (set "
+                      "ARROW_DEFAULT_MEMORY_POOL=system before importing "
+                      "pyarrow to re-enable).", file=sys.stderr)
+                _WARNED_NO_PREFETCH = True
+            prefetch = 0
+        if prefetch > 0:
+            gen = _prefetch_iter(gen, prefetch)
+        return gen
+
+    def _iter_array_batches_impl(self, path, feature_cols, label_cols,
+                                 chunk_rows, rank, size, epoch,
+                                 shuffle_seed):
         import pyarrow.parquet as pq
         parts = self._parquet_parts(path)
-        if size <= 1:
+        if size <= 1 and shuffle_seed is None:
             for part in parts:
                 with self._open(part, "rb") as f:
                     pf = pq.ParquetFile(f)
-                    for rb in pf.iter_batches(batch_size=chunk_rows):
+                    for rb in pf.iter_batches(batch_size=chunk_rows,
+                                              use_threads=False):
                         yield dataframe_to_arrays(rb.to_pandas(),
                                                   feature_cols, label_cols)
             return
@@ -154,26 +272,49 @@ class Store:
         # dataset, and footer reads are round trips on remote stores.
         units = self._row_group_units(path, parts)
 
+        if shuffle_seed is not None and len(units) > 1:
+            # Identical permutation on every rank: pure function of
+            # (seed, epoch) over the deterministic unit table.  Sharding
+            # the PERMUTED table keeps rank shards disjoint and globally
+            # complete while both the per-rank read order and the
+            # rank->unit assignment change each epoch.
+            perm = np.random.default_rng(
+                [int(shuffle_seed) & 0x7FFFFFFF,
+                 int(epoch)]).permutation(len(units))
+            units = [units[i] for i in perm]
+
         if len(units) >= size:
             mine = units[rank::size]
             common = min(sum(u[2] for u in units[r::size])
                          for r in range(size))
 
             def frames():
-                from itertools import groupby
-                # Strided selection keeps same-part units adjacent: open
-                # each file once and read its row groups from one handle,
-                # streamed in chunk_rows batches (a single row group can
-                # be the whole file — materializing it would break the
-                # bounded-memory contract the unsharded path keeps).
-                for part, group in groupby(mine, key=lambda u: u[0]):
-                    with self._open(part, "rb") as f:
-                        pf = pq.ParquetFile(f)
-                        for _, rg, _rows in group:
-                            for rb in pf.iter_batches(
-                                    batch_size=chunk_rows,
-                                    row_groups=[rg]):
-                                yield rb.to_pandas()
+                # Per-part handle cache: the shuffled schedule interleaves
+                # parts, so open each file once on first use and reuse its
+                # handle for later row groups (on remote stores every
+                # open+footer parse is a round trip).  Row groups stream
+                # in chunk_rows batches — a single row group can be the
+                # whole file, and materializing it would break the
+                # bounded-memory contract the unsharded path keeps.
+                open_files, open_pfs = {}, {}
+                try:
+                    for part, rg, _rows in mine:
+                        pf = open_pfs.get(part)
+                        if pf is None:
+                            f = self._open(part, "rb")
+                            open_files[part] = f
+                            pf = open_pfs[part] = pq.ParquetFile(f)
+                        for rb in pf.iter_batches(
+                                batch_size=chunk_rows,
+                                row_groups=[rg],
+                                use_threads=False):
+                            yield rb.to_pandas()
+                finally:
+                    for f in open_files.values():
+                        try:
+                            f.close()
+                        except Exception:  # noqa: BLE001
+                            pass
         else:
             total = sum(u[2] for u in units)
             common = min(len(range(r, total, size)) for r in range(size))
@@ -183,7 +324,8 @@ class Store:
                 for part in parts:
                     with self._open(part, "rb") as f:
                         pf = pq.ParquetFile(f)
-                        for rb in pf.iter_batches(batch_size=chunk_rows):
+                        for rb in pf.iter_batches(batch_size=chunk_rows,
+                                                  use_threads=False):
                             df = rb.to_pandas()
                             sel = [i for i in range(len(df))
                                    if (offset + i) % size == rank]

@@ -133,6 +133,135 @@ def test_sharded_reader_disjoint_reads_equal_schedule(tmp_path):
     assert len(set(all_rows.tolist())) == len(all_rows)  # disjoint
 
 
+def test_per_epoch_shuffle_order_differs_membership_same(tmp_path):
+    """VERDICT r3 #5: seeded per-epoch permutation of the row-group unit
+    schedule — identical across ranks, disjointness preserved, epochs
+    traverse the data in different orders with unchanged global
+    membership (the Petastorm shuffle role)."""
+    s = Store.create(str(tmp_path))
+    path = s.get_train_data_path("shuf")
+    s.makedirs(path)
+    # 8 parts x 1 row group, 12 rows each (96 rows, divisible by all).
+    base = 0
+    for i in range(8):
+        df = pd.DataFrame({
+            "features": [[float(base + j), 0.0, 0.0] for j in range(12)],
+            "label": [float(base + j) for j in range(12)],
+        })
+        df.to_parquet(f"{path}/part-{i:05d}.parquet")
+        base += 12
+    size = 2
+
+    def labels_per_rank(epoch):
+        out = {}
+        for rank in range(size):
+            chunks = list(s.iter_array_batches(
+                path, ["features"], ["label"], chunk_rows=12, rank=rank,
+                size=size, epoch=epoch, shuffle_seed=7))
+            out[rank] = np.concatenate([y.ravel() for _, y in chunks])
+        return out
+
+    ep0, ep1 = labels_per_rank(0), labels_per_rank(1)
+    for ep in (ep0, ep1):
+        # Disjoint shards, globally complete.
+        assert not set(ep[0].tolist()) & set(ep[1].tolist())
+        assert set(np.concatenate([ep[0], ep[1]]).tolist()) == \
+            set(float(v) for v in range(96))
+    # Epochs differ in order (the permutation moved units)...
+    order0 = np.concatenate([ep0[0], ep0[1]])
+    order1 = np.concatenate([ep1[0], ep1[1]])
+    assert not np.array_equal(order0, order1)
+    # ...but not in membership.
+    assert set(order0.tolist()) == set(order1.tolist())
+    # Same (seed, epoch) is deterministic — every rank plans the same
+    # permutation with no communication.
+    again = labels_per_rank(1)
+    for rank in range(size):
+        np.testing.assert_array_equal(ep1[rank], again[rank])
+
+
+def test_prefetch_overlaps_reads_with_compute(tmp_path, monkeypatch):
+    """VERDICT r3 #5: with prefetch, the next chunk's store reads run on
+    a background thread during the consumer's compute (instrumented: the
+    reader makes progress while the consumer sleeps).
+
+    The pytest process imported pandas (hence pyarrow, hence its bundled
+    mimalloc pool) before horovod_tpu.spark could set the system-pool
+    default, so the allocator guard would degrade prefetch here; this
+    test overrides it — the mi_thread_init hazard has only ever
+    manifested in estimator worker processes, which get the right import
+    order, and what is under test is the overlap mechanics."""
+    import threading
+    import time as _time
+
+    from horovod_tpu.spark import store as store_mod
+    monkeypatch.setattr(store_mod, "_arrow_background_thread_safe",
+                        lambda: True)
+
+    s = Store.create(str(tmp_path))
+    path = s.get_train_data_path("pf")
+    s.makedirs(path)
+    for i in range(6):
+        df = pd.DataFrame({
+            "features": [[float(j), 0.0, 0.0] for j in range(64)],
+            "label": [float(i * 64 + j) for j in range(64)],
+        })
+        df.to_parquet(f"{path}/part-{i:05d}.parquet")
+
+    opens = []
+    orig_open = Store._open
+
+    def traced_open(self, p, mode):
+        opens.append((threading.current_thread().name,
+                      _time.monotonic()))
+        return orig_open(self, p, mode)
+
+    monkeypatch.setattr(Store, "_open", traced_open)
+
+    gen = s.iter_array_batches(path, ["features"], ["label"],
+                               chunk_rows=64, prefetch=2, rank=0, size=1,
+                               shuffle_seed=3)
+    seen = 0
+    consume_windows = []
+    for x, y in gen:
+        t0 = _time.monotonic()
+        _time.sleep(0.05)  # the "train step"
+        consume_windows.append((t0, _time.monotonic()))
+        seen += len(x)
+    assert seen == 6 * 64
+    # All parquet opens happened on the prefetch thread...
+    assert opens and all("prefetch" in name for name, _ in opens), opens
+    # ...and at least one open overlapped a consumer compute window
+    # (reads genuinely ran ahead during the sleep).
+    overlapped = any(a <= t <= b for _, t in opens
+                     for a, b in consume_windows)
+    assert overlapped, (opens, consume_windows)
+
+
+def test_prefetch_degrades_safely_under_foreign_arrow_pool(tmp_path):
+    """When pyarrow was initialized with its mimalloc pool before
+    horovod_tpu.spark (the pandas-first import order of this very test
+    process), prefetch degrades to synchronous reads — correct data, no
+    fresh-thread arrow use — instead of risking the mi_thread_init
+    segfault."""
+    s = Store.create(str(tmp_path))
+    path = s.get_train_data_path("dg")
+    s.makedirs(path)
+    pd.DataFrame({
+        "features": [[float(j), 0.0, 0.0] for j in range(48)],
+        "label": [float(j) for j in range(48)],
+    }).to_parquet(f"{path}/part-00000.parquet")
+    import pyarrow as pa
+    chunks = list(s.iter_array_batches(path, ["features"], ["label"],
+                                       chunk_rows=16, prefetch=2))
+    assert sum(len(x) for x, _ in chunks) == 48
+    if pa.default_memory_pool().backend_name == "mimalloc":
+        # The degrade path ran (this process is pandas-first); with the
+        # system pool the full prefetch path is allowed instead.
+        from horovod_tpu.spark import store as store_mod
+        assert not store_mod._arrow_background_thread_safe()
+
+
 def test_legacy_store_feed_override_still_works(tmp_path):
     """A user Store subclass overriding iter_array_batches with the OLD
     signature (no rank/size kwargs) must keep working: the train loop
