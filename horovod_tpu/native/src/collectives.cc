@@ -603,10 +603,52 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
     if (!st.ok()) return st;
   }
 
-  // Phase 3: fan the full result down the intra-node chain.
+  // Phase 3: fan the result down the intra-node chain, skipping each
+  // RECEIVER's own block — the one region every member already holds
+  // (the redundant bytes of the plain chain schedule; the reference
+  // avoids them with its shared-memory window, MEMCPY_IN_SHARED_BUFFER).
+  // Senders hold the full buffer at their turn (they received everything
+  // but their own block, which they have natively), so per 4MB pipeline
+  // chunk each hop streams the chunk minus the receiver's block span.
+  const int pos = rank - leader;
   int64_t total = 0;
   for (auto b : bytes) total += b;
-  return ChainFanout(net, buf, total, rank, leader, local_size);
+  auto minus = [](int64_t s, int64_t e, int64_t bs, int64_t be,
+                  std::pair<int64_t, int64_t> out[2]) {
+    int n = 0;
+    if (be <= s || bs >= e) {
+      out[n++] = {s, e};
+    } else {
+      if (bs > s) out[n++] = {s, bs};
+      if (be < e) out[n++] = {be, e};
+    }
+    return n;
+  };
+  const int64_t kChunk = 4 << 20;
+  for (int64_t off = 0; off < total; off += kChunk) {
+    const int64_t end = std::min(off + kChunk, total);
+    std::pair<int64_t, int64_t> spans[2];
+    if (pos > 0) {
+      int n = minus(off, end, offsets[rank], offsets[rank] + bytes[rank],
+                    spans);
+      for (int i = 0; i < n; ++i) {
+        Status st = RecvStream(net, rank - 1, buf + spans[i].first,
+                               spans[i].second - spans[i].first);
+        if (!st.ok()) return st;
+      }
+    }
+    if (pos < local_size - 1) {
+      const int nxt = rank + 1;
+      int n = minus(off, end, offsets[nxt], offsets[nxt] + bytes[nxt],
+                    spans);
+      for (int i = 0; i < n; ++i) {
+        Status st = SendStream(net, nxt, buf + spans[i].first,
+                               spans[i].second - spans[i].first);
+        if (!st.ok()) return st;
+      }
+    }
+  }
+  return Status::OK();
 }
 
 Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
@@ -968,6 +1010,13 @@ Status AdasumAllreduce(Network& net, void* vbuf, int64_t count,
   }
 }
 
+namespace {
+
+Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
+                              DataType dtype, int local_size);
+
+}  // namespace
+
 Status HierarchicalAdasum(Network& net, void* vbuf, int64_t count,
                           DataType dtype, int local_size) {
   // Reference AdasumGpuAllreduceOp (adasum_gpu_operations.cc:38-…):
@@ -983,6 +1032,23 @@ Status HierarchicalAdasum(Network& net, void* vbuf, int64_t count,
       dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64)
     return Status::InvalidArgument(
         "eager Adasum supports float16/bfloat16/float32/float64");
+  if (dtype == DataType::FLOAT16 || dtype == DataType::BFLOAT16) {
+    // fp32 accumulation for 16-bit wires across ALL phases, matching the
+    // flat path (which converts the whole buffer before any reduction):
+    // fp16 intra-node partial sums would overflow at moderate local_size
+    // and the hierarchical result would diverge from the flat one.
+    return With16BitAsFloat(vbuf, count, dtype, [&](float* w) {
+      return HierarchicalAdasumImpl(net, w, count, DataType::FLOAT32,
+                                    local_size);
+    });
+  }
+  return HierarchicalAdasumImpl(net, vbuf, count, dtype, local_size);
+}
+
+namespace {
+
+Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
+                              DataType dtype, int local_size) {
   const int size = net.size();
   const int rank = net.rank();
   const int n_nodes = local_size > 0 ? size / local_size : 0;
@@ -1015,5 +1081,7 @@ Status HierarchicalAdasum(Network& net, void* vbuf, int64_t count,
   return ChainFanout(net, static_cast<uint8_t*>(vbuf),
                      count * DataTypeSize(dtype), rank, leader, local_size);
 }
+
+}  // namespace
 
 }  // namespace hvdtpu

@@ -203,18 +203,20 @@ class ElasticDriver:
     def _slot_id(self, s: SlotInfo) -> str:
         return f"{s.hostname}:{s.local_rank}"
 
-    def _controller_port(self, hostname: str) -> int:
+    def _controller_port(self, hostname: str) -> Optional[int]:
         """A fresh controller port for this round.  The rank-0 worker binds
         it on ``hostname``; when that is this machine, probe a genuinely
         free port (two concurrent elastic jobs on one host must not
         collide — the old ``base_port + round`` scheme did).  For a remote
-        rank-0 host a local probe proves nothing, so fall back to the
-        configured base plus a round offset; a bind failure there surfaces
-        as a worker failure and the next round picks a different port."""
+        rank-0 host a local probe proves nothing: return None and the
+        round's rank-0 WORKER probes a port on its own host and publishes
+        it through the rendezvous KV (worker._resolve_controller_addr) —
+        the driver guessing base_port + round collided between concurrent
+        jobs sharing the remote head host (ADVICE r3)."""
         if exec_mod._is_local(hostname):
             from .chips import _free_port
             return _free_port()
-        return self._base_port + (self._round % 1000)
+        return None
 
     def _start_round(self, hosts: List[HostInfo]):
         with self._lock:
@@ -223,9 +225,10 @@ class ElasticDriver:
             np_ = sum(h.slots for h in hosts)
             slots = get_host_assignments(hosts, np_)
             port = self._controller_port(hosts[0].hostname)
-            controller_addr = f"{hosts[0].hostname}:{port}"
-            if hosts[0].hostname in ("localhost",):
-                controller_addr = f"127.0.0.1:{port}"
+            host0 = ("127.0.0.1" if hosts[0].hostname in ("localhost",)
+                     else hosts[0].hostname)
+            controller_addr = (f"{host0}:{port}" if port is not None
+                               else f"auto:{host0}")
             assignment = {
                 "round": self._round,
                 "size": np_,

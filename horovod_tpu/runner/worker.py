@@ -14,7 +14,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
-from .rendezvous import http_get
+from .rendezvous import http_get, http_put
 
 
 def rendezvous_addr() -> Optional[str]:
@@ -49,11 +49,13 @@ def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
                     assignment = json.loads(blob.decode())
                     mine = assignment["slots"].get(slot)
                     if mine is not None:
+                        ctl_addr = _resolve_controller_addr(
+                            addr, assignment, mine,
+                            deadline - time.time(), poll_interval)
                         return {
                             "round": assignment["round"],
                             "size": assignment["size"],
-                            "controller_addr":
-                                assignment["controller_addr"],
+                            "controller_addr": ctl_addr,
                             "jax_coord_addr":
                                 assignment.get("jax_coord_addr"),
                             **mine,
@@ -61,6 +63,38 @@ def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
         time.sleep(poll_interval)
     raise TimeoutError(f"no rendezvous round included slot {slot} within "
                        f"{timeout}s")
+
+
+def _resolve_controller_addr(rdv_addr: str, assignment: Dict[str, Any],
+                             mine: Dict[str, Any], budget: float,
+                             poll_interval: float) -> str:
+    """Resolve an ``auto:<host>`` controller address: the round's rank-0
+    worker probes a free port ON ITS OWN HOST and publishes it to the KV;
+    peers poll for it.  The driver guessing a port for a possibly-remote
+    rank-0 host collided between concurrent jobs sharing that host
+    (ADVICE r3); a local probe leaves only the tiny close->bind window."""
+    ctl_addr = assignment["controller_addr"]
+    if not ctl_addr.startswith("auto:"):
+        return ctl_addr
+    host = ctl_addr[len("auto:"):]
+    rnd = assignment["round"]
+    key = f"ctlport.{rnd}"
+    if mine["rank"] == 0:
+        import socket
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        http_put(rdv_addr, "elastic", key, str(port).encode())
+        return f"{host}:{port}"
+    deadline = time.time() + max(budget, 5.0)
+    while time.time() < deadline:
+        blob = http_get(rdv_addr, "elastic", key, timeout=5)
+        if blob is not None:
+            return f"{host}:{int(blob.decode())}"
+        time.sleep(poll_interval)
+    raise TimeoutError(
+        f"rank 0 never published a controller port for round {rnd}")
 
 
 def poll_host_event(last_ts: float) -> Optional[Dict[str, Any]]:

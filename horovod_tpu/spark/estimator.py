@@ -349,6 +349,8 @@ def _torch_train_loop(spec) -> None:
 
 
 def _torch_fit_worker(spec):
+    from .store import prefer_system_arrow_pool
+    prefer_system_arrow_pool()  # before the worker's first arrow touch
     """Module-level worker for runner.run (spawn requires picklability):
     trains a rank; rank 0 returns the state_dict bytes."""
     import io as _io
@@ -475,6 +477,8 @@ def _lightning_train_loop(spec) -> None:
 
 
 def _lightning_fit_worker(spec):
+    from .store import prefer_system_arrow_pool
+    prefer_system_arrow_pool()  # before the worker's first arrow touch
     import io as _io
     import torch
     import horovod_tpu.torch as hvd_torch

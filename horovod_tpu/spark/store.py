@@ -28,14 +28,22 @@ from typing import Optional
 
 import numpy as np
 
-# pyarrow's bundled mimalloc segfaults in mi_thread_init when arrow is
-# first exercised from a freshly-created Python thread in processes with
-# certain loader states (observed: spawn workers of a pytest parent;
-# kernel log points the fault into libarrow's mi_thread_init).  The async
-# prefetch reader is exactly such a thread, so default arrow to the
-# system allocator before any pyarrow import can pick a pool.  Explicitly
-# set ARROW_DEFAULT_MEMORY_POOL env wins over this default.
-os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+def prefer_system_arrow_pool() -> None:
+    """pyarrow's bundled mimalloc segfaults in mi_thread_init when arrow
+    is first exercised from a freshly-created Python thread in processes
+    with certain loader states (observed: estimator worker processes;
+    kernel log points the fault into libarrow's mi_thread_init).  The
+    async prefetch reader is exactly such a thread.  The pool is baked at
+    pyarrow import, so estimator WORKERS call this before their first
+    arrow touch to default to the system allocator — scoped there rather
+    than at library import, which would silently change the allocator of
+    any host application that merely imports horovod_tpu.spark.  No-op
+    once pyarrow is loaded (the runtime guard in iter_array_batches then
+    degrades prefetch instead).  An explicitly set
+    ARROW_DEFAULT_MEMORY_POOL always wins."""
+    import sys
+    if "pyarrow" not in sys.modules:
+        os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
 
 
 def _prefetch_iter(gen, depth: int):
@@ -53,21 +61,27 @@ def _prefetch_iter(gen, depth: int):
     _END = object()
     stop = threading.Event()
 
+    def put_checked(item) -> bool:
+        """Bounded put that gives up when the consumer abandoned the
+        iterator — EVERY reader put must go through this, including the
+        end sentinel and the exception relay, or the thread parks on the
+        full queue forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def reader():
         try:
             for item in gen:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
+                if not put_checked(item):
                     return
-            q.put(_END)
+            put_checked(_END)
         except BaseException as e:  # noqa: BLE001 — re-raised by consumer
-            if not stop.is_set():
-                q.put(e)
+            put_checked(e)
 
     t = threading.Thread(target=reader, daemon=True,
                          name="hvd-store-prefetch")
@@ -296,25 +310,40 @@ class Store:
                 # in chunk_rows batches — a single row group can be the
                 # whole file, and materializing it would break the
                 # bounded-memory contract the unsharded path keeps.
-                open_files, open_pfs = {}, {}
+                # LRU-capped handle cache: reuse per-part handles under
+                # the shuffled (interleaved) schedule without holding one
+                # fd/remote connection per part of an arbitrarily large
+                # dataset open at once.
+                from collections import OrderedDict
+                _CAP = 64
+                open_files: "OrderedDict" = OrderedDict()  # part -> (f, pf)
+
+                def _close(part):
+                    f, _pf = open_files.pop(part)
+                    try:
+                        f.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
                 try:
                     for part, rg, _rows in mine:
-                        pf = open_pfs.get(part)
-                        if pf is None:
+                        ent = open_files.get(part)
+                        if ent is None:
+                            if len(open_files) >= _CAP:
+                                _close(next(iter(open_files)))
                             f = self._open(part, "rb")
-                            open_files[part] = f
-                            pf = open_pfs[part] = pq.ParquetFile(f)
-                        for rb in pf.iter_batches(
+                            ent = (f, pq.ParquetFile(f))
+                            open_files[part] = ent
+                        else:
+                            open_files.move_to_end(part)
+                        for rb in ent[1].iter_batches(
                                 batch_size=chunk_rows,
                                 row_groups=[rg],
                                 use_threads=False):
                             yield rb.to_pandas()
                 finally:
-                    for f in open_files.values():
-                        try:
-                            f.close()
-                        except Exception:  # noqa: BLE001
-                            pass
+                    for part in list(open_files):
+                        _close(part)
         else:
             total = sum(u[2] for u in units)
             common = min(len(range(r, total, size)) for r in range(size))

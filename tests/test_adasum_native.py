@@ -116,6 +116,19 @@ def _hier_worker(rank, size, port, q):
                       (contribs[2] + contribs[3]) / 2.0]
         want = _adasum_tree(node_means)
         np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+        # fp16 wires accumulate in fp32 across ALL phases (ADVICE r3):
+        # per-rank values near fp16 max would overflow an intra-node
+        # partial sum held in the wire dtype (40000+40000 > 65504 ->
+        # inf); the fp32 conversion before phase 1 keeps it finite and
+        # equal to the flat-path semantics.
+        big = np.full((64,), 40000.0, dtype=np.float16)
+        out16 = ctl.allreduce(big, op=2, name="hier.ad.fp16big")
+        assert np.isfinite(out16.astype(np.float32)).all(), out16[:4]
+        # All inputs identical -> node means identical -> Adasum of
+        # identical vectors stays at that vector.
+        np.testing.assert_allclose(out16.astype(np.float32), 40000.0,
+                                   rtol=1e-2)
         q.put((rank, "ok", True))
     except Exception as e:  # noqa: BLE001
         q.put((rank, "error", repr(e)))
