@@ -345,13 +345,20 @@ def bench_resnet():
     })
 
 
-def _bench_free_port():
+def _bench_free_ports(n=1):
+    """Probe n distinct free ports, holding every probe socket open until
+    all are bound — closing one before binding the next can hand the same
+    port back twice."""
     import socket as socket_mod
-    s = socket_mod.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    socks = []
+    for _ in range(n):
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports if n > 1 else ports[0]
 
 
 def _collect_worker_results(procs, q, n, timeout):
@@ -451,7 +458,7 @@ def _run_eager_config(np_procs, env, specs, timeout=900):
     """Spawn np_procs workers, run all specs, return {name: max_dt}."""
     import multiprocessing as mp
 
-    port = _bench_free_port()
+    port = _bench_free_ports()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=_eager_sweep_worker,
@@ -553,6 +560,8 @@ def bench_eager_sweep():
     record("adasum_tree", 4, ad,
            dict(base_env, HVD_TPU_ADASUM_ALGO="tree"))
 
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_EAGER.json")
     artifact = {
         "schema": "horovod_tpu eager data-plane sweep v1",
         "environment": {
@@ -565,8 +574,13 @@ def bench_eager_sweep():
         },
         "rows": rows,
     }
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_EAGER.json")
+    try:  # preserve sections other modes maintain (eager_device)
+        with open(out_path) as f:
+            prev = json.load(f)
+        if "device_plane" in prev:
+            artifact["device_plane"] = prev["device_plane"]
+    except (OSError, ValueError):
+        pass
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
 
@@ -678,7 +692,7 @@ def bench_eager_device():
     iters = int(os.environ.get("BENCH_ITERS", "8"))
     payloads_kb = [64, 1024, 8192, 65536]  # 64KB .. 64MB
 
-    ctl_port, jax_port = _bench_free_port(), _bench_free_port()
+    ctl_port, jax_port = _bench_free_ports(2)
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=_eager_device_worker,

@@ -3,6 +3,7 @@
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sched.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -18,12 +19,22 @@ constexpr size_t kMapBytes =
     sizeof(ShmChannel::Hdr) + ShmChannel::kSlots * ShmChannel::kSlotBytes;
 constexpr uint64_t kProbeMagic = 0x48764474707531ULL;
 
-// Bounded wait on a shm condition: brief spin for the streaming case,
-// then micro-sleeps; 60 s deadline like the socket paths.
+// Bounded wait on a shm condition: brief spin for the multi-core
+// streaming case, then sched_yield — on an oversubscribed or single-CPU
+// host a pure spin PREVENTS the peer from running until the spinner's
+// timeslice ends, and a usleep(50) pays ~wakeup-latency per ring-slot
+// handoff (measured: shm lost to TCP at 1MB payloads on a 1-core box
+// because blocking socket reads hand the CPU to the producer
+// immediately).  yield gives the same immediate handoff; micro-sleeps
+// only as the deep fallback.  60 s deadline like the socket paths.
 template <typename Cond>
 Status WaitFor(Cond cond, const char* what) {
+  for (int i = 0; i < 64; ++i) {
+    if (cond()) return Status::OK();
+  }
   for (int i = 0; i < 4096; ++i) {
     if (cond()) return Status::OK();
+    ::sched_yield();
   }
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::seconds(60);
