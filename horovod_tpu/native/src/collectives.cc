@@ -251,11 +251,14 @@ Status FullDuplexThreaded(Network& net, int send_peer,
                           const uint8_t* send_buf, size_t nsend,
                           int recv_peer, uint8_t* recv_buf, size_t nrecv,
                           const std::function<void(size_t)>& on_recv) {
+  // Persistent helper thread instead of a per-call std::thread: the ring
+  // calls this 2(P-1) times per allreduce, and the spawn+join cost
+  // rivals the transfer itself at small payloads.
   Status send_st = Status::OK();
-  std::thread sender(
+  net.duplex_helper().Run(
       [&] { send_st = SendStream(net, send_peer, send_buf, nsend); });
   Status st = RecvStream(net, recv_peer, recv_buf, nrecv, on_recv);
-  sender.join();
+  net.duplex_helper().Wait();
   return st.ok() ? send_st : st;
 }
 

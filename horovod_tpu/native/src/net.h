@@ -7,14 +7,105 @@
 // in, the address table is broadcast, then every pair connects directly.
 #pragma once
 
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "common.h"
 #include "shm.h"
 
 namespace hvdtpu {
+
+// Persistent helper thread for full-duplex streaming: the data plane
+// overlaps one send with one recv per ring round, and spawning a fresh
+// std::thread per round (2(P-1) spawns per allreduce) costs more than
+// the transfer at small payloads.  One lazily-started helper per
+// Network; the background thread is the only submitter.
+class DuplexHelper {
+ public:
+  ~DuplexHelper() { Stop(); }
+
+  // Runs fn on the helper thread; pair with Wait() before touching the
+  // buffers fn captures.  Single-submitter contract (the background
+  // thread): overlapping Run calls would overwrite the in-flight task's
+  // closure (whose by-reference captures then dangle) — abort loudly
+  // instead of corrupting silently.
+  void Run(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (busy_) {
+        fprintf(stderr,
+                "DuplexHelper: overlapping Run (single-submitter "
+                "contract violated)\n");
+        std::abort();
+      }
+      if (!started_) {
+        started_ = true;
+        th_ = std::thread([this] { Loop(); });
+      }
+      task_ = std::move(fn);
+      has_task_ = true;
+      done_ = false;
+      busy_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return done_; });
+    busy_ = false;
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!started_) return;
+      stop_ = true;
+    }
+    cv_.notify_all();
+    if (th_.joinable()) th_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    started_ = false;
+    stop_ = false;
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || has_task_; });
+        if (stop_) return;
+        fn = std::move(task_);
+        has_task_ = false;
+      }
+      fn();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        done_ = true;
+      }
+      cv_.notify_all();
+    }
+  }
+
+  std::thread th_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::function<void()> task_;
+  bool started_ = false;
+  bool has_task_ = false;
+  bool done_ = false;
+  bool busy_ = false;
+  bool stop_ = false;
+};
 
 class Socket {
  public:
@@ -52,6 +143,8 @@ class Network {
   ShmChannel* shm_tx(int r) { return shm_tx_[r].get(); }  // me → r
   ShmChannel* shm_rx(int r) { return shm_rx_[r].get(); }  // r → me
 
+  DuplexHelper& duplex_helper() { return duplex_helper_; }
+
  private:
   Network(int rank, int size) : rank_(rank), size_(size) {
     peers_.resize(size);
@@ -65,6 +158,7 @@ class Network {
   std::vector<std::unique_ptr<Socket>> peers_;
   std::vector<std::unique_ptr<ShmChannel>> shm_tx_;
   std::vector<std::unique_ptr<ShmChannel>> shm_rx_;
+  DuplexHelper duplex_helper_;
 };
 
 }  // namespace hvdtpu
