@@ -418,6 +418,15 @@ def _eager_sweep_worker(rank, size, port, env, specs, q):
                                              name=f"{tag}.{i % 4}")
                     ctl.wait(h)
                 dt = time.perf_counter() - t0
+            elif kind == "allgather":
+                # nbytes = per-rank contribution; result is nbytes*size.
+                x = np.ones((spec["nbytes"] // 4,), dtype=np.float32)
+                ctl.allgather(x, name=f"w.{tag}")
+                ctl.barrier()
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    ctl.allgather(x, name=f"{tag}.{i % 4}")
+                dt = time.perf_counter() - t0
             elif kind == "many_small":
                 # The fusion-threshold workload: ntensors concurrent small
                 # allreduces per step; under a large threshold the runtime
@@ -513,6 +522,14 @@ def bench_eager_sweep():
             dt = dts[spec["name"]]
             nbytes = spec["nbytes"]
             alg = nbytes * spec["iters"] / dt / 1e9
+            # Bus-bandwidth factor per op (NCCL convention): ring
+            # allreduce moves 2(P-1)/P x payload per rank; allgather's
+            # per-rank-CONTRIBUTION bandwidth scales by (P-1) (each rank
+            # receives (P-1) contributions).
+            if spec["kind"] == "allgather":
+                bus = alg * (np_procs - 1)
+            else:
+                bus = alg * 2 * (np_procs - 1) / np_procs
             rows.append({
                 "config": config, "np": np_procs,
                 "op": spec["name"].split("/")[0],
@@ -520,7 +537,7 @@ def bench_eager_sweep():
                 "iters": spec["iters"],
                 "sec_per_op": round(dt / spec["iters"], 5),
                 "alg_gbps": round(alg, 3),
-                "bus_gbps": round(alg * 2 * (np_procs - 1) / np_procs, 3),
+                "bus_gbps": round(bus, 3),
             })
             sys.stderr.write(
                 f"  {config} np={np_procs} {spec['name']}: "
@@ -540,6 +557,18 @@ def bench_eager_sweep():
     sys.stderr.write("[eager sweep] hierarchical np=4\n")
     record("hierarchical_shm", 4, ar_specs([1, 64, 256]),
            dict(base_env, HVD_TPU_HIERARCHICAL_ALLREDUCE="1",
+                HVD_TPU_LOCAL_SIZE="2"))
+
+    # 3b. Allgather: flat ring vs hierarchical (leader staging + CMA
+    # star fan-out, the reference MPIHierarchicalAllgather shape).
+    # nbytes = per-rank contribution (result is 4x that at np=4).
+    ag = [{"name": f"allgather/{mb}MB", "kind": "allgather",
+           "nbytes": mb << 20, "iters": 4} for mb in (4, 32)]
+    sys.stderr.write("[eager sweep] allgather flat np=4\n")
+    record("allgather_flat", 4, ag, dict(base_env))
+    sys.stderr.write("[eager sweep] allgather hier np=4\n")
+    record("allgather_hier", 4, ag,
+           dict(base_env, HVD_TPU_HIERARCHICAL_ALLGATHER="1",
                 HVD_TPU_LOCAL_SIZE="2"))
 
     # 4. Fusion on/off: 128 x 16KB concurrent tensors (2MB total) — the

@@ -646,7 +646,8 @@ class NativeController:
         return self._lib.hvd_native_last_fused_names()
 
     def last_allgather_schedule(self) -> int:
-        """0 = flat ring, 1 = hierarchical (most recent allgather)."""
+        """0 = flat ring, 1 = hierarchical (chain fan-out),
+        2 = hierarchical (CMA star fan-out) — most recent allgather."""
         return self._lib.hvd_native_last_allgather_schedule()
 
     def adasum_scratch_peak(self) -> int:

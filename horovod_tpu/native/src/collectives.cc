@@ -606,13 +606,18 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
     if (!st.ok()) return st;
   }
 
-  // Phase 3: fan the result down the intra-node chain, skipping each
-  // RECEIVER's own block — the one region every member already holds
-  // (the redundant bytes of the plain chain schedule; the reference
-  // avoids them with its shared-memory window, MEMCPY_IN_SHARED_BUFFER).
-  // Senders hold the full buffer at their turn (they received everything
-  // but their own block, which they have natively), so per 4MB pipeline
-  // chunk each hop streams the chunk minus the receiver's block span.
+  // Phase 3: deliver the assembled result to the node's members.
+  //
+  // Fast path — zero-copy STAR (the reference's shared-memory-window
+  // analog, MEMCPY_IN_SHARED_BUFFER): when every leader->member channel
+  // supports cross-memory attach, the leader publishes at most two CMA
+  // descriptors per member (the buffer minus that member's own block)
+  // and all members pull directly from the leader's memory
+  // CONCURRENTLY — one copy per member, none for the leader, no
+  // per-hop forwarding.  Fallback — pipelined chain, skipping each
+  // receiver's own block.  The leader picks and announces the mode
+  // in-band (one flag byte per member) so capability asymmetries can
+  // never desynchronize the framing.
   const int pos = rank - leader;
   int64_t total = 0;
   for (auto b : bytes) total += b;
@@ -627,6 +632,81 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
     }
     return n;
   };
+
+  uint8_t star = 0;
+  if (rank == leader) {
+    // HVD_TPU_AG_FANOUT=chain forces the chain (benchmark head-to-head
+    // comparison knob, like HVD_TPU_ADASUM_ALGO).
+    static const bool force_chain = [] {
+      const char* v = getenv("HVD_TPU_AG_FANOUT");
+      return v && std::string(v) == "chain";
+    }();
+    star = force_chain ? 0 : 1;
+    for (int i = 1; i < local_size; ++i) {
+      ShmChannel* ch = net.shm_tx(leader + i);
+      if (ch == nullptr || !ch->refs_enabled()) star = 0;
+    }
+    for (int i = 1; i < local_size; ++i) {
+      Status st = SendStream(net, leader + i, &star, 1);
+      if (!st.ok()) return st;
+    }
+  } else {
+    Status st = RecvStream(net, leader, &star, 1);
+    if (!st.ok()) return st;
+  }
+  // Observability: 1 = hierarchical chain fan-out, 2 = hierarchical CMA
+  // star (this rank's node; tests assert the intended path actually ran).
+  g_allgather_schedule.store(star ? 2 : 1);
+
+  if (star) {
+    std::pair<int64_t, int64_t> spans[2];
+    if (rank == leader) {
+      // On ANY failure mid-star, poison EVERY member channel before
+      // returning: live descriptors into a buffer the failed op will
+      // free must not let a slow member complete a "successful" pull
+      // from reused memory (only the failing channel self-poisons).
+      auto poison_all = [&] {
+        for (int i = 1; i < local_size; ++i)
+          if (ShmChannel* ch = net.shm_tx(leader + i)) ch->Poison();
+      };
+      for (int i = 1; i < local_size; ++i) {
+        const int peer = leader + i;
+        int n = minus(0, total, offsets[peer], offsets[peer] + bytes[peer],
+                      spans);
+        for (int s = 0; s < n; ++s) {
+          if (spans[s].second == spans[s].first) continue;
+          Status st = net.shm_tx(peer)->PushRef(
+              buf + spans[s].first, spans[s].second - spans[s].first);
+          if (!st.ok()) {
+            poison_all();
+            return st;
+          }
+        }
+      }
+      // Drain AFTER publishing to every member: the pulls overlap.
+      for (int i = 1; i < local_size; ++i) {
+        Status st = net.shm_tx(leader + i)->WaitDrained();
+        if (!st.ok()) {
+          poison_all();
+          return st;
+        }
+      }
+      return Status::OK();
+    }
+    int n = minus(0, total, offsets[rank], offsets[rank] + bytes[rank],
+                  spans);
+    for (int s = 0; s < n; ++s) {
+      const int64_t want = spans[s].second - spans[s].first;
+      if (want == 0) continue;
+      size_t got = 0;
+      Status st = net.shm_rx(leader)->PopInto(
+          buf + spans[s].first, static_cast<size_t>(want), &got);
+      if (!st.ok()) return st;
+      if (static_cast<int64_t>(got) != want)
+        return Status::Error("allgather star: descriptor length mismatch");
+    }
+    return Status::OK();
+  }
   const int64_t kChunk = 4 << 20;
   for (int64_t off = 0; off < total; off += kChunk) {
     const int64_t end = std::min(off + kChunk, total);

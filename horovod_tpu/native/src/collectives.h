@@ -55,7 +55,8 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
                               int local_size);
 
 // Test/observability hook: schedule used by the most recent allgather on
-// this process (0 = flat ring, 1 = hierarchical).
+// this process (0 = flat ring, 1 = hierarchical with chain
+// fan-out, 2 = hierarchical with CMA star fan-out).
 int LastAllgatherSchedule();
 
 // In-place broadcast of buf from root (chain schedule).

@@ -77,6 +77,10 @@ class ShmChannel {
   // reported a successful probe).
   void EnableRefs() { use_refs_ = true; }
   bool refs_enabled() const { return use_refs_; }
+  // Mark the channel unusable: consumers' in-flight/later reads fail
+  // instead of trusting descriptors into memory the producer may have
+  // freed (producer-side error teardown).
+  void Poison() { hdr_->poisoned.store(1, std::memory_order_release); }
 
  private:
   ShmChannel() = default;

@@ -210,6 +210,68 @@ def test_device_varsize_matrix_np4_under_launcher(tmp_path, monkeypatch):
         assert data["checks"] == 11
 
 
+JOIN_DEVICE_WORKER = textwrap.dedent("""
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    ctl = eager._controller()
+    assert eager._negotiated_device_ready(ctl), "device plane not engaged"
+    eager._np = lambda _t: (_ for _ in ()).throw(
+        AssertionError("host copy on device plane"))
+
+    # Uneven DEVICE-tensor batches: rank r has r+1 batches.  Ranks that
+    # run out call join(); survivors' device collectives complete with
+    # HBM zero proxies synthesized by the executor for the joined ranks
+    # (reference Join op, operations.cc:1202-1226 — here the proxies are
+    # jnp.zeros inside the fused device Response).
+    sums = []
+    n_batches = rank + 1
+    for b in range(n_batches):
+        out = hvd.allreduce(
+            jnp.full((4,), float(rank + 1), dtype=jnp.float32),
+            op=hvd.Sum, name=f"jb.{{b}}")
+        assert isinstance(out, jax.Array), type(out)
+        sums.append(float(np.asarray(out)[0]))
+    last = hvd.join()
+    # Batch b sums contributions of ranks with r+1 > b: sum(r+1 for
+    # r >= b) = sum(b+1..size).
+    want = [float(sum(r + 1 for r in range(b, size)))
+            for b in range(n_batches)]
+    assert sums == want, (sums, want)
+    assert last == size - 1, last
+    with open({outfile!r} + f".{{rank}}", "w") as f:
+        json.dump({{"rank": rank, "sums": sums, "last": last}}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(420)
+def test_join_uneven_device_batches_np4_under_launcher(tmp_path,
+                                                      monkeypatch):
+    """Join with genuinely uneven DEVICE-tensor batch counts: joined
+    ranks' executors still participate in the SPMD collective with HBM
+    zero proxies, survivors get correct partial sums."""
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "result")
+    script = tmp_path / "join_device_worker.py"
+    script.write_text(JOIN_DEVICE_WORKER.format(repo=REPO,
+                                                outfile=outfile))
+    monkeypatch.setenv("HVD_TPU_CPU_JAX_WORLD", "1")
+    rc = main(["-np", "4", sys.executable, str(script)])
+    assert rc == 0
+    for r in range(4):
+        data = json.load(open(f"{outfile}.{r}"))
+        assert data["last"] == 3
+        assert len(data["sums"]) == r + 1
+
+
 @pytest.mark.timeout(420)
 def test_device_matrix_np4_under_launcher(tmp_path, monkeypatch):
     from horovod_tpu.runner.launch import main

@@ -70,7 +70,8 @@ def test_hierarchical_allreduce_4proc():
         assert p.exitcode == 0
 
 
-def _ag_worker(rank, size, port, hierarchical, q):
+def _ag_worker(rank, size, port, hierarchical, q, local_size=2,
+               fanout=None):
     """Allgather under --hierarchical-allgather: the wire schedule must
     actually change (reference MPIHierarchicalAllgather,
     mpi_operations.cc:186-341 — the round-2 dead knob, now implemented)."""
@@ -78,7 +79,9 @@ def _ag_worker(rank, size, port, hierarchical, q):
     os.environ["HVD_TPU_CYCLE_TIME"] = "1"
     if hierarchical:
         os.environ["HVD_TPU_HIERARCHICAL_ALLGATHER"] = "1"
-    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"  # 2 ranks per 'node'
+    if fanout:
+        os.environ["HVD_TPU_AG_FANOUT"] = fanout
+    os.environ["HVD_TPU_LOCAL_SIZE"] = str(local_size)
     from horovod_tpu.native.controller import NativeController
     ctl = NativeController(rank, size, f"127.0.0.1:{port}")
     try:
@@ -89,7 +92,8 @@ def _ag_worker(rank, size, port, hierarchical, q):
             [np.full((r + 1, 3), float(r), dtype=np.float32)
              for r in range(size)])
         np.testing.assert_allclose(out, expected)
-        assert ctl.last_allgather_schedule() == (1 if hierarchical else 0)
+        sched = ctl.last_allgather_schedule()
+        assert sched in ((1, 2) if hierarchical else (0,)), sched
         # Large payload: exercises chunked leader staging + pipelined
         # intra-node fan-out through the shm/CMA transports.
         big = np.full((1 << 18,), float(rank + 1), dtype=np.float32)
@@ -98,11 +102,12 @@ def _ag_worker(rank, size, port, hierarchical, q):
         for r in range(size):
             np.testing.assert_allclose(out[r << 18], r + 1.0)
             np.testing.assert_allclose(out[((r + 1) << 18) - 1], r + 1.0)
-        assert ctl.last_allgather_schedule() == (1 if hierarchical else 0)
+        sched = ctl.last_allgather_schedule()
+        assert sched in ((1, 2) if hierarchical else (0,)), sched
         # Repeat with the response cache warm.
         out = ctl.allgather(x, name="hag.uneven2")
         np.testing.assert_allclose(out, expected)
-        q.put((rank, "ok", True))
+        q.put((rank, "ok", ctl.last_allgather_schedule()))
     except Exception as e:  # noqa: BLE001
         q.put((rank, "error", repr(e)))
     finally:
@@ -123,6 +128,39 @@ def test_hierarchical_allgather_4proc(hierarchical):
     for _ in range(size):
         rank, status, payload = q.get(timeout=120)
         assert status == "ok", f"rank {rank}: {payload}"
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+
+@pytest.mark.timeout(240)
+@pytest.mark.parametrize("fanout", ["star", "chain"])
+def test_hierarchical_allgather_3member_nodes(fanout):
+    """local_size=3 (np=6, 2 nodes): exercises MIDDLE chain members
+    (recv + forward with receiver-own-block span skipping) and the
+    multi-member CMA star (2 descriptors per member around each
+    member's own block); both fan-outs must produce identical results.
+    HVD_TPU_AG_FANOUT=chain forces the chain on CMA-capable hosts."""
+    size = 6
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(
+        target=_ag_worker,
+        args=(r, size, port, True, q),
+        kwargs={"local_size": 3,
+                "fanout": None if fanout == "star" else "chain"})
+        for r in range(size)]
+    for p in procs:
+        p.start()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=180)
+        assert status == "ok", f"rank {rank}: {payload}"
+        # The intended fan-out actually ran (2 = CMA star, 1 = chain);
+        # a CMA-incapable host silently downgrading star to chain would
+        # otherwise ship star-path regressions green.
+        assert payload == (2 if fanout == "star" else 1), \
+            (rank, fanout, payload)
     for p in procs:
         p.join(timeout=30)
         assert p.exitcode == 0
