@@ -38,6 +38,10 @@ class State:
         self._host_messages: "queue.Queue" = queue.Queue()
         self._last_updated_timestamp = 0
         self._reset_callbacks = []
+        # Commit seniority for sync-root election (elect_sync_root): a
+        # freshly (re)spawned worker carries 0, survivors the number of
+        # commits their state has seen.
+        self._sync_generation = 0
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -55,8 +59,38 @@ class State:
 
     def commit(self):
         self.save()
+        self._sync_generation += 1
         notification_manager.poll()
         self.check_host_updates()
+
+    def elect_sync_root(self) -> int:
+        """Agree on which rank's state seeds ``sync()``: the lowest rank
+        holding the highest commit generation.
+
+        Broadcasting from a hardcoded rank 0 loses committed progress
+        whenever a freshly respawned process is seated at rank 0 of the
+        new round (e.g. a cascade respawn of the first host's slot 0):
+        its constructor-initial state would overwrite every survivor's.
+        The reference sidesteps this by keeping previously-assigned hosts
+        first in the host order (elastic/driver.py host assignment); that
+        is slot-granular here, so the root is elected explicitly from
+        commit seniority instead."""
+        from ..optimizers import allgather_object
+        gens = allgather_object(int(self._sync_generation),
+                                name="elastic.sync.generation")
+        self._elected_generation = max(gens)
+        return int(max(range(len(gens)), key=lambda r: (gens[r], -r)))
+
+    def adopt_sync_generation(self):
+        """Call once sync's broadcasts COMPLETE: only then does this
+        worker actually hold the root's state and deserve its seniority.
+        Adopting at election time would let a fresh worker whose sync
+        died mid-broadcast claim a generation it never received — and
+        win a tie-break in the retry round's election."""
+        g = getattr(self, "_elected_generation", None)
+        if g is not None:
+            self._sync_generation = max(self._sync_generation, g)
+            self._elected_generation = None
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt if the host set changed since the last
@@ -110,11 +144,14 @@ class ObjectState(State):
         for k, v in self._saved_state.items():
             setattr(self, k, copy.deepcopy(v))
 
-    def sync(self):
+    def sync(self, root: Optional[int] = None):
         if self._saved_state:
-            synced = self._bcast_object(self._saved_state, root_rank=0)
+            if root is None:
+                root = self.elect_sync_root()
+            synced = self._bcast_object(self._saved_state, root_rank=root)
             self._saved_state = synced
             self.restore()
+        self.adopt_sync_generation()
 
 
 class TpuState(ObjectState):
@@ -147,20 +184,29 @@ class TpuState(ObjectState):
                 setattr(self, k, jax.tree_util.tree_map(
                     lambda x: jax.numpy.asarray(x), host))
 
-    def sync(self):
+    def sync(self, root: Optional[int] = None):
         from ..optimizers import broadcast_parameters
+        if root is None:
+            root = self.elect_sync_root()
         for k in self._tree_keys:
             setattr(self, k, broadcast_parameters(getattr(self, k),
-                                                  root_rank=0))
+                                                  root_rank=root))
         # Sync the plain-object part too.
         object_keys = [k for k in self._saved_state
                        if k not in self._tree_keys]
         if object_keys:
             from ..optimizers import broadcast_object
             synced = broadcast_object(
-                {k: getattr(self, k) for k in object_keys}, root_rank=0)
+                {k: getattr(self, k) for k in object_keys}, root_rank=root)
             for k, v in synced.items():
                 setattr(self, k, v)
+        # Persist the synced state into the restorable snapshots BEFORE
+        # claiming the root's seniority: otherwise a pre-first-commit
+        # failure would restore() this worker to constructor-initial
+        # state while it carries the adopted generation — and a later
+        # election could crown that initial state.
+        self.save()
+        self.adopt_sync_generation()
 
 
 def _reset():

@@ -131,6 +131,12 @@ class ElasticDriver:
         self._last_failure_ts: Optional[float] = None
         self._cascade_grace_s = float(os.environ.get(
             "HVD_TPU_ELASTIC_CASCADE_GRACE", "10"))
+        # Debounce for the cascade republish (see _on_worker_exit): one
+        # incident's collateral exits usually arrive within this window
+        # and fold into a single fresh round.
+        self._cascade_debounce_s = float(os.environ.get(
+            "HVD_TPU_ELASTIC_CASCADE_DEBOUNCE", "1.0"))
+        self._cascade_timer: Optional[threading.Timer] = None
         self._succeeded = False  # any worker exited 0: job is completing
         self._result: Optional[int] = None
         self._result_cv = threading.Condition()
@@ -180,6 +186,9 @@ class ElasticDriver:
         finally:
             self._shutdown.set()
             with self._lock:
+                if self._cascade_timer is not None:
+                    self._cascade_timer.cancel()
+                    self._cascade_timer = None
                 exec_mod.terminate_all(list(self._workers.values()))
             self._rendezvous.stop()
 
@@ -377,21 +386,22 @@ class ElasticDriver:
                        self._cascade_grace_s)
             if cascade:
                 # Collateral exit of the incident already being handled:
-                # no blacklist, no reset charge, no fresh round (each
-                # collateral exit publishing a new round would churn
-                # survivors mid-reconnect and burn the reset budget per
-                # worker of a single incident) — just respawn this slot
-                # into the CURRENT round, whose assignment still
-                # includes it.
+                # no blacklist, no reset charge.  The slot must NOT be
+                # respawned into the CURRENT round: survivors of the
+                # broken round re-init with min_round = current+1
+                # (core/basics.py fetch_assignment), so they would block
+                # on a round this branch never publishes, die on the
+                # fetch timeout outside the grace window, and wrongly
+                # blacklist a collateral host.  Instead publish ONE
+                # fresh round with the unchanged host set — a short
+                # debounce folds the incident's other collateral exits
+                # into the same round instead of churning survivors
+                # with a round per exit.
                 if self._verbose:
                     print(f"[elastic] worker {sid} failed (exit {code});"
                           f" cascade within {self._cascade_grace_s:.0f}s"
-                          " - respawning into the current round")
-                np_ = sum(h.slots for h in self._current_hosts)
-                for s2 in get_host_assignments(self._current_hosts, np_):
-                    if self._slot_id(s2) == sid:
-                        self._spawn(s2)
-                        break
+                          " - scheduling a fresh round (same hosts)")
+                self._schedule_cascade_round()
                 return
             # Anchor the window at the blacklisting failure (a sliding
             # window would let a fast crash-looper read as an endless
@@ -416,6 +426,33 @@ class ElasticDriver:
                 return
             self._publish_host_event(added_only=False)
             self._start_round(hosts)
+
+    def _schedule_cascade_round(self):
+        """Arrange one fresh round (unchanged hosts, no blacklist, no
+        reset charge) for a cascade incident; caller holds the lock."""
+        if self._cascade_timer is not None:
+            return  # a republish for this incident is already pending
+        t = threading.Timer(self._cascade_debounce_s, self._cascade_round)
+        t.daemon = True
+        self._cascade_timer = t
+        t.start()
+
+    def _cascade_round(self):
+        with self._lock:
+            self._cascade_timer = None
+            if (self._shutdown.is_set() or self._result is not None
+                    or self._succeeded):
+                return
+            # A blacklist-path round may have been published meanwhile
+            # (its _start_round spawns every dead slot); republish only
+            # if some slot of the current assignment still lacks a live
+            # worker.
+            np_ = sum(h.slots for h in self._current_hosts)
+            slots = get_host_assignments(self._current_hosts, np_)
+            if all(self._slot_id(s) in self._workers for s in slots):
+                return
+            self._publish_host_event(added_only=False)
+            self._start_round(self._current_hosts)
 
     def _bump_reset(self) -> bool:
         """Count a reset; True (job over) once the limit is exceeded."""

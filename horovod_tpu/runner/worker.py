@@ -81,6 +81,12 @@ def _resolve_controller_addr(rdv_addr: str, assignment: Dict[str, Any],
     key = f"ctlport.{rnd}"
     if mine["rank"] == 0:
         import socket
+        # ctlport.{rnd} is single-writer: every respawn goes through a
+        # FRESH driver round (the cascade path publishes one too, see
+        # elastic_driver._cascade_round), so no second incarnation of a
+        # round's rank 0 can exist to overwrite this key after peers
+        # resolved it.  A rank-0 death after publishing simply abandons
+        # the round — the driver's next round gets a new key.
         s = socket.socket()
         s.bind(("", 0))
         port = s.getsockname()[1]

@@ -349,10 +349,10 @@ def _torch_train_loop(spec) -> None:
 
 
 def _torch_fit_worker(spec):
-    from .store import prefer_system_arrow_pool
-    prefer_system_arrow_pool()  # before the worker's first arrow touch
     """Module-level worker for runner.run (spawn requires picklability):
     trains a rank; rank 0 returns the state_dict bytes."""
+    from .store import prefer_system_arrow_pool
+    prefer_system_arrow_pool()  # before the worker's first arrow touch
     import io as _io
     import torch
     import horovod_tpu.torch as hvd_torch
@@ -477,6 +477,8 @@ def _lightning_train_loop(spec) -> None:
 
 
 def _lightning_fit_worker(spec):
+    """Module-level lightning worker for runner.run: trains a rank;
+    rank 0 returns the state_dict bytes."""
     from .store import prefer_system_arrow_pool
     prefer_system_arrow_pool()  # before the worker's first arrow touch
     import io as _io

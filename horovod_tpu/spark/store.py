@@ -110,9 +110,15 @@ def _arrow_background_thread_safe() -> bool:
     arrow from a fresh Python thread is then safe."""
     try:
         import pyarrow as pa
+    except Exception:  # noqa: BLE001 — no arrow in the process
+        return True  # prefetch cannot touch arrow; nothing to trip
+    try:
         return pa.default_memory_pool().backend_name != "mimalloc"
-    except Exception:  # noqa: BLE001 — no arrow / no backend_name attr
-        return True
+    except Exception:  # noqa: BLE001 — older pyarrow, no backend_name
+        # pyarrow IS present but the pool cannot be identified: the
+        # mimalloc hazard the guard exists for cannot be ruled out —
+        # degrade to synchronous reads.
+        return False
 
 
 class Store:

@@ -54,14 +54,16 @@ class TensorFlowState(State):
             setattr(self, k, copy.deepcopy(val))
 
     def sync(self):
-        broadcast_variables(self.variables, root_rank=0)
+        root = self.elect_sync_root()
+        broadcast_variables(self.variables, root_rank=root)
         if self._object_keys:
             synced = broadcast_object(
                 {k: getattr(self, k) for k in self._object_keys},
-                root_rank=0, name="tf.state.objects")
+                root_rank=root, name="tf.state.objects")
             for k, v in synced.items():
                 setattr(self, k, v)
         self.save()
+        self.adopt_sync_generation()
 
 
 class TensorFlowKerasState(State):
@@ -122,17 +124,19 @@ class TensorFlowKerasState(State):
             setattr(self, k, copy.deepcopy(val))
 
     def sync(self):
-        broadcast_variables(self.model.variables, root_rank=0)
+        root = self.elect_sync_root()
+        broadcast_variables(self.model.variables, root_rank=root)
         opt_vars = self._opt_variables()
         if opt_vars:
-            broadcast_variables(opt_vars, root_rank=0)
+            broadcast_variables(opt_vars, root_rank=root)
         if self._object_keys:
             synced = broadcast_object(
                 {k: getattr(self, k) for k in self._object_keys},
-                root_rank=0, name="keras.state.objects")
+                root_rank=root, name="keras.state.objects")
             for k, v in synced.items():
                 setattr(self, k, v)
         self.save()
+        self.adopt_sync_generation()
 
 
 def run(func):

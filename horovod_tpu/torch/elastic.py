@@ -55,17 +55,18 @@ class TorchState(ObjectState):
         super().restore()
 
     def sync(self):
+        root = self.elect_sync_root()
         if self._model is not None:
-            synced = broadcast_object(self._model_snapshot, root_rank=0,
+            synced = broadcast_object(self._model_snapshot, root_rank=root,
                                       name="torchstate.model")
             self._model_snapshot = synced
             self._model.load_state_dict(synced)
         if self._optimizer is not None:
-            synced = broadcast_object(self._opt_snapshot, root_rank=0,
+            synced = broadcast_object(self._opt_snapshot, root_rank=root,
                                       name="torchstate.opt")
             self._opt_snapshot = synced
             self._optimizer.load_state_dict(synced)
-        super().sync()
+        super().sync(root=root)
 
 
 class ElasticSampler(torch.utils.data.Sampler):

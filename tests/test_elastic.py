@@ -207,6 +207,103 @@ def test_elastic_recovery_with_device_plane_engaged(tmp_path):
         assert e["sum"] == pytest.approx(3.0)  # ranks 0,1 -> 1+2
 
 
+CASCADE_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    LOG = {log!r}
+    MARK = {mark!r}
+    FAILS = {{"127.0.0.1:0": 1, "localhost:0": 2}}  # slot -> fail epoch
+
+    hvd.init()
+    state = elastic.ObjectState(epoch=0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < {epochs}:
+            slot = os.environ["HVD_TPU_ELASTIC_SLOT"]
+            fail_epoch = FAILS.get(slot)
+            marker = MARK + "." + slot.replace(":", "_")
+            if (fail_epoch is not None and state.epoch == fail_epoch
+                    and not os.path.exists(marker)):
+                open(marker, "w").close()  # fail once per slot
+                os._exit(1)
+            x = np.full((4,), float(hvd.rank() + 1), dtype=np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"ep.{{state.epoch}}")
+            with open(LOG + f".{{slot}}", "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size(),
+                    "sum": float(np.asarray(out)[0])}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+    train(state)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(300)
+def test_elastic_cascade_failure_publishes_fresh_round(tmp_path):
+    """ADVICE r4 (medium): a failure inside the cascade grace window must
+    publish a FRESH round with the unchanged host set — not respawn into
+    the current round.  Survivors of the established round re-init with
+    min_round = current+1 (core/basics.py), so under the old behavior they
+    blocked on a round the driver never published, timed out, and wrongly
+    blacklisted collateral hosts.
+
+    Schedule: 127.0.0.1:0 dies at epoch 1 (blacklist path → round 1 on the
+    two remaining hosts); localhost:0 dies at epoch 2, seconds later and
+    inside the grace window, in the established round 1 (cascade path →
+    fresh round 2, same hosts, slot respawned, host NOT blacklisted)."""
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(CASCADE_WORKER.format(
+        repo=REPO, log=log, mark=str(tmp_path / "mark"), epochs=6))
+    local_name = __import__("socket").gethostname()
+    hosts = [HostInfo("127.0.0.1", 1), HostInfo("localhost", 1),
+             HostInfo(local_name, 2)]
+    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    os.environ["HVD_TPU_ELASTIC_CASCADE_GRACE"] = "60"
+    try:
+        driver = ElasticDriver(
+            FixedHosts(hosts), [sys.executable, str(script)],
+            min_np=2, max_np=4, controller_base_port=28800, verbose=True)
+        rc = driver.run()
+    finally:
+        os.environ.pop("HVD_TPU_ELASTIC_CASCADE_GRACE", None)
+    assert rc == 0
+    # Only the first failure's host was blacklisted; the cascade host was
+    # respawned, not condemned, and no collateral host was blacklisted.
+    assert driver._blacklist == {"127.0.0.1"}
+    slots = ["127.0.0.1:0", "localhost:0",
+             f"{local_name}:0", f"{local_name}:1"]
+    events = _read_logs(log, slots)
+    # The job started at the full world of 4.
+    assert any(e["size"] == 4 and e["epoch"] == 0 for e in events)
+    # The final epoch completed with all 3 post-blacklist ranks — the
+    # cascade-respawned localhost slot among them (ranks 0,1,2 → sum 6).
+    finals = [e for e in events if e["epoch"] == 5]
+    assert len(finals) == 3 and all(e["size"] == 3 for e in finals), finals
+    assert any(e["slot"] == "localhost:0" for e in finals), finals
+    for e in finals:
+        assert e["sum"] == pytest.approx(6.0)
+    # No rollback: the respawned localhost:0 may be seated at rank 0 of
+    # the fresh round, and sync() must broadcast a SURVIVOR's committed
+    # state (elected by commit generation), not the fresh process's
+    # epoch-0 state.  A rollback replays pre-failure epochs at size 3 and
+    # double-logs epochs on the surviving slots.
+    size3 = [e for e in events if e["size"] == 3]
+    assert not any(e["epoch"] == 0 for e in size3), \
+        "epoch 0 replayed at size 3: sync rolled survivors back"
+    for slot in (f"{local_name}:0", f"{local_name}:1"):
+        eps = [e["epoch"] for e in size3 if e["slot"] == slot]
+        assert len(eps) == len(set(eps)), \
+            f"survivor {slot} double-logged epochs {eps}: state rollback"
+
+
 SCALEUP_WORKER = textwrap.dedent("""
     import json, os, sys, time
     sys.path.insert(0, {repo!r})
