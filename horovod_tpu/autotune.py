@@ -3,11 +3,15 @@
 Capability parity with the reference's autotune subsystem
 (parameter_manager.h:42-246 + optim/bayesian_optimization.cc +
 optim/gaussian_process.cc): joint Bayesian optimization of {fusion
-threshold bytes, cycle time ms} scored by data-plane throughput
+threshold bytes, cycle time ms} AND the categorical toggles
+{hierarchical_allreduce, hierarchical_allgather, cache_enabled}
+(parameter_manager.h:91-93), scored by data-plane throughput
 (bytes/sec) over sample windows, with an optional CSV log
 (HOROVOD_AUTOTUNE_LOG).  Rebuilt in numpy: RBF-kernel Gaussian-process
 regression with expected-improvement acquisition maximized over a random
-candidate set (the reference uses Eigen + LBFGS for the same acquisition).
+candidate set (the reference uses Eigen + LBFGS for the same acquisition);
+the categorical toggles ride the same GP as relaxed [0,1] dimensions
+rounded at application, instead of the reference's nested grids.
 
 The tuner runs on rank 0 (the coordinator owns fusion decisions); tuned
 parameters are applied through the native runtime's SetParams hook.
@@ -118,28 +122,52 @@ class BayesianOptimizer:
 
 
 class ParameterManager:
-    """Tunes {log2(fusion bytes), cycle ms} against observed throughput.
+    """Tunes {log2(fusion bytes), cycle ms} JOINTLY with the categorical
+    toggles {hierarchical_allreduce, hierarchical_allgather, cache_enabled}
+    against observed throughput.
 
-    Reference semantics (parameter_manager.h:234-236): scores are throughput
-    bytes/sec over sample windows; after ``max_samples`` windows the best
-    parameters are frozen.
+    Reference semantics (parameter_manager.h:91-93, 225-236): the three
+    booleans are CategoricalParameter<bool>s chained with the joint
+    Bayesian numeric parameters; scores are throughput bytes/sec over
+    sample windows; after ``max_samples`` windows the best parameters are
+    frozen.  TPU-native difference: instead of the reference's nested
+    categorical grids, the toggles are relaxed to [0,1] dimensions of the
+    SAME GP and rounded at application — one joint surrogate over the
+    mixed space — with a deterministic bootstrap plan that tries both
+    values of every toggle before EI takes over (so e.g. hierarchical
+    allreduce is demonstrably tried OFF on a single host, where it loses
+    — BENCH_EAGER.json hierarchical rows).
     """
 
-    # log2(bytes): 1 MB .. 256 MB; cycle: 0.5 .. 25 ms.
-    BOUNDS = [(20.0, 28.0), (0.5, 25.0)]
+    # log2(bytes): 1 MB .. 256 MB; cycle: 0.5 .. 25 ms; three relaxed
+    # booleans {hierarchical_allreduce, hierarchical_allgather, cache}.
+    BOUNDS = [(20.0, 28.0), (0.5, 25.0),
+              (0.0, 1.0), (0.0, 1.0), (0.0, 1.0)]
 
     def __init__(self, apply_fn, max_samples: int = 20,
                  window_seconds: float = 2.0,
                  log_file: Optional[str] = None, seed: int = 0,
                  warmup_samples: int = 3, steps_per_sample: int = 0,
-                 gp_noise: float = 0.8):
-        """apply_fn(fusion_bytes: int, cycle_ms: float) applies parameters
-        to the runtime (native SetParams).
+                 gp_noise: float = 0.8,
+                 initial_toggles: Tuple[bool, bool, bool] =
+                 (False, False, True),
+                 tune_toggles: bool = True):
+        """apply_fn(fusion_bytes: int, cycle_ms: float, hierarchical_
+        allreduce: bool, hierarchical_allgather: bool, cache_enabled:
+        bool) applies parameters to the runtime (native SetParams +
+        SetTunedToggles).
 
         ``warmup_samples`` windows are discarded (not fed to the GP) to
         skip compile/cache-cold noise; ``steps_per_sample > 0`` closes a
         window every N traffic reports instead of by wall-clock — the
-        reference's step-counted sampling (--autotune-steps-per-sample)."""
+        reference's step-counted sampling (--autotune-steps-per-sample).
+        ``initial_toggles`` seeds the bootstrap plan with the configured
+        algorithm choice.  ``tune_toggles`` is a per-toggle bool triple
+        (a plain bool applies to all three): a pinned toggle stays at
+        its initial value and is never explored — flipping a toggle
+        that cannot take effect (hierarchical with one node, cache with
+        capacity 0) would burn sample budget re-measuring an identical
+        configuration."""
         self._apply = apply_fn
         self._opt = BayesianOptimizer(self.BOUNDS, seed=seed,
                                       noise=gp_noise)
@@ -152,6 +180,27 @@ class ParameterManager:
         self._samples = 0
         self._frozen = False
         self._current = None
+        self._initial_toggles = tuple(bool(t) for t in initial_toggles)
+        if isinstance(tune_toggles, (tuple, list)):
+            self._tunable = tuple(bool(t) for t in tune_toggles)
+        else:
+            self._tunable = (bool(tune_toggles),) * 3
+        # Deterministic categorical bootstrap (the reference's grids try
+        # every categorical value; here: the configured triple, then each
+        # TUNABLE toggle flipped once).  Numeric dims stay GP-proposed.
+        if any(self._tunable):
+            t0 = self._initial_toggles
+            self._toggle_plan = [t0] + [
+                tuple(not t0[j] if j == i else t0[j] for j in range(3))
+                for i in range(3) if self._tunable[i]]
+        else:
+            self._toggle_plan = []
+        # The plan holds the numeric dims FIXED across the toggle flips:
+        # a controlled comparison, so fusion/cycle variation (which can
+        # swing throughput far more than ~20%) cannot confound the
+        # categorical signal.  The reference's nested grids get the same
+        # property structurally.
+        self._plan_numeric = None
         self._window_start = time.perf_counter()
         self._bytes = 0
         self._propose()
@@ -162,11 +211,24 @@ class ParameterManager:
 
     @property
     def current(self):
+        """(fusion_bytes, cycle_ms, hier_allreduce, hier_allgather,
+        cache_enabled)"""
         return self._current
 
+    def _round_toggles(self, x) -> Tuple[bool, bool, bool]:
+        return tuple(bool(x[2 + i] >= 0.5) if self._tunable[i]
+                     else self._initial_toggles[i] for i in range(3))
+
     def _propose(self):
-        x = self._opt.suggest()
-        self._current = (int(2 ** x[0]), float(x[1]))
+        if self._toggle_plan:
+            if self._plan_numeric is None:
+                x = self._opt.suggest()
+                self._plan_numeric = (int(2 ** x[0]), float(x[1]))
+            self._current = self._plan_numeric + self._toggle_plan.pop(0)
+        else:
+            x = self._opt.suggest()
+            self._current = ((int(2 ** x[0]), float(x[1]))
+                             + self._round_toggles(x))
         self._apply(*self._current)
 
     def record_bytes(self, nbytes: int):
@@ -189,21 +251,26 @@ class ParameterManager:
         self._steps_in_window = 0
         self._window_start = now
 
+    def _x_of_current(self) -> np.ndarray:
+        return np.array([math.log2(self._current[0]), self._current[1]]
+                        + [1.0 if t else 0.0 for t in self._current[2:]])
+
     def _observe(self, score: float):
         if self._warmup_left > 0:
             # Warmup windows (compile/cold-cache noise) are logged but not
-            # fed to the GP and do not count toward max_samples.
+            # fed to the GP and do not count toward max_samples.  The
+            # current proposal stays applied — re-proposing here would
+            # burn bootstrap-plan entries on discarded windows.
             self._warmup_left -= 1
             self._log(score, tag="warmup")
-            self._propose()
             return
-        x = np.array([math.log2(self._current[0]), self._current[1]])
-        self._opt.observe(x, score)
+        self._opt.observe(self._x_of_current(), score)
         self._log(score)
         self._samples += 1
         if self._samples >= self._max_samples:
             best_x, best_y = self._opt.best()
-            self._current = (int(2 ** best_x[0]), float(best_x[1]))
+            self._current = ((int(2 ** best_x[0]), float(best_x[1]))
+                             + tuple(self._round_toggles(best_x)))
             self._apply(*self._current)
             self._frozen = True
             self._log(best_y, tag="final")
@@ -216,6 +283,7 @@ class ParameterManager:
         try:
             with open(self._log_file, "a") as f:
                 f.write(f"{tag},{self._current[0]},{self._current[1]:.3f},"
-                        f"{score:.1f}\n")
+                        f"{int(self._current[2])},{int(self._current[3])},"
+                        f"{int(self._current[4])},{score:.1f}\n")
         except OSError:
             pass

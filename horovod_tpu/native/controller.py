@@ -121,6 +121,8 @@ def load_library():
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
+    lib.hvd_native_set_tuned_toggles.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_set_topology.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
@@ -209,20 +211,35 @@ class NativeController:
             self.set_device_executor(_negotiated_executor(self))
         except ImportError:
             pass
-        # Autotune (reference ParameterManager): rank 0 owns fusion
-        # decisions, so the tuner runs there and applies via SetParams.
+        # Autotune (reference ParameterManager): rank 0 owns fusion and
+        # algorithm decisions, so the tuner runs there; numeric params
+        # apply via SetParams, categorical toggles via SetTunedToggles
+        # (the coordinator stamps each Response so every rank executes
+        # the same schedule mid-flip).
         self._autotune = None
         if cfg.autotune and rank == 0:
             from ..autotune import ParameterManager
             self._autotune = ParameterManager(
-                apply_fn=lambda fusion, cycle:
-                    self._lib.hvd_native_set_params(int(fusion),
-                                                    float(cycle)),
+                apply_fn=self._apply_tuned,
                 log_file=cfg.autotune_log or None,
                 max_samples=cfg.autotune_bayes_opt_max_samples,
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
-                gp_noise=cfg.autotune_gaussian_process_noise)
+                gp_noise=cfg.autotune_gaussian_process_noise,
+                initial_toggles=(cfg.hierarchical_allreduce,
+                                 cfg.hierarchical_allgather,
+                                 cfg.cache_capacity > 0),
+                # Per-toggle: hierarchical variants are dead with a
+                # single node; the cache cannot be enabled at capacity 0.
+                tune_toggles=(local_size > 1, local_size > 1,
+                              cfg.cache_capacity > 0))
+
+    def _apply_tuned(self, fusion, cycle, hier_allreduce, hier_allgather,
+                     cache_enabled):
+        self._lib.hvd_native_set_params(int(fusion), float(cycle))
+        self._lib.hvd_native_set_tuned_toggles(
+            1 if hier_allreduce else 0, 1 if hier_allgather else 0,
+            1 if cache_enabled else 0)
 
     @classmethod
     def from_env(cls) -> "NativeController":

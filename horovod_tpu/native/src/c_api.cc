@@ -224,6 +224,18 @@ void hvd_native_set_params(int64_t fusion_threshold, double cycle_time_ms) {
   Runtime::Get().SetParams(fusion_threshold, cycle_time_ms);
 }
 
+// Categorical autotune toggles (reference parameter_manager.h:91-93):
+// rank 0's tuner flips {hierarchical allreduce, hierarchical allgather,
+// response cache} per sample; the coordinator distributes the choice
+// through the response stream so every rank stays schedule-consistent.
+void hvd_native_set_tuned_toggles(int hierarchical_allreduce,
+                                  int hierarchical_allgather,
+                                  int cache_enabled) {
+  Runtime::Get().SetTunedToggles(hierarchical_allreduce != 0,
+                                 hierarchical_allgather != 0,
+                                 cache_enabled != 0);
+}
+
 void hvd_native_counters(int64_t* bytes, double* seconds) {
   Runtime::Get().ReadCounters(bytes, seconds);
 }

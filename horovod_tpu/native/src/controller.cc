@@ -171,6 +171,12 @@ void Controller::AbsorbCacheHits(const std::vector<RequestList>& lists,
 ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
   const int size = net_->size();
   ResponseList rl;
+  // Snapshot the tuned toggles once per round so every response of the
+  // round (and the distributed cache_on) reflects one consistent choice.
+  const bool hier_ar = hier_allreduce_.load();
+  const bool hier_ag = hier_allgather_.load();
+  const bool cache_on = cache_on_.load();
+  rl.cache_on = cache_on;
 
   // Absorb flags + requests.
   for (int r = 0; r < size; ++r) {
@@ -225,7 +231,10 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
     // Cache slot for this tensor: reuse its bit or assign a fresh one;
     // refresh the per-rank metadata (reference ResponseCache put path).
     uint32_t cache_bit = UINT32_MAX;
-    if (err.empty() && cache_.enabled()) {
+    // cache_on gates NEW bit assignment only: bits already announced
+    // this round were honored by AbsorbCacheHits above, so a flip never
+    // strands an in-flight announcement (it drains via resend_bits).
+    if (err.empty() && cache_.enabled() && cache_on) {
       int32_t b = cache_.BitForName(name);
       cache_bit = b >= 0 ? static_cast<uint32_t>(b) : cache_.Assign(name);
       cache_.InsertAt(cache_bit, name, q);
@@ -261,6 +270,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
         resp.prescale = q.prescale;
         resp.postscale = q.postscale;
         resp.device = q.device;
+        resp.hierarchical = hier_ar;
         resp.sizes = {NumElements(q.shape)};
         resp.cache_bits = {cache_bit};
         rl.responses.push_back(resp);
@@ -285,6 +295,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       for (size_t d = 1; d < q.shape.size(); ++d) row_elems *= q.shape[d];
       resp.sizes.push_back(row_elems);
       resp.device = q.device;
+      resp.hierarchical = hier_ag;
       resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;

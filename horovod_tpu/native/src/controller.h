@@ -49,6 +49,17 @@ class Controller {
     fusion_threshold_.store(bytes);
   }
 
+  // Categorical autotune toggles (reference parameter_manager.h:91-93):
+  // the coordinator stamps each Response's algorithm choice
+  // (Response::hierarchical) and distributes the cache toggle
+  // (ResponseList::cache_on), so flips stay rank-consistent mid-run.
+  void SetAlgoToggles(bool hier_allreduce, bool hier_allgather,
+                      bool cache_on) {
+    hier_allreduce_.store(hier_allreduce);
+    hier_allgather_.store(hier_allgather);
+    cache_on_.store(cache_on);
+  }
+
   // Coordinator-side timeline: per-rank NEGOTIATE ready instants are
   // recorded as each rank's report arrives (reference timeline.cc:496-541).
   void set_timeline(Timeline* t) { timeline_ = t; }
@@ -76,6 +87,9 @@ class Controller {
   ControllerConfig cfg_;
   Timeline* timeline_ = nullptr;
   std::atomic<int64_t> fusion_threshold_{0};  // 0 -> use cfg_ value
+  std::atomic<bool> hier_allreduce_{false};
+  std::atomic<bool> hier_allgather_{false};
+  std::atomic<bool> cache_on_{true};
   // Coordinator-only state (persists across rounds).
   ResponseCache cache_;
   std::map<std::string, PendingTensor> table_;

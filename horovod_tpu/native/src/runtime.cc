@@ -239,8 +239,9 @@ void Runtime::BackgroundLoop() {
         // Response-cache fast path: announce a previously-negotiated
         // tensor as one bit instead of the full request (reference
         // controller.cc:181-237).
-        int32_t bit = worker_cache_.enabled() ? worker_cache_.Lookup(q)
-                                              : -1;
+        int32_t bit = (worker_cache_.enabled() && coord_cache_on_.load())
+                          ? worker_cache_.Lookup(q)
+                          : -1;
         if (bit >= 0) {
           SetBit(rl.cache_hits, static_cast<uint32_t>(bit));
         } else {
@@ -301,6 +302,7 @@ void Runtime::BackgroundLoop() {
       }
     }
     // 4. Execute responses in coordinator order (identical on all ranks).
+    coord_cache_on_.store(responses.cache_on);
     for (const auto& resp : responses.responses) ExecuteResponse(resp);
     worker_cache_.Touch(responses.valid_cache_bits);
 
@@ -620,12 +622,15 @@ void Runtime::ExecuteAllreduce(
 
   timeline_.Record(resp.names[0], "B", "RING_ALLREDUCE");
   Status st;
+  // Algorithm choice comes from the RESPONSE (coordinator-stamped), not
+  // local state: the tuner flips the toggle on rank 0 mid-run and every
+  // rank must execute the same schedule for the same Response.
   if (resp.op == ReduceOp::ADASUM) {
-    st = (hierarchical_allreduce_ && local_size_ > 1)
+    st = (resp.hierarchical && local_size_ > 1)
              ? HierarchicalAdasum(*net_, fb, total_elems, resp.dtype,
                                   local_size_)
              : AdasumAllreduce(*net_, fb, total_elems, resp.dtype);
-  } else if (hierarchical_allreduce_ && local_size_ > 1) {
+  } else if (resp.hierarchical && local_size_ > 1) {
     st = HierarchicalAllreduce(*net_, fb, total_elems, resp.dtype, resp.op,
                                local_size_);
   } else {
@@ -688,7 +693,7 @@ void Runtime::ExecuteAllgather(const Response& resp,
   // marker and degrades to the flat ring itself when local_size == 1.
   Status st = HierarchicalAllgatherv(
       *net_, out->data(), bytes, offsets,
-      (hierarchical_allgather_ && local_size_ > 1) ? local_size_ : 1);
+      (resp.hierarchical && local_size_ > 1) ? local_size_ : 1);
   if (entry) {
     timeline_.Record(entry->name, "E", "RING_ALLGATHER");
     entry->var_output = out;
@@ -787,8 +792,20 @@ Status Runtime::BarrierBlocking() {
 void Runtime::SetTopology(int local_size, bool hierarchical_allreduce,
                           bool hierarchical_allgather) {
   local_size_ = local_size;
-  hierarchical_allreduce_ = hierarchical_allreduce;
-  hierarchical_allgather_ = hierarchical_allgather;
+  // Seed the coordinator's per-response stamping with the configured
+  // algorithm choice (the tuner may override later via SetTunedToggles).
+  if (controller_)
+    controller_->SetAlgoToggles(hierarchical_allreduce,
+                                hierarchical_allgather, tuned_cache_on_);
+}
+
+void Runtime::SetTunedToggles(bool hierarchical_allreduce,
+                              bool hierarchical_allgather,
+                              bool cache_enabled) {
+  tuned_cache_on_ = cache_enabled;
+  if (controller_)
+    controller_->SetAlgoToggles(hierarchical_allreduce,
+                                hierarchical_allgather, cache_enabled);
 }
 
 void Runtime::SetParams(int64_t fusion_threshold, double cycle_time_ms) {

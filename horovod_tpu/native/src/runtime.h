@@ -94,6 +94,12 @@ class Runtime {
   // of local_size consecutive ranks; ICI-intra / DCN-inter analog).
   void SetTopology(int local_size, bool hierarchical_allreduce,
                    bool hierarchical_allgather);
+  // Categorical autotune toggles (reference parameter_manager.h:91-93):
+  // forwarded to the coordinator, which stamps each Response's algorithm
+  // choice and distributes the cache toggle — execution consults the
+  // RESPONSE, never local state, so mid-run flips stay rank-consistent.
+  void SetTunedToggles(bool hierarchical_allreduce,
+                       bool hierarchical_allgather, bool cache_enabled);
   void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();
@@ -164,8 +170,13 @@ class Runtime {
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
   std::atomic<int64_t> bytes_processed_{0};
   int local_size_ = 1;
-  bool hierarchical_allreduce_ = false;
-  bool hierarchical_allgather_ = false;
+  // The hierarchical toggles live in the Controller (stamped onto each
+  // Response); execution consults resp.hierarchical ONLY — no local
+  // mirror exists to drift out of sync.
+  bool tuned_cache_on_ = true;
+  // Coordinator's distributed cache toggle (ResponseList::cache_on),
+  // adopted each round: gates this worker's bit announcements.
+  std::atomic<bool> coord_cache_on_{true};
   std::atomic<DeviceExecutorFn> device_executor_{nullptr};
   std::atomic<int64_t> last_fused_names_{0};
   std::chrono::steady_clock::time_point counter_start_;

@@ -66,6 +66,7 @@ static void SerializeResponse(const Response& s, Writer& w) {
   w.vec_i64(s.sizes);
   w.vec_u32(s.cache_bits);
   w.u8(s.device ? 1 : 0);
+  w.u8(s.hierarchical ? 1 : 0);
 }
 
 static Response DeserializeResponse(Reader& r) {
@@ -83,6 +84,7 @@ static Response DeserializeResponse(Reader& r) {
   s.sizes = r.vec_i64();
   s.cache_bits = r.vec_u32();
   s.device = r.u8() != 0;
+  s.hierarchical = r.u8() != 0;
   return s;
 }
 
@@ -94,6 +96,7 @@ void SerializeResponseList(const ResponseList& rl, Writer& w) {
   w.u8(rl.shutdown ? 1 : 0);
   w.u8(rl.barrier_release ? 1 : 0);
   w.i32(rl.last_joined_rank);
+  w.u8(rl.cache_on ? 1 : 0);
 }
 
 ResponseList DeserializeResponseList(Reader& r) {
@@ -107,6 +110,7 @@ ResponseList DeserializeResponseList(Reader& r) {
   rl.shutdown = r.u8() != 0;
   rl.barrier_release = r.u8() != 0;
   rl.last_joined_rank = r.i32();
+  rl.cache_on = r.u8() != 0;
   return rl;
 }
 

@@ -57,6 +57,12 @@ struct Response {
   // Execute through the registered device executor on HBM buffers instead
   // of the host TCP data plane (all fused entries are device-resident).
   bool device = false;
+  // Algorithm choice stamped by the COORDINATOR (allreduce: hierarchical
+  // vs flat ring; allgather: hierarchical vs flat allgatherv): the tuner
+  // flips these per sample on rank 0 (reference's categorical autotune
+  // parameters, parameter_manager.h:91-93), and per-response stamping is
+  // what keeps every rank executing the same schedule mid-flip.
+  bool hierarchical = false;
 };
 
 struct ResponseList {
@@ -69,6 +75,11 @@ struct ResponseList {
   bool shutdown = false;                   // all ranks done → stop loop
   bool barrier_release = false;
   int32_t last_joined_rank = -1;           // all ranks joined → returned
+  // Coordinator's current response-cache toggle (autotuned categorical,
+  // reference parameter_manager.h:93): workers stop announcing bits when
+  // the coordinator turned caching off; outstanding bits from the
+  // transition window still resolve (or self-heal via resend_bits).
+  bool cache_on = true;
 };
 
 // --- serialization ---------------------------------------------------------

@@ -47,22 +47,23 @@ def test_parameter_manager_applies_and_freezes():
     applied = []
 
     pm = ParameterManager(
-        apply_fn=lambda fusion, cycle: applied.append((fusion, cycle)),
-        max_samples=4, window_seconds=0.0, warmup_samples=0)
+        apply_fn=lambda *p: applied.append(p),
+        max_samples=6, window_seconds=0.0, warmup_samples=0)
     assert len(applied) == 1  # initial proposal applied
-    for _ in range(4):
+    for _ in range(6):
         pm.record_bytes(1000)
     assert pm.frozen
-    fusion, cycle = pm.current
+    fusion, cycle, har, hag, cache = pm.current
     assert 2 ** 20 <= fusion <= 2 ** 28
     assert 0.5 <= cycle <= 25.0
+    assert all(isinstance(t, bool) for t in (har, hag, cache))
     # Final best re-applied.
     assert applied[-1] == pm.current
 
 
 def test_parameter_manager_logs(tmp_path):
     log = tmp_path / "autotune.csv"
-    pm = ParameterManager(apply_fn=lambda f, c: None, max_samples=2,
+    pm = ParameterManager(apply_fn=lambda *p: None, max_samples=2,
                           window_seconds=0.0, log_file=str(log),
                           warmup_samples=0)
     pm.record_bytes(100)
@@ -70,6 +71,67 @@ def test_parameter_manager_logs(tmp_path):
     lines = log.read_text().strip().splitlines()
     assert len(lines) == 3  # 2 samples + final
     assert lines[-1].startswith("final,")
+    # Each line records the categorical choices: tag, fusion, cycle,
+    # har, hag, cache, score.
+    for ln in lines:
+        cols = ln.split(",")
+        assert len(cols) == 7, cols
+        assert cols[3] in ("0", "1") and cols[4] in ("0", "1") \
+            and cols[5] in ("0", "1"), cols
+
+
+def test_parameter_manager_bootstrap_tries_both_toggle_values():
+    """The deterministic bootstrap plan (the analog of the reference's
+    categorical grids) must try each toggle's flipped value before EI
+    takes over."""
+    seen = []
+    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:]),
+                          max_samples=8, window_seconds=0.0,
+                          warmup_samples=0,
+                          initial_toggles=(True, False, True))
+    for _ in range(4):
+        pm.record_bytes(1000)
+    assert (True, False, True) in seen
+    assert (False, False, True) in seen   # har flipped off
+    assert (True, True, True) in seen     # hag flipped on
+    assert (True, False, False) in seen   # cache flipped off
+
+
+def test_parameter_manager_pinned_toggle_never_flips():
+    """A toggle that cannot take effect (hierarchical with one node,
+    cache at capacity 0) is pinned: never flipped by the plan, never
+    proposed by the GP."""
+    seen = []
+    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:]),
+                          max_samples=10, window_seconds=0.0,
+                          warmup_samples=0, seed=5,
+                          initial_toggles=(True, False, True),
+                          tune_toggles=(True, False, False))
+    while not pm.frozen:
+        pm._observe(1e9)
+    assert all(t[1] is False and t[2] is True for t in seen), seen
+    # The tunable toggle was still explored both ways.
+    assert any(t[0] for t in seen) and any(not t[0] for t in seen)
+
+
+def test_parameter_manager_disables_losing_toggle():
+    """Synthetic oracle for VERDICT r4 #2: hierarchical allreduce costs
+    23% (the single-host regime BENCH_EAGER.json documents at 256 MB);
+    the tuner must freeze with it DISABLED even when the job starts with
+    it enabled."""
+    applied = []
+    pm = ParameterManager(apply_fn=lambda *p: applied.append(p),
+                          max_samples=10, window_seconds=0.0,
+                          warmup_samples=0, seed=3,
+                          initial_toggles=(True, False, True))
+    while not pm.frozen:
+        har = pm.current[2]
+        pm._observe(1e9 * (0.77 if har else 1.0))
+    assert pm.current[2] is False, pm.current
+    assert applied[-1][2] is False
+    # Both values were actually explored before the verdict.
+    assert any(p[2] for p in applied[:-1]) and \
+        any(not p[2] for p in applied[:-1])
 
 
 # --- integration: live 4-proc autotune under the real launcher ----------
@@ -128,6 +190,95 @@ AUTOTUNE_WORKER = textwrap.dedent("""
 """)
 
 
+HIER_AUTOTUNE_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    ctl = eager._controller()
+    if rank == 0:
+        assert ctl._autotune is not None, "--autotune did not engage"
+
+    # ONE 128MB tensor per step: the hierarchical-allreduce single-host
+    # penalty only manifests at large per-RESPONSE payloads
+    # (BENCH_EAGER.json: 0.83x at 64MB, 0.77x at 256MB, parity at 1MB),
+    # and a single tensor keeps fusion-threshold proposals from
+    # splitting the payload into small responses that hide the signal.
+    n_t, elems = 1, 32 * 1024 * 1024
+    bufs = [np.full((elems,), float(rank + 1), dtype=np.float32)
+            for _ in range(n_t)]
+    for it in range(200):
+        hs = [ctl.allreduce_async_(b, b, op=1, name=f"ha.{{it % 2}}.{{j}}")
+              for j, b in enumerate(bufs)]
+        for h in hs:
+            ctl.wait(h)
+        # Collective stop flag: peers cannot see rank 0's tuner state,
+        # so rank 0 announces the freeze through the data plane and all
+        # ranks leave the loop on the same iteration.
+        stop = np.array([1.0 if (rank == 0 and ctl._autotune.frozen)
+                         else 0.0], dtype=np.float32)
+        out = np.zeros_like(stop)
+        ctl.wait(ctl.allreduce_async_(stop, out, op=1,
+                                      name=f"stop.{{it % 2}}"))
+        for b in bufs:
+            b.fill(float(rank + 1))
+        if out[0] > 0:
+            break
+    if rank == 0:
+        final = ctl._autotune.current if ctl._autotune.frozen else None
+        with open({outfile!r}, "w") as f:
+            json.dump({{"final": list(final) if final else None}}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_autotune_disables_hierarchical_on_single_host(tmp_path):
+    """VERDICT r4 #2 'done' criterion: hierarchical allreduce on ONE
+    physical host is pure overhead, and the tuner must turn it off.
+
+    Topology: -H localhost:2,127.0.0.1:2 advertises the single machine
+    as 2 "nodes" x 2 ranks — BENCH_EAGER.json's hierarchical_shm regime
+    (HVD_TPU_LOCAL_SIZE=2), where the cross-"node" leader phases buy
+    nothing and cost ~40% at 128MB (hier/flat ~1.43x measured); with
+    local_size=4 (one node) hierarchical degrades to near-parity and
+    there is nothing to tune away.  The job starts WITH
+    --hierarchical-allreduce; the tuner must freeze with it OFF and the
+    log must record the categorical choices per sample."""
+    from horovod_tpu.runner.launch import main
+    outfile = str(tmp_path / "result.json")
+    log_file = str(tmp_path / "autotune.csv")
+    script = tmp_path / "hier_worker.py"
+    script.write_text(HIER_AUTOTUNE_WORKER.format(repo=REPO,
+                                                  outfile=outfile))
+    rc = main([
+        "-np", "4", "-H", "localhost:2,127.0.0.1:2",
+        "--autotune", "--hierarchical-allreduce",
+        "--autotune-log-file", log_file,
+        "--autotune-warmup-samples", "1",
+        "--autotune-steps-per-sample", "6",
+        "--autotune-bayes-opt-max-samples", "6",
+        sys.executable, str(script)])
+    assert rc == 0
+    final = json.load(open(outfile))["final"]
+    assert final is not None, "tuner never froze"
+    assert final[2] in (False, 0), \
+        f"hierarchical allreduce not disabled: {final}"
+    # The log records categorical choices per sample, and both values of
+    # the hierarchical-allreduce toggle were actually sampled.
+    lines = [ln.split(",") for ln in
+             open(log_file).read().strip().splitlines()]
+    assert all(len(ln) == 7 for ln in lines), lines
+    sampled_har = {ln[3] for ln in lines if ln[0] == "sample"}
+    assert sampled_har == {"0", "1"}, lines
+    assert lines[-1][0] == "final" and lines[-1][3] == "0", lines
+
+
 @pytest.mark.timeout(420)
 def test_autotune_live_job_np4_under_launcher(tmp_path):
     """VERDICT r3 #4: a 4-proc launcher workload with --autotune must show
@@ -145,7 +296,11 @@ def test_autotune_live_job_np4_under_launcher(tmp_path):
         "--autotune-log-file", log_file,
         "--autotune-warmup-samples", "1",
         "--autotune-steps-per-sample", "32",
-        "--autotune-bayes-opt-max-samples", "4",
+        # 4 bootstrap-plan samples (numerics held FIXED for the
+        # controlled categorical comparison) + >=3 EI samples that vary
+        # the numeric dims — the fused-size/params-vary assertions below
+        # need the EI phase.
+        "--autotune-bayes-opt-max-samples", "7",
         sys.executable, str(script)])
     assert rc == 0
     results = [json.load(open(f"{outfile}.{r}")) for r in range(4)]
