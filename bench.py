@@ -553,11 +553,19 @@ def bench_eager_sweep():
     record("flat_tcp", 4, ar_specs([1, 64, 256]),
            dict(base_env, HVD_TPU_DISABLE_SHM="1"))
 
-    # 3. Hierarchical allreduce (2 simulated nodes x 2 local ranks).
+    # 3. Hierarchical allreduce (2 simulated nodes x 2 local ranks) —
+    # default zero-copy CMA star fan-out, plus the forced-chain variant
+    # for the star-vs-chain head-to-head (flat-vs-hier ratios confound
+    # with run-to-run load on this box; the fan-out comparison is the
+    # controlled signal).
     sys.stderr.write("[eager sweep] hierarchical np=4\n")
     record("hierarchical_shm", 4, ar_specs([1, 64, 256]),
            dict(base_env, HVD_TPU_HIERARCHICAL_ALLREDUCE="1",
                 HVD_TPU_LOCAL_SIZE="2"))
+    sys.stderr.write("[eager sweep] hierarchical (chain fan-out) np=4\n")
+    record("hierarchical_shm_chain", 4, ar_specs([64, 256]),
+           dict(base_env, HVD_TPU_HIERARCHICAL_ALLREDUCE="1",
+                HVD_TPU_LOCAL_SIZE="2", HVD_TPU_AR_FANOUT="chain"))
 
     # 3b. Allgather: flat ring vs hierarchical (leader staging + CMA
     # star fan-out, the reference MPIHierarchicalAllgather shape).

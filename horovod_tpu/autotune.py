@@ -83,10 +83,16 @@ class BayesianOptimizer:
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
                  seed: int = 0, n_candidates: int = 512,
-                 noise: float = 0.8):
+                 noise: float = 0.8,
+                 pinned: Optional[dict] = None):
         self.bounds = np.asarray(bounds, dtype=np.float64)
         self.rng = np.random.RandomState(seed)
         self.n_candidates = n_candidates
+        # dim index -> NORMALIZED value, clamped into every candidate:
+        # letting candidates vary a dimension whose observations are
+        # pinned keeps posterior sigma maximal there, so EI chases
+        # unrealizable points and the free dims ride along as noise.
+        self.pinned = dict(pinned or {})
         # The GP standardizes scores to zero-mean/unit-std internally, so
         # this noise level acts on unit-scale observations — directly
         # comparable to the reference's alpha knob
@@ -108,10 +114,17 @@ class BayesianOptimizer:
         self.ys.append(float(y))
         self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
 
+    def _pin(self, u: np.ndarray) -> np.ndarray:
+        for i, v in self.pinned.items():
+            u[..., i] = v
+        return u
+
     def suggest(self) -> np.ndarray:
         if len(self.xs) < 3:  # bootstrap with random exploration
-            return self._denormalize(self.rng.rand(len(self.bounds)))
-        cand = self.rng.rand(self.n_candidates, len(self.bounds))
+            return self._denormalize(self._pin(
+                self.rng.rand(len(self.bounds))))
+        cand = self._pin(self.rng.rand(self.n_candidates,
+                                       len(self.bounds)))
         mu, sigma = self.gp.predict(cand)
         ei = expected_improvement(mu, sigma, max(self.ys))
         return self._denormalize(cand[int(np.argmax(ei))])
@@ -169,8 +182,17 @@ class ParameterManager:
         capacity 0) would burn sample budget re-measuring an identical
         configuration."""
         self._apply = apply_fn
-        self._opt = BayesianOptimizer(self.BOUNDS, seed=seed,
-                                      noise=gp_noise)
+        init_toggles = tuple(bool(t) for t in initial_toggles)
+        if isinstance(tune_toggles, (tuple, list)):
+            tunable = tuple(bool(t) for t in tune_toggles)
+        else:
+            tunable = (bool(tune_toggles),) * 3
+        # Pin the GP's candidate dims for non-tunable toggles (toggle
+        # bounds are [0,1], so normalized == raw value).
+        self._opt = BayesianOptimizer(
+            self.BOUNDS, seed=seed, noise=gp_noise,
+            pinned={2 + i: (1.0 if init_toggles[i] else 0.0)
+                    for i in range(3) if not tunable[i]})
         self._max_samples = max_samples
         self._window = window_seconds
         self._warmup_left = max(0, warmup_samples)
@@ -180,11 +202,8 @@ class ParameterManager:
         self._samples = 0
         self._frozen = False
         self._current = None
-        self._initial_toggles = tuple(bool(t) for t in initial_toggles)
-        if isinstance(tune_toggles, (tuple, list)):
-            self._tunable = tuple(bool(t) for t in tune_toggles)
-        else:
-            self._tunable = (bool(tune_toggles),) * 3
+        self._initial_toggles = init_toggles
+        self._tunable = tunable
         # Deterministic categorical bootstrap (the reference's grids try
         # every categorical value; here: the configured triple, then each
         # TUNABLE toggle flipped once).  Numeric dims stay GP-proposed.

@@ -126,6 +126,8 @@ def load_library():
     lib.hvd_native_set_topology.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
+    lib.hvd_native_last_allreduce_fanout.restype = ctypes.c_int
+    lib.hvd_native_last_bcast_schedule.restype = ctypes.c_int
     lib.hvd_native_adasum_scratch_peak.restype = ctypes.c_int64
     lib.hvd_native_last_fused_names.restype = ctypes.c_int64
     lib.hvd_native_counters.argtypes = [
@@ -666,6 +668,16 @@ class NativeController:
         """0 = flat ring, 1 = hierarchical (chain fan-out),
         2 = hierarchical (CMA star fan-out) — most recent allgather."""
         return self._lib.hvd_native_last_allgather_schedule()
+
+    def last_allreduce_fanout(self) -> int:
+        """0 = flat/none, 1 = chain, 2 = zero-copy CMA star — phase-3
+        fan-out of the most recent hierarchical allreduce/Adasum."""
+        return self._lib.hvd_native_last_allreduce_fanout()
+
+    def last_bcast_schedule(self) -> int:
+        """0 = none yet, 1 = pipelined chain, 2 = zero-copy CMA star —
+        most recent broadcast."""
+        return self._lib.hvd_native_last_bcast_schedule()
 
     def adasum_scratch_peak(self) -> int:
         """Peak scratch bytes of the Adasum VHDD path since last reset."""

@@ -210,6 +210,10 @@ int hvd_native_last_allgather_schedule() {
   return LastAllgatherSchedule();
 }
 
+// 0 = flat/none, 1 = pipelined chain, 2 = zero-copy CMA star.
+int hvd_native_last_allreduce_fanout() { return LastAllreduceFanout(); }
+int hvd_native_last_bcast_schedule() { return LastBroadcastSchedule(); }
+
 // Test/observability hooks: peak scratch bytes of the Adasum VHDD path.
 int64_t hvd_native_adasum_scratch_peak() { return AdasumScratchPeak(); }
 void hvd_native_adasum_scratch_reset() { ResetAdasumScratchPeak(); }

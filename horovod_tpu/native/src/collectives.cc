@@ -262,10 +262,121 @@ Status FullDuplexThreaded(Network& net, int send_peer,
   return st.ok() ? send_st : st;
 }
 
+// Zero-copy CMA star delivery of [buf, buf+total) from `root` to every
+// other member (the reference's shared-memory window for fan-outs,
+// MEMCPY_IN_SHARED_BUFFER in mpi_operations.cc): the root publishes
+// cross-memory descriptors per member and all members pull directly
+// from the root's memory CONCURRENTLY — one copy per member, none for
+// the root, no per-hop forwarding.  The root picks and announces the
+// mode in-band (one flag byte per member) so capability asymmetries can
+// never desynchronize the framing.  When *used_star comes back false
+// the caller runs its chain fallback.
+//
+// `star_min`: payloads below it skip the star AND the mode-byte
+// exchange entirely — `total` is coordinator-provided and identical on
+// every member, so the short-circuit is symmetric (SendStream's CMA
+// path has the same >=1MB cutoff: descriptor+syscall overhead beats a
+// shm-slot memcpy only on large payloads).
+//
+// `skip_off`/`skip_len` (indexed BY RANK, both or neither): each
+// member's own block is excluded from its spans — the allgather case,
+// where a member already holds its contribution; at most two
+// descriptors per member around the hole.
+Status StarFanout(Network& net, uint8_t* buf, int64_t total, int root,
+                  const std::vector<int>& members, bool force_chain,
+                  int64_t star_min, bool* used_star,
+                  const std::vector<int64_t>* skip_off = nullptr,
+                  const std::vector<int64_t>* skip_len = nullptr) {
+  const int rank = net.rank();
+  *used_star = false;
+  if (total < star_min) return Status::OK();
+  uint8_t star = 0;
+  if (rank == root) {
+    star = force_chain ? 0 : 1;
+    for (int peer : members) {
+      if (peer == root) continue;
+      ShmChannel* ch = net.shm_tx(peer);
+      if (ch == nullptr || !ch->refs_enabled()) star = 0;
+    }
+    for (int peer : members) {
+      if (peer == root) continue;
+      Status st = SendStream(net, peer, &star, 1);
+      if (!st.ok()) return st;
+    }
+  } else {
+    Status st = RecvStream(net, root, &star, 1);
+    if (!st.ok()) return st;
+  }
+  *used_star = star != 0;
+  if (!star || total == 0) return Status::OK();
+  // Spans for rank r: [0, total) minus r's own block (when skipping).
+  auto spans_for = [&](int r, std::pair<int64_t, int64_t> out[2]) {
+    int64_t s0 = skip_off ? (*skip_off)[r] : 0;
+    int64_t s1 = s0 + (skip_len ? (*skip_len)[r] : 0);
+    int n = 0;
+    if (s1 <= 0 || s0 >= total) {
+      out[n++] = {0, total};
+    } else {
+      if (s0 > 0) out[n++] = {0, s0};
+      if (s1 < total) out[n++] = {s1, total};
+    }
+    return n;
+  };
+  if (rank == root) {
+    // On ANY failure mid-star, poison EVERY member channel before
+    // returning: live descriptors into a buffer the failed op will free
+    // must not let a slow member complete a "successful" pull from
+    // reused memory (only the failing channel self-poisons).
+    auto poison_all = [&] {
+      for (int peer : members)
+        if (peer != root)
+          if (ShmChannel* ch = net.shm_tx(peer)) ch->Poison();
+    };
+    std::pair<int64_t, int64_t> spans[2];
+    for (int peer : members) {
+      if (peer == root) continue;
+      int n = spans_for(peer, spans);
+      for (int s = 0; s < n; ++s) {
+        if (spans[s].second == spans[s].first) continue;
+        Status st = net.shm_tx(peer)->PushRef(
+            buf + spans[s].first, spans[s].second - spans[s].first);
+        if (!st.ok()) {
+          poison_all();
+          return st;
+        }
+      }
+    }
+    // Drain AFTER publishing to every member: the pulls overlap.
+    for (int peer : members) {
+      if (peer == root) continue;
+      Status st = net.shm_tx(peer)->WaitDrained();
+      if (!st.ok()) {
+        poison_all();
+        return st;
+      }
+    }
+    return Status::OK();
+  }
+  std::pair<int64_t, int64_t> spans[2];
+  int n = spans_for(rank, spans);
+  for (int s = 0; s < n; ++s) {
+    const int64_t want = spans[s].second - spans[s].first;
+    if (want == 0) continue;
+    size_t got = 0;
+    Status st = net.shm_rx(root)->PopInto(
+        buf + spans[s].first, static_cast<size_t>(want), &got);
+    if (!st.ok()) return st;
+    if (static_cast<int64_t>(got) != want)
+      return Status::Error("star fanout: descriptor length mismatch");
+  }
+  return Status::OK();
+}
+
 // Chunk-pipelined intra-node chain: the leader streams the payload down
 // leader -> leader+1 -> ... -> leader+L-1; downstream ranks start
 // forwarding while upstream bytes are still in flight.  Shared by the
-// hierarchical allreduce/allgather/Adasum fan-out phases.
+// hierarchical allreduce/allgather/Adasum fan-out phases (the
+// StarFanout fallback when a channel lacks cross-memory attach).
 Status ChainFanout(Network& net, uint8_t* buf, int64_t nbytes, int rank,
                    int leader, int local_size) {
   const int pos = rank - leader;
@@ -482,12 +593,35 @@ Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
   return RingAllreduceGroup(net, vbuf, count, dtype, op, all);
 }
 
+namespace {
+// Schedule markers for tests/observability: most recent hierarchical
+// allreduce/Adasum fan-out and most recent broadcast on this process
+// (0 = flat/none, 1 = pipelined chain, 2 = zero-copy CMA star).
+std::atomic<int> g_allreduce_fanout{0};
+std::atomic<int> g_bcast_schedule{0};
+
+bool ForceChainEnv(const char* name) {
+  const char* v = getenv(name);
+  return v && std::string(v) == "chain";
+}
+
+// Star cutoff for full-payload fan-outs (allreduce/Adasum/broadcast):
+// below this the chain's shm-slot memcpys beat CMA descriptor+syscall
+// overhead (same rationale as SendStream's CMA threshold).
+constexpr int64_t kStarMinBytes = 1 << 20;
+}  // namespace
+
+int LastAllreduceFanout() { return g_allreduce_fanout.load(); }
+int LastBroadcastSchedule() { return g_bcast_schedule.load(); }
+
 Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
                              DataType dtype, ReduceOp op, int local_size) {
   const int size = net.size();
   const int rank = net.rank();
-  if (local_size <= 1 || size % local_size != 0 || size == local_size)
+  if (local_size <= 1 || size % local_size != 0 || size == local_size) {
+    g_allreduce_fanout.store(0);
     return RingAllreduce(net, vbuf, count, dtype, op);
+  }
   const int node = rank / local_size;
   const int leader = node * local_size;
 
@@ -509,10 +643,26 @@ Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
     if (!st.ok()) return st;
   }
 
-  // Phase 3: leaders broadcast the global result within their node
-  // (intra-node hops ride shm when available).
-  return ChainFanout(net, static_cast<uint8_t*>(vbuf),
-                     count * DataTypeSize(dtype), rank, leader, local_size);
+  // Phase 3: leaders deliver the global result within their node —
+  // zero-copy CMA star when the payload is large and every
+  // leader->member channel supports cross-memory attach, pipelined
+  // chain otherwise (HVD_TPU_AR_FANOUT=chain forces the chain for
+  // benchmarking).  Markers record only schedules that COMPLETED — a
+  // failed fan-out must not read as the schedule that never ran.
+  static const bool force_chain = ForceChainEnv("HVD_TPU_AR_FANOUT");
+  bool used_star = false;
+  st = StarFanout(net, static_cast<uint8_t*>(vbuf),
+                  count * DataTypeSize(dtype), leader, local_members,
+                  force_chain, kStarMinBytes, &used_star);
+  if (!st.ok()) return st;
+  if (used_star) {
+    g_allreduce_fanout.store(2);
+    return st;
+  }
+  st = ChainFanout(net, static_cast<uint8_t*>(vbuf),
+                   count * DataTypeSize(dtype), rank, leader, local_size);
+  if (st.ok()) g_allreduce_fanout.store(1);
+  return st;
 }
 
 namespace {
@@ -633,79 +783,27 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
     return n;
   };
 
-  uint8_t star = 0;
-  if (rank == leader) {
-    // HVD_TPU_AG_FANOUT=chain forces the chain (benchmark head-to-head
-    // comparison knob, like HVD_TPU_ADASUM_ALGO).
-    static const bool force_chain = [] {
-      const char* v = getenv("HVD_TPU_AG_FANOUT");
-      return v && std::string(v) == "chain";
-    }();
-    star = force_chain ? 0 : 1;
-    for (int i = 1; i < local_size; ++i) {
-      ShmChannel* ch = net.shm_tx(leader + i);
-      if (ch == nullptr || !ch->refs_enabled()) star = 0;
-    }
-    for (int i = 1; i < local_size; ++i) {
-      Status st = SendStream(net, leader + i, &star, 1);
-      if (!st.ok()) return st;
-    }
-  } else {
-    Status st = RecvStream(net, leader, &star, 1);
+  // Star via the shared StarFanout (skip spans exclude each member's
+  // own block — it already holds its contribution).  star_min = 0: the
+  // leader staging already dominates small allgathers, and the
+  // schedule-marker tests pin tiny payloads to the star path.
+  // HVD_TPU_AG_FANOUT=chain forces the chain (benchmark head-to-head
+  // comparison knob, like HVD_TPU_ADASUM_ALGO).
+  {
+    static const bool force_chain = ForceChainEnv("HVD_TPU_AG_FANOUT");
+    std::vector<int> members(local_size);
+    for (int i = 0; i < local_size; ++i) members[i] = leader + i;
+    bool used_star = false;
+    Status st = StarFanout(net, buf, total, leader, members, force_chain,
+                           0, &used_star, &offsets, &bytes);
     if (!st.ok()) return st;
-  }
-  // Observability: 1 = hierarchical chain fan-out, 2 = hierarchical CMA
-  // star (this rank's node; tests assert the intended path actually ran).
-  g_allgather_schedule.store(star ? 2 : 1);
-
-  if (star) {
-    std::pair<int64_t, int64_t> spans[2];
-    if (rank == leader) {
-      // On ANY failure mid-star, poison EVERY member channel before
-      // returning: live descriptors into a buffer the failed op will
-      // free must not let a slow member complete a "successful" pull
-      // from reused memory (only the failing channel self-poisons).
-      auto poison_all = [&] {
-        for (int i = 1; i < local_size; ++i)
-          if (ShmChannel* ch = net.shm_tx(leader + i)) ch->Poison();
-      };
-      for (int i = 1; i < local_size; ++i) {
-        const int peer = leader + i;
-        int n = minus(0, total, offsets[peer], offsets[peer] + bytes[peer],
-                      spans);
-        for (int s = 0; s < n; ++s) {
-          if (spans[s].second == spans[s].first) continue;
-          Status st = net.shm_tx(peer)->PushRef(
-              buf + spans[s].first, spans[s].second - spans[s].first);
-          if (!st.ok()) {
-            poison_all();
-            return st;
-          }
-        }
-      }
-      // Drain AFTER publishing to every member: the pulls overlap.
-      for (int i = 1; i < local_size; ++i) {
-        Status st = net.shm_tx(leader + i)->WaitDrained();
-        if (!st.ok()) {
-          poison_all();
-          return st;
-        }
-      }
-      return Status::OK();
+    // Observability: 1 = hierarchical chain fan-out, 2 = hierarchical
+    // CMA star (this rank's node; tests assert the intended path
+    // actually ran).  Stored only for schedules that COMPLETED.
+    if (used_star) {
+      g_allgather_schedule.store(2);
+      return st;
     }
-    int n = minus(0, total, offsets[rank], offsets[rank] + bytes[rank],
-                  spans);
-    for (int s = 0; s < n; ++s) {
-      const int64_t want = spans[s].second - spans[s].first;
-      if (want == 0) continue;
-      size_t got = 0;
-      Status st = net.shm_rx(leader)->PopInto(
-          buf + spans[s].first, static_cast<size_t>(want), &got);
-      if (!st.ok()) return st;
-      if (static_cast<int64_t>(got) != want)
-        return Status::Error("allgather star: descriptor length mismatch");
-    }
-    return Status::OK();
   }
   const int64_t kChunk = 4 << 20;
   for (int64_t off = 0; off < total; off += kChunk) {
@@ -731,6 +829,7 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
       }
     }
   }
+  g_allgather_schedule.store(1);
   return Status::OK();
 }
 
@@ -739,6 +838,26 @@ Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
   const int rank = net.rank();
   if (size == 1 || nbytes == 0) return Status::OK();
   uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  // Zero-copy CMA star when the payload is large and every root->rank
+  // channel supports cross-memory attach (single-host broadcast: one
+  // concurrent pull per rank instead of size-1 chained
+  // store-and-forward hops); pipelined chain otherwise
+  // (HVD_TPU_BCAST_FANOUT=chain forces it).  Small broadcasts skip the
+  // star and its O(size) mode-byte exchange entirely — nbytes is known
+  // identically on every rank, so the short-circuit is symmetric.
+  {
+    static const bool force_chain = ForceChainEnv("HVD_TPU_BCAST_FANOUT");
+    std::vector<int> all(size);
+    for (int i = 0; i < size; ++i) all[i] = i;
+    bool used_star = false;
+    Status st = StarFanout(net, buf, nbytes, root, all, force_chain,
+                           kStarMinBytes, &used_star);
+    if (!st.ok()) return st;
+    if (used_star) {
+      g_bcast_schedule.store(2);
+      return st;
+    }
+  }
   // Rotate so root is position 0 in the chain; forward chunk-by-chunk so
   // the chain pipelines (downstream ranks start receiving while upstream
   // bytes are still in flight) instead of store-and-forwarding the whole
@@ -758,6 +877,7 @@ Status ChainBroadcast(Network& net, void* vbuf, int64_t nbytes, int root) {
       if (!st.ok()) return st;
     }
   }
+  g_bcast_schedule.store(1);
   return Status::OK();
 }
 
@@ -1136,8 +1256,10 @@ Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
   const int rank = net.rank();
   const int n_nodes = local_size > 0 ? size / local_size : 0;
   if (local_size <= 1 || size % local_size != 0 || size == local_size ||
-      (n_nodes & (n_nodes - 1)) != 0)
+      (n_nodes & (n_nodes - 1)) != 0) {
+    g_allreduce_fanout.store(0);
     return AdasumAllreduce(net, vbuf, count, dtype);
+  }
   if (count == 0) return Status::OK();
   const int node = rank / local_size;
   const int leader = node * local_size;
@@ -1159,10 +1281,23 @@ Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
     ScaleBuffer(vbuf, count, dtype, 1.0 / local_size);
   }
 
-  // Phase 3: leaders fan the result down the intra-node chain
-  // (same pipelined schedule as HierarchicalAllreduce phase 3).
-  return ChainFanout(net, static_cast<uint8_t*>(vbuf),
-                     count * DataTypeSize(dtype), rank, leader, local_size);
+  // Phase 3: leaders deliver the result within their node (same star-
+  // or-chain schedule as HierarchicalAllreduce phase 3; markers record
+  // only completed schedules).
+  static const bool force_chain = ForceChainEnv("HVD_TPU_AR_FANOUT");
+  bool used_star = false;
+  st = StarFanout(net, static_cast<uint8_t*>(vbuf),
+                  count * DataTypeSize(dtype), leader, local_members,
+                  force_chain, kStarMinBytes, &used_star);
+  if (!st.ok()) return st;
+  if (used_star) {
+    g_allreduce_fanout.store(2);
+    return st;
+  }
+  st = ChainFanout(net, static_cast<uint8_t*>(vbuf),
+                   count * DataTypeSize(dtype), rank, leader, local_size);
+  if (st.ok()) g_allreduce_fanout.store(1);
+  return st;
 }
 
 }  // namespace

@@ -58,6 +58,10 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
 // this process (0 = flat ring, 1 = hierarchical with chain
 // fan-out, 2 = hierarchical with CMA star fan-out).
 int LastAllgatherSchedule();
+// Most recent hierarchical allreduce/Adasum fan-out and most recent
+// broadcast schedule (0 = flat/none, 1 = chain, 2 = zero-copy CMA star).
+int LastAllreduceFanout();
+int LastBroadcastSchedule();
 
 // In-place broadcast of buf from root (chain schedule).
 Status ChainBroadcast(Network& net, void* buf, int64_t nbytes, int root);

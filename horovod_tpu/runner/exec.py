@@ -181,22 +181,37 @@ def _watch_exit(w: WorkerProcess, on_exit: Callable[[SlotInfo, int], None]):
 
 def wait_all(workers: List[WorkerProcess],
              timeout: Optional[float] = None) -> int:
-    """Wait for all workers; on first failure, terminate the rest
-    (fail-fast) and return its exit code."""
+    """Wait for all workers; on the first failure — in EXIT order, not
+    rank order — terminate the rest (fail-fast) and return its exit
+    code.  Waiting on workers sequentially would leave a crash of rank
+    k unnoticed while rank 0 still runs, hanging the job on survivors
+    blocked in collectives with a dead peer (the reference's
+    safe_shell_exec terminates everything on any failure immediately).
+    ``timeout`` is the overall deadline; 124 on expiry."""
+    import queue as queue_mod
+    import time as time_mod
+    done: "queue_mod.Queue" = queue_mod.Queue()
+    for w in workers:
+        threading.Thread(target=lambda w=w: done.put((w, w.proc.wait())),
+                         daemon=True).start()
     result = 0
-    pending = list(workers)
-    try:
-        while pending:
-            w = pending[0]
-            code = w.proc.wait(timeout=timeout)
-            w.exit_code = code
-            pending.pop(0)
-            if code != 0 and result == 0:
-                result = code
-                terminate_all(pending)
-    except subprocess.TimeoutExpired:
-        terminate_all(pending)
-        return 124
+    remaining = len(workers)
+    # Monotonic: an NTP step must neither fire the timeout early nor
+    # push it out indefinitely.
+    deadline = None if timeout is None else time_mod.monotonic() + timeout
+    while remaining:
+        try:
+            wait_s = (None if deadline is None
+                      else max(deadline - time_mod.monotonic(), 0.001))
+            w, code = done.get(timeout=wait_s)
+        except queue_mod.Empty:
+            terminate_all([x for x in workers if x.proc.poll() is None])
+            return 124
+        w.exit_code = code
+        remaining -= 1
+        if code != 0 and result == 0:
+            result = code
+            terminate_all([x for x in workers if x.proc.poll() is None])
     return result
 
 

@@ -1,0 +1,172 @@
+"""The REAL ssh branch of the launcher, end to end (VERDICT r4 #5).
+
+A PATH-shimmed ``ssh`` stands in for the binary: it validates the
+launcher's invocation shape (-o options, -p port, -i identity, host,
+single remote command string), records it, scrubs its inherited
+HVD_TPU_*/HOROVOD_* environment (a real remote shell would not inherit
+the driver's env), and executes the remote command locally with stdin
+attached — so the env-assignments-in-argv and secret-via-stdin paths of
+``runner/exec.py:build_command`` and the ssh connectivity probe of
+``runner/probe.py`` all genuinely run.  Hosts are loopback aliases
+(127.0.0.2/127.0.0.3): NOT in ``_is_local``'s set, so the launcher takes
+the remote path, yet routable on this machine.
+
+Reference analog: gloo_run.py:105-268 exercised via containerized
+multi-host integration tests.
+"""
+
+import json
+import os
+import stat
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SSH_SHIM = textwrap.dedent("""\
+    #!/bin/bash
+    # Test double for ssh: validate flags, record the call, exec the
+    # remote command locally.
+    log="${HVD_TPU_TEST_SSH_LOG:?}"
+    port="" ident="" host=""
+    while (($#)); do
+      case "$1" in
+        -o) shift 2 ;;                 # -o Key=Value options are fine
+        -p) port="$2"; shift 2 ;;
+        -i) ident="$2"; shift 2 ;;
+        -*) echo "ssh-shim: unexpected flag $1" >&2; exit 12 ;;
+        *) host="$1"; shift; break ;;
+      esac
+    done
+    if [ -z "$host" ] || (($# == 0)); then
+      echo "ssh-shim: missing host or remote command" >&2; exit 12
+    fi
+    # Real ssh joins remaining args with spaces into ONE remote line.
+    remote="$*"
+    logged="${remote//$'\\n'/<NL>}"     # keep one log line per call
+    printf 'HOST=%s PORT=%s IDENT=%s CMD=%s\\n' \\
+        "$host" "$port" "$ident" "$logged" >> "$log"
+    # A real remote shell would NOT inherit the driver's environment:
+    # anything the worker needs must have traveled in the remote line
+    # (env assignments) or through stdin (the secret).  Scrub so leaks
+    # in build_command fail loudly here.
+    for v in $(compgen -e | grep -E '^(HVD_TPU_|HOROVOD_)'); do
+      unset "$v"
+    done
+    exec bash -c "$remote"
+    """)
+
+SSH_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.allreduce(
+        np.full((8,), float(hvd.rank() + 1), dtype=np.float32),
+        op=hvd.Sum, name="ssh.ar")
+    with open({outfile!r} + f".{{hvd.rank()}}", "w") as f:
+        json.dump({{
+            "rank": hvd.rank(), "size": hvd.size(),
+            "local_size": hvd.local_size(),
+            "cross_size": hvd.cross_size(),
+            "sum": float(np.asarray(out)[0]),
+            "secret_present":
+                bool(os.environ.get("HVD_TPU_RENDEZVOUS_SECRET")),
+            "hostname": os.environ.get("HVD_TPU_HOSTNAME", ""),
+        }}, f)
+    hvd.shutdown()
+""")
+
+
+@pytest.mark.timeout(300)
+def test_ssh_launch_two_fake_hosts(tmp_path, monkeypatch):
+    """np=4 across two loopback-alias 'hosts' through the shimmed ssh:
+    the probe, env-via-argv, secret-via-stdin, -p/-i flags and fail-fast
+    capture all run the REAL remote codepath."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    shim = bin_dir / "ssh"
+    shim.write_text(SSH_SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+    ssh_log = tmp_path / "ssh.log"
+    ident = tmp_path / "id_test"
+    ident.write_text("not-a-real-key\n")
+
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("HVD_TPU_TEST_SSH_LOG", str(ssh_log))
+
+    outfile = str(tmp_path / "result")
+    script = tmp_path / "worker.py"
+    script.write_text(SSH_WORKER.format(repo=REPO, outfile=outfile))
+
+    from horovod_tpu.runner.launch import main
+    rc = main([
+        "-np", "4", "-H", "127.0.0.2:2,127.0.0.3:2",
+        "--worker-platform", "cpu",
+        "--ssh-port", "2299", "--ssh-identity-file", str(ident),
+        sys.executable, str(script)])
+    assert rc == 0
+
+    results = [json.load(open(f"{outfile}.{r}")) for r in range(4)]
+    for r in results:
+        assert r["size"] == 4 and r["local_size"] == 2 \
+            and r["cross_size"] == 2, r
+        assert r["sum"] == pytest.approx(10.0)  # 1+2+3+4
+        # The secret arrived — through stdin, since the shim scrubbed
+        # the inherited environment.
+        assert r["secret_present"], r
+    assert {r["hostname"] for r in results} == {"127.0.0.2", "127.0.0.3"}
+
+    log_lines = ssh_log.read_text().strip().splitlines()
+    # The NIC probe sshed to both hosts, then one worker launch per slot.
+    hosts_seen = [ln.split(" ", 1)[0] for ln in log_lines]
+    assert hosts_seen.count("HOST=127.0.0.2") >= 3  # probe + 2 slots
+    assert hosts_seen.count("HOST=127.0.0.3") >= 3
+    # Every invocation carried the configured -p port.
+    assert all(" PORT=2299 " in ln for ln in log_lines), log_lines
+    worker_lines = [ln for ln in log_lines
+                    if "read -r HVD_TPU_RENDEZVOUS_SECRET" in ln]
+    assert len(worker_lines) == 4, log_lines
+    for ln in worker_lines:
+        # Worker launches carry the -i identity file; env assignments
+        # travel in the remote line; the secret VALUE must not (it rides
+        # stdin — /proc/*/cmdline is world-readable on both machines).
+        assert f" IDENT={ident} " in ln, ln
+        assert "HVD_TPU_RANK=" in ln and "HVD_TPU_SIZE=" in ln, ln
+        assert " && cd " in ln, ln
+        assert "HVD_TPU_RENDEZVOUS_SECRET='" not in ln and \
+            "HVD_TPU_RENDEZVOUS_SECRET=\"" not in ln, ln
+
+
+@pytest.mark.timeout(300)
+def test_ssh_launch_fail_fast_captures_remote_failure(tmp_path,
+                                                      monkeypatch):
+    """A remote worker that dies must fail the whole launch with its
+    exit code surfaced (fail-fast), through the same shimmed-ssh path."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    shim = bin_dir / "ssh"
+    shim.write_text(SSH_SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("HVD_TPU_TEST_SSH_LOG", str(tmp_path / "ssh.log"))
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        if os.environ.get("HVD_TPU_RANK") == "3":
+            sys.exit(7)  # simulated remote failure before init
+        import time
+        time.sleep(600)  # survivors hang: fail-fast must kill them
+    """))
+    from horovod_tpu.runner.launch import main
+    rc = main([
+        "-np", "4", "-H", "127.0.0.2:2,127.0.0.3:2",
+        "--worker-platform", "cpu",
+        sys.executable, str(script)])
+    assert rc == 7
