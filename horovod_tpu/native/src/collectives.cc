@@ -609,6 +609,29 @@ bool ForceChainEnv(const char* name) {
 // below this the chain's shm-slot memcpys beat CMA descriptor+syscall
 // overhead (same rationale as SendStream's CMA threshold).
 constexpr int64_t kStarMinBytes = 1 << 20;
+
+// Shared phase-3 delivery for the hierarchical allreduce family
+// (allreduce + Adasum): star-or-chain under HVD_TPU_AR_FANOUT, with
+// the completed schedule recorded in g_allreduce_fanout.
+Status StarOrChainArFanout(Network& net, void* vbuf, int64_t nbytes,
+                           int rank, int leader,
+                           const std::vector<int>& local_members,
+                           int local_size) {
+  static const bool force_chain = ForceChainEnv("HVD_TPU_AR_FANOUT");
+  bool used_star = false;
+  Status st = StarFanout(net, static_cast<uint8_t*>(vbuf), nbytes, leader,
+                         local_members, force_chain, kStarMinBytes,
+                         &used_star);
+  if (!st.ok()) return st;
+  if (used_star) {
+    g_allreduce_fanout.store(2);
+    return st;
+  }
+  st = ChainFanout(net, static_cast<uint8_t*>(vbuf), nbytes, rank, leader,
+                   local_size);
+  if (st.ok()) g_allreduce_fanout.store(1);
+  return st;
+}
 }  // namespace
 
 int LastAllreduceFanout() { return g_allreduce_fanout.load(); }
@@ -649,20 +672,8 @@ Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
   // chain otherwise (HVD_TPU_AR_FANOUT=chain forces the chain for
   // benchmarking).  Markers record only schedules that COMPLETED — a
   // failed fan-out must not read as the schedule that never ran.
-  static const bool force_chain = ForceChainEnv("HVD_TPU_AR_FANOUT");
-  bool used_star = false;
-  st = StarFanout(net, static_cast<uint8_t*>(vbuf),
-                  count * DataTypeSize(dtype), leader, local_members,
-                  force_chain, kStarMinBytes, &used_star);
-  if (!st.ok()) return st;
-  if (used_star) {
-    g_allreduce_fanout.store(2);
-    return st;
-  }
-  st = ChainFanout(net, static_cast<uint8_t*>(vbuf),
-                   count * DataTypeSize(dtype), rank, leader, local_size);
-  if (st.ok()) g_allreduce_fanout.store(1);
-  return st;
+  return StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
+                             rank, leader, local_members, local_size);
 }
 
 namespace {
@@ -1284,20 +1295,8 @@ Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
   // Phase 3: leaders deliver the result within their node (same star-
   // or-chain schedule as HierarchicalAllreduce phase 3; markers record
   // only completed schedules).
-  static const bool force_chain = ForceChainEnv("HVD_TPU_AR_FANOUT");
-  bool used_star = false;
-  st = StarFanout(net, static_cast<uint8_t*>(vbuf),
-                  count * DataTypeSize(dtype), leader, local_members,
-                  force_chain, kStarMinBytes, &used_star);
-  if (!st.ok()) return st;
-  if (used_star) {
-    g_allreduce_fanout.store(2);
-    return st;
-  }
-  st = ChainFanout(net, static_cast<uint8_t*>(vbuf),
-                   count * DataTypeSize(dtype), rank, leader, local_size);
-  if (st.ok()) g_allreduce_fanout.store(1);
-  return st;
+  return StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
+                             rank, leader, local_members, local_size);
 }
 
 }  // namespace
