@@ -496,19 +496,26 @@ def bench_xla_sweep():
     import subprocess
 
     model = os.environ.get("BENCH_SWEEP_MODEL", "resnet")
+    if model == "xla_sweep":
+        raise SystemExit("BENCH_SWEEP_MODEL=xla_sweep would recurse")
+    on_cpu = (not _tpu_transport_alive()
+              or os.environ.get("BENCH_FORCE_CPU") == "1")
     sets_env = os.environ.get("BENCH_XLA_FLAGS_SETS")
     if sets_env is not None:
         flag_sets = [s.strip() for s in sets_env.split(";")]
-    elif _tpu_transport_alive() and \
-            os.environ.get("BENCH_FORCE_CPU") != "1":
-        flag_sets = _TPU_FLAG_SETS
     else:
-        flag_sets = _CPU_FLAG_SETS
+        flag_sets = _CPU_FLAG_SETS if on_cpu else _TPU_FLAG_SETS
     results = []
     here = os.path.abspath(__file__)
     for fs in flag_sets:
         env = dict(os.environ)
         env["BENCH_MODEL"] = model
+        if on_cpu:
+            # The children must run the REAL model mode on the CPU
+            # mesh, not degrade to the scaling fallback — the sweep
+            # would otherwise rank near-flag-insensitive efficiency
+            # fractions as if they were throughput.
+            env["BENCH_FORCE_CPU"] = "1"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + fs).strip()
         sys.stderr.write(f"[xla sweep] XLA_FLAGS={fs!r}\n")
         try:

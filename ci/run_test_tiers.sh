@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Tiered test pipeline (the reference's docker-compose/Buildkite matrix
+# analog, docker-compose.test.yml + .buildkite/gen-pipeline.sh):
+#
+#   ci/run_test_tiers.sh fast     # tier 1: single-process unit tests
+#   ci/run_test_tiers.sh matrix   # tier 2: multi-process integration
+#   ci/run_test_tiers.sh slow     # tier 3: elastic + slow bench-asserts
+#   ci/run_test_tiers.sh all      # everything, tier by tier
+#
+# Tiers run SEQUENTIALLY and each tier is one pytest invocation: the
+# multi-process tests contend for cores and flake when two pytest
+# processes overlap (tests/conftest.py enforces per-test timeouts).
+#
+# The partition is validated by tests/test_ci_tiers.py (the golden-test
+# spirit of the reference's test/single/test_buildkite.py): every
+# tests/test_*.py file must belong to exactly one tier, so a new test
+# file can never silently fall out of CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Tier 1 — fast, single-process: model/op/unit layers (~5 min).
+TIER_FAST=(
+  test_basics.py test_bert.py test_chips.py test_ci_tiers.py
+  test_collectives.py test_flash_attention.py test_launch_flags.py
+  test_optimizers.py test_parallel.py test_probe_rendezvous.py
+  test_resnet.py test_response_cache.py test_timeline.py
+  test_transformer.py test_utils_ops.py
+)
+
+# Tier 2 — multi-process matrix: native runtime, transports, device
+# plane, framework front-ends, launcher (~20 min).
+TIER_MATRIX=(
+  test_adasum_native.py test_async_api.py test_autotune.py
+  test_device_matrix.py
+  test_eager_device_plane.py test_examples.py test_frontend_matrix.py
+  test_fuzz_native.py test_hierarchical.py test_integrations.py
+  test_mxnet_frontend.py test_native_matrix.py test_native_runtime.py
+  test_runner.py test_shm_transport.py test_spark_estimators.py
+  test_ssh_launch.py test_stall.py test_tf_custom_op.py
+  test_tf_frontend.py test_torch_adasum.py test_torch_async_grouped.py
+  test_torch_extras.py test_torch_frontend.py
+)
+
+# Tier 3 — elastic recovery + slow-marked perf/regression asserts.
+TIER_SLOW=(
+  test_eager_bench.py test_elastic.py test_tf_elastic.py
+)
+
+run_tier() {
+  local name="$1"; shift
+  local files=()
+  for f in "$@"; do files+=("tests/$f"); done
+  echo "=== tier: ${name} ($# files) ==="
+  python -m pytest "${files[@]}" -q
+}
+
+case "${1:-all}" in
+  fast)   run_tier fast "${TIER_FAST[@]}" ;;
+  matrix) run_tier matrix "${TIER_MATRIX[@]}" ;;
+  slow)   run_tier slow "${TIER_SLOW[@]}" ;;
+  all)
+    run_tier fast "${TIER_FAST[@]}"
+    run_tier matrix "${TIER_MATRIX[@]}"
+    run_tier slow "${TIER_SLOW[@]}"
+    ;;
+  list)
+    # Machine-readable partition for tests/test_ci_tiers.py.
+    printf '%s\n' "${TIER_FAST[@]}" | sed 's/^/fast /'
+    printf '%s\n' "${TIER_MATRIX[@]}" | sed 's/^/matrix /'
+    printf '%s\n' "${TIER_SLOW[@]}" | sed 's/^/slow /'
+    ;;
+  *)
+    echo "usage: $0 {fast|matrix|slow|all|list}" >&2; exit 2 ;;
+esac

@@ -90,7 +90,7 @@ def test_output_filename_per_rank_capture(tmp_path):
 def test_parameter_manager_warmup_and_steps():
     from horovod_tpu.autotune import ParameterManager
     applied = []
-    pm = ParameterManager(lambda f, c: applied.append((f, c)),
+    pm = ParameterManager(lambda *p: applied.append(p),
                           max_samples=2, warmup_samples=1,
                           steps_per_sample=3)
     # Step-counted windows: 3 reports close one window.
