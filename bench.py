@@ -83,6 +83,24 @@ def _transformer_train_flops_per_seq(n_params, seq_len, n_layers, d_model):
     return dense + attn
 
 
+def _bert_train_flops_per_seq(cfg, n_pred=None):
+    """Exact matmul-FLOPs accounting for the BERT step (train = 3x fwd).
+
+    Encoder: per token per layer qkv 6d^2 + proj 2d^2 + mlp 4*d*ff;
+    attention 4*S^2*d per layer per seq (scores + AV).  MLM head: the
+    transform (2d^2) and tied-vocab projection (2dV) run per predicted
+    position — S positions on the dense path, n_pred on the gathered
+    path (real-BERT max_predictions_per_seq semantics), so the gathered
+    step's reported MFU counts only the FLOPs it actually executes."""
+    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
+                      cfg.vocab_size)
+    enc = s * L * (8.0 * d * d + 4.0 * d * ff)
+    attn = L * 4.0 * s * s * d
+    pos = s if n_pred is None else n_pred
+    head = pos * (2.0 * d * d + 2.0 * d * v)
+    return 3.0 * (enc + attn + head)
+
+
 def _host_sync(x):
     """Device->host transfer as the timing barrier: on some TPU transports
     (axon tunnel) jax.block_until_ready can return before compute
@@ -124,43 +142,64 @@ def bench_bert():
     batch = per_chip_batch * n_dev
 
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    # gathered (default): MLM head on the ~15% masked positions only —
+    # the real-BERT pretraining formulation (max_predictions_per_seq).
+    # dense: logits at every position (the pre-round-5 shape).
+    gathered = os.environ.get("BENCH_MLM", "gathered") == "gathered"
     cfg = bert.BertConfig(seq_len=seq_len, dtype=jnp.bfloat16, remat=remat)
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
-    step, shard_params = bert.make_train_step(cfg, mesh, opt)
+    step, shard_params = bert.make_train_step(cfg, mesh, opt,
+                                              gathered=gathered)
     params = shard_params(params)
     opt_state = opt.init(params)
-    inputs, labels = bert.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
+    if gathered:
+        inputs, positions, labels = bert.synthetic_mlm_batch(
+            jax.random.PRNGKey(1), cfg, batch)
+        n_pred = positions.shape[-1]
+    else:
+        inputs, labels = bert.synthetic_batch(jax.random.PRNGKey(1), cfg,
+                                              batch)
+        positions, n_pred = None, None
 
     n_params = _param_count(params)
-    flops_per_seq = _transformer_train_flops_per_seq(
-        n_params, seq_len, cfg.n_layers, cfg.d_model)
+    flops_per_seq = _bert_train_flops_per_seq(cfg, n_pred=n_pred)
 
     # Fold the timed block into one device call (lax.scan), like the
     # resnet mode: per-step Python dispatch is an RPC on tunneled
     # transports and would cap MFU regardless of the model's compute.
-    def multi_step(params, opt_state, inputs, labels, k):
+    def multi_step(params, opt_state, inputs, positions, labels, k):
         def body(carry, _):
             p, o = carry
-            p, o, loss = step(p, o, inputs, labels)
+            if gathered:
+                p, o, loss = step(p, o, inputs, positions, labels)
+            else:
+                p, o, loss = step(p, o, inputs, labels)
             return (p, o), loss
         (params, opt_state), losses = jax.lax.scan(
             body, (params, opt_state), None, length=k)
         return params, opt_state, losses[-1]
 
     jmulti = jax.jit(multi_step, donate_argnums=(0, 1),
-                     static_argnums=(4,))
+                     static_argnums=(5,))
 
-    del warmup  # one untimed scan call IS the warmup (single compile)
-    params, opt_state, loss = jmulti(params, opt_state, inputs, labels,
-                                     iters)
-    _host_sync(loss)
+    del warmup  # untimed scan calls ARE the warmup (single compile)
+    # First call compiles; subsequent warm calls amortize the tunneled
+    # transport's one-time first-execution cost (~3x, measured) so the
+    # timed best-of block sees steady state.
+    for _ in range(1 + int(os.environ.get("BENCH_WARM_BLOCKS", "1"))):
+        params, opt_state, loss = jmulti(params, opt_state, inputs,
+                                         positions, labels, iters)
+        _host_sync(loss)
 
-    t0 = time.perf_counter()
-    params, opt_state, loss = jmulti(params, opt_state, inputs, labels,
-                                     iters)
-    _host_sync(loss)
-    dt = time.perf_counter() - t0
+    dt = None
+    for _ in range(max(1, int(os.environ.get("BENCH_TIMED_BLOCKS", "2")))):
+        t0 = time.perf_counter()
+        params, opt_state, loss = jmulti(params, opt_state, inputs,
+                                         positions, labels, iters)
+        _host_sync(loss)
+        block_dt = time.perf_counter() - t0
+        dt = block_dt if dt is None else min(dt, block_dt)
 
     seq_per_sec = batch * iters / dt / n_dev
     achieved = seq_per_sec * flops_per_seq
@@ -175,6 +214,9 @@ def bench_bert():
         "vs_baseline": round(seq_per_sec / baseline_seq_per_sec, 3),
         "mfu": round(achieved / peak, 4) if peak else None,
         "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "mlm_head": ("gathered(%d)" % n_pred) if gathered else "dense",
+        "batch_per_chip": per_chip_batch,
+        "remat": remat,
         "params": n_params,
         "platform": jax.devices()[0].platform,
         **({"forced_cpu": True}
@@ -283,13 +325,23 @@ def _timed_resnet(mesh, per_chip_batch, image_size, depth, width, iters,
     _host_sync(loss)
     compile_s = time.perf_counter() - t_c0
 
-    # Timed scanned block — the device-feed number, and in host mode
-    # the baseline the feed overhead is measured against.
-    t0 = time.perf_counter()
-    params, stats, opt_state, loss = jstep(params, stats, opt_state,
-                                           images, labels, iters)
-    _host_sync(loss)
-    scan_dt = time.perf_counter() - t0
+    # Tunneled transports charge a large one-time cost on the FIRST
+    # post-compile execution of a program (measured ~3x on the axon
+    # relay, matmul microbench rep0 vs rep1) — warm past it, then take
+    # the fastest of BENCH_TIMED_BLOCKS so the reported number is the
+    # steady-state silicon rate, not relay amortization.
+    for _ in range(int(os.environ.get("BENCH_WARM_BLOCKS", "1"))):
+        params, stats, opt_state, loss = jstep(params, stats, opt_state,
+                                               images, labels, iters)
+        _host_sync(loss)
+    scan_dt = None
+    for _ in range(max(1, int(os.environ.get("BENCH_TIMED_BLOCKS", "2")))):
+        t0 = time.perf_counter()
+        params, stats, opt_state, loss = jstep(params, stats, opt_state,
+                                               images, labels, iters)
+        _host_sync(loss)
+        block_dt = time.perf_counter() - t0
+        scan_dt = block_dt if scan_dt is None else min(scan_dt, block_dt)
     dt = scan_dt
 
     if feed == "host":

@@ -43,7 +43,8 @@ TIER_MATRIX=(
 
 # Tier 3 — elastic recovery + slow-marked perf/regression asserts.
 TIER_SLOW=(
-  test_eager_bench.py test_elastic.py test_tf_elastic.py
+  test_churn_soak.py test_eager_bench.py test_elastic.py
+  test_tf_elastic.py
 )
 
 run_tier() {
