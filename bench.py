@@ -141,7 +141,12 @@ def bench_bert():
     mesh = create_mesh({"dp": n_dev, "mp": 1})
     batch = per_chip_batch * n_dev
 
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    # BENCH_REMAT: 1 (full, default) | 0 (off) | dots (save matmul
+    # outputs, recompute elementwise only — near-off compute, low mem).
+    remat_env = os.environ.get("BENCH_REMAT", "1")
+    if remat_env not in ("1", "0", "dots"):
+        raise SystemExit(f"BENCH_REMAT must be 1|0|dots, got {remat_env!r}")
+    remat = {"1": True, "0": False}.get(remat_env, remat_env)
     # gathered (default): MLM head on the ~15% masked positions only —
     # the real-BERT pretraining formulation (max_predictions_per_seq).
     # dense: logits at every position (the pre-round-5 shape).
@@ -238,7 +243,10 @@ def _resnet_setup(mesh, per_chip_batch, image_size, depth, width,
     n_dev = mesh.devices.size
     batch = per_chip_batch * n_dev
     cfg = resnet.ResNetConfig(depth=depth, num_classes=1000, width=width,
-                              dtype=jnp.bfloat16)
+                              dtype=jnp.bfloat16,
+                              # BENCH_S2D=1: space-to-depth stem (same
+                              # math, MXU-dense 12-channel contraction).
+                              stem_s2d=os.environ.get("BENCH_S2D") == "1")
     params, stats = resnet.init_params(jax.random.PRNGKey(0), cfg)
     tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9)) \
         if distributed else optax.sgd(0.1, momentum=0.9)
@@ -464,6 +472,9 @@ def bench_scaling(degraded_from=None):
         payload["vs_baseline"] = None
         payload["degraded_from"] = degraded_from
         payload["degraded_reason"] = "tpu_tunnel_unreachable"
+        # Real-chip numbers DO exist for round 5: point the reader at
+        # the committed silicon session instead of this fallback.
+        payload["silicon_evidence"] = "BENCH_SILICON_r05.json"
     _emit(payload)
 
 

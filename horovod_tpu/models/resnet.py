@@ -30,6 +30,12 @@ class ResNetConfig(NamedTuple):
     dtype: Any = jnp.bfloat16
     sync_bn_axis: Optional[str] = None   # mesh axis for cross-replica BN
     bn_momentum: float = 0.9
+    # Compute the 7x7/s2 stem as a 4x4/s1 conv over a 2x2 space-to-depth
+    # transform of the input (3 -> 12 channels): bit-identical math, but
+    # the MXU sees a dense 12-channel contraction at half the spatial
+    # size instead of a 3-channel one padded 42x to the lane width — the
+    # standard TPU ResNet stem formulation (MLPerf conv0 space-to-depth).
+    stem_s2d: bool = False
 
 
 def _conv_init(key, kh, kw, cin, cout):
@@ -100,6 +106,39 @@ def _conv(x, w, stride=1, padding="SAME"):
         padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
+def _space_to_depth2(x):
+    """(B, H, W, C) -> (B, H/2, W/2, 4C), channel order (di, dj, c)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // 2, w // 2, 4 * c)
+
+
+def _s2d_stem_kernel(w):
+    """Transform the (7,7,C,K) stride-2 stem kernel into the equivalent
+    (4,4,4C,K) stride-1 kernel over the space-to-depth input.
+
+    With SAME padding (k=7, s=2, even input) the conv reads
+    X[2i+p-2, 2j+q-2]; writing p = 2a+di maps taps onto s2d channel
+    (di, dj, c) at spatial offset (a-1, b-1) — i.e. a 4x4 window with
+    asymmetric padding (1,2).  Tap p=7 never occurs: zero-pad 7->8."""
+    kh, kw, c, k = w.shape
+    assert (kh, kw) == (7, 7), (kh, kw)
+    wp = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    wp = wp.reshape(4, 2, 4, 2, c, k)          # (a, di, b, dj, c, k)
+    wp = wp.transpose(0, 2, 1, 3, 4, 5)        # (a, b, di, dj, c, k)
+    return wp.reshape(4, 4, 4 * c, k)
+
+
+def _stem_s2d_conv(x, w):
+    y = _space_to_depth2(x)
+    w4 = _s2d_stem_kernel(w)
+    return lax.conv_general_dilated(
+        y, w4.astype(x.dtype), window_strides=(1, 1),
+        padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 def _batch_norm(x, bn, stats, cfg: ResNetConfig, training: bool):
     """BN in fp32; with ``sync_bn_axis`` the batch moments are allreduced
     over the mesh axis (reference SyncBatchNormalization semantics).
@@ -137,7 +176,10 @@ def apply(params, stats, images, cfg: ResNetConfig,
     bottleneck = cfg.depth in BOTTLENECK
     x = images.astype(cfg.dtype)
     new_stats: Dict[str, Any] = {}
-    x = _conv(x, params["stem"]["conv"], stride=2)
+    if cfg.stem_s2d:
+        x = _stem_s2d_conv(x, params["stem"]["conv"])
+    else:
+        x = _conv(x, params["stem"]["conv"], stride=2)
     x, new_stats["stem"] = _batch_norm(x, params["stem"]["bn"],
                                        stats["stem"], cfg, training)
     x = jax.nn.relu(x)

@@ -118,3 +118,28 @@ def test_gathered_train_step_reduces_loss(mesh):
                                        positions, labels)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("remat", [True, "dots"])
+def test_remat_modes_same_loss_and_grad(remat):
+    """Rematerialization choices change memory/compute scheduling, never
+    values: loss and gradients agree across none/full/dots policies."""
+    cfg = CFG._replace(remat=remat)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    inputs, positions, labels = bert.synthetic_mlm_batch(
+        jax.random.PRNGKey(1), cfg, 4)
+
+    def loss_fn(p):
+        return bert.serial_forward_loss(cfg, p, inputs, labels,
+                                        positions=positions)
+
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    base_cfg = CFG._replace(remat=False)
+    base_loss, base_g = jax.value_and_grad(
+        lambda p: bert.serial_forward_loss(base_cfg, p, inputs, labels,
+                                           positions=positions))(params)
+    np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(base_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

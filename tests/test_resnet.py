@@ -98,3 +98,26 @@ def test_sync_bn_moments_match_global_batch():
     np.testing.assert_allclose(np.asarray(sync_mean),
                                np.asarray(full_stats["stem"]["mean"]),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_stem_space_to_depth_equivalence():
+    """stem_s2d computes the identical function: the (7,7,C,K)/s2 stem
+    re-expressed as a (4,4,4C,K)/s1 conv over a 2x2 space-to-depth input
+    (MLPerf conv0 transform) — kernel-level and full-model parity."""
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (2, 32, 32, 3), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (7, 7, 3, 16),
+                          jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(resnet._conv(x, w, stride=2)),
+        np.asarray(resnet._stem_s2d_conv(x, w)), rtol=1e-5, atol=1e-5)
+
+    cfg = resnet.ResNetConfig(depth=18, num_classes=10, width=8,
+                          dtype=jnp.float32)
+    params, stats = resnet.init_params(jax.random.PRNGKey(2), cfg)
+    imgs = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 64, 3),
+                             jnp.float32)
+    l1, _ = resnet.apply(params, stats, imgs, cfg)
+    l2, _ = resnet.apply(params, stats, imgs, cfg._replace(stem_s2d=True))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-4, atol=1e-4)
