@@ -109,6 +109,10 @@ def _conv(x, w, stride=1, padding="SAME"):
 def _space_to_depth2(x):
     """(B, H, W, C) -> (B, H/2, W/2, 4C), channel order (di, dj, c)."""
     b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            f"stem_s2d requires even input H/W, got {(h, w)}; use the "
+            "default stem for odd sizes")
     x = x.reshape(b, h // 2, 2, w // 2, 2, c)
     x = x.transpose(0, 1, 3, 2, 4, 5)
     return x.reshape(b, h // 2, w // 2, 4 * c)

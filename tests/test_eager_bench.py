@@ -88,3 +88,38 @@ def test_bandwidth_grows_out_of_latency_regime():
               "nbytes": 64 << 10, "iters": 6}, _ar(8, 4)]
     bw = _measure({"HVD_TPU_CYCLE_TIME": "1"}, specs)
     assert bw["allreduce/8MB"] > 3 * bw["allreduce/64KB"], bw
+
+
+@pytest.mark.timeout(300)
+def test_longctx_bench_mode_runs_ring_and_dense():
+    """BENCH_MODEL=longctx (the long-context causal-LM benchmark) emits
+    its JSON line on the CPU mesh in both attention regimes: dense
+    single-mesh and ring sequence-parallel over mp=2 — the silicon-day
+    command needs zero edits."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    # Strip ambient BENCH_* so stray shell env cannot flip the
+    # hard-coded mesh/attn expectations below.
+    base = {k: v for k, v in os.environ.items()
+            if not k.startswith("BENCH_")}
+    base.update({
+        "BENCH_MODEL": "longctx", "BENCH_FORCE_CPU": "1",
+        "BENCH_ITERS": "2", "BENCH_BATCH": "1",
+        "BENCH_SEQ_LEN": "128", "BENCH_DMODEL": "64",
+        "BENCH_HEADS": "4", "BENCH_DFF": "128", "BENCH_LAYERS": "2",
+        "BENCH_WARM_BLOCKS": "0", "BENCH_TIMED_BLOCKS": "1"})
+    for extra, want_attn, want_mesh in (
+            ({}, "megatron", {"dp": 2, "mp": 1}),
+            ({"BENCH_MP": "2", "BENCH_ATTN": "ring"}, "ring",
+             {"dp": 1, "mp": 2})):
+        out = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "bench.py")],
+            env={**base, **extra}, capture_output=True, text=True,
+            timeout=280)
+        assert out.returncode == 0, out.stderr[-2000:]
+        row = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert row["metric"] == "longctx_lm_train_throughput"
+        assert row["value"] > 0
+        assert row["attn_mode"] == want_attn
+        assert row["mesh"] == want_mesh, row
