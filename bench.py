@@ -272,6 +272,9 @@ def bench_longctx():
     iters = int(os.environ.get("BENCH_ITERS", "10"))
     mp = int(os.environ.get("BENCH_MP", "1"))
     attn = os.environ.get("BENCH_ATTN", "megatron" if mp == 1 else "ring")
+    if attn not in ("megatron", "ring", "ulysses"):
+        raise SystemExit(
+            f"BENCH_ATTN must be megatron|ring|ulysses, got {attn!r}")
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
         want = int(os.environ.get("BENCH_SCALING_DEVICES", "2"))

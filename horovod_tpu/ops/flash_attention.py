@@ -49,9 +49,20 @@ _NEG_INF = -1e30
 _BLOCK_CANDIDATES = (512, 256, 128)
 
 
-def _pick_block(size: int) -> Optional[int]:
+def _pick_block(size: int, env: str = "") -> Optional[int]:
     """Largest 128-aligned divisor block, else the whole dim (Mosaic's
-    equal-to-array-dim exemption) when small enough to fit VMEM tiles."""
+    equal-to-array-dim exemption) when small enough to fit VMEM tiles.
+
+    ``env`` names an override variable (HVD_TPU_FLASH_BLOCK_Q/K) for
+    silicon block-size tuning: the override must divide the dimension,
+    else it is ignored and auto-selection applies."""
+    if env:
+        try:
+            forced = int(os.environ.get(env, "0"))
+        except ValueError:
+            forced = 0  # non-numeric override: ignore, auto-select
+        if forced > 0 and size % forced == 0:
+            return forced
     for c in _BLOCK_CANDIDATES:
         if size % c == 0 and c <= size:
             return c
@@ -417,8 +428,8 @@ def _supported(q, k) -> Optional[Tuple[int, int]]:
     sk = k.shape[1]
     if d % 8 != 0 or d > 512:
         return None
-    bq = _pick_block(sq)
-    bk = _pick_block(sk)
+    bq = _pick_block(sq, env="HVD_TPU_FLASH_BLOCK_Q")
+    bk = _pick_block(sk, env="HVD_TPU_FLASH_BLOCK_K")
     if bq is None or bk is None:
         return None
     return bq, bk

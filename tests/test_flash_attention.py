@@ -118,3 +118,21 @@ def test_ring_flash_gradients_ride_the_ring(sp_mesh):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-2, rtol=1e-2)
+
+
+def test_block_size_env_override(monkeypatch):
+    """HVD_TPU_FLASH_BLOCK_Q/K force the kernel block sizes (silicon
+    tuning knob); non-divisor overrides are ignored, and the forced
+    blocks produce the same numbers."""
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "128")
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "64")
+    q, k, v = _qkv(s=256)
+    assert fa._supported(q, k) == (128, 64)
+    ref = ra.reference_attention(q, k, v, causal=True)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                             block_q=128, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=1e-3)
+    # Non-divisor override falls back to auto-selection.
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "96")
+    assert fa._supported(q, k)[0] == 256
