@@ -35,6 +35,10 @@ def main():
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--dense-head", action="store_true",
+                    help="compute MLM logits at every position instead "
+                         "of the default gathered masked-position head "
+                         "(real-BERT max_predictions_per_seq)")
     args = ap.parse_args()
 
     hvd.init()
@@ -47,7 +51,9 @@ def main():
                           seq_len=args.seq_len, dtype=jnp.bfloat16)
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
-    step, shard_params = bert.make_train_step(cfg, mesh, opt)
+    gathered = not args.dense_head
+    step, shard_params = bert.make_train_step(cfg, mesh, opt,
+                                              gathered=gathered)
     params = shard_params(params)
     opt_state = opt.init(params)
 
@@ -55,9 +61,15 @@ def main():
     key = jax.random.PRNGKey(1)
     for i in range(args.steps):
         key, sub = jax.random.split(key)
-        inputs, labels = bert.synthetic_batch(sub, cfg, batch)
+        if gathered:
+            inputs, positions, labels = bert.synthetic_mlm_batch(
+                sub, cfg, batch)
+            batch_args = (inputs, positions, labels)
+        else:
+            inputs, labels = bert.synthetic_batch(sub, cfg, batch)
+            batch_args = (inputs, labels)
         t0 = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, inputs, labels)
+        params, opt_state, loss = step(params, opt_state, *batch_args)
         loss = float(loss)
         if hvd.rank() == 0:
             print(f"step {i:3d}  mlm_loss {loss:.4f}  "

@@ -61,7 +61,11 @@ def _pick_block(size: int, env: str = "") -> Optional[int]:
             forced = int(os.environ.get(env, "0"))
         except ValueError:
             forced = 0  # non-numeric override: ignore, auto-select
-        if forced > 0 and size % forced == 0:
+        # Same legality envelope as auto-selection: a 128-aligned
+        # divisor, or the whole (small) dim — anything else would fail
+        # Mosaic's lane alignment / VMEM fit on silicon.
+        if forced > 0 and size % forced == 0 and (
+                forced % 128 == 0 or (forced == size and size <= 512)):
             return forced
     for c in _BLOCK_CANDIDATES:
         if size % c == 0 and c <= size:

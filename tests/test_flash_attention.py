@@ -122,17 +122,19 @@ def test_ring_flash_gradients_ride_the_ring(sp_mesh):
 
 def test_block_size_env_override(monkeypatch):
     """HVD_TPU_FLASH_BLOCK_Q/K force the kernel block sizes (silicon
-    tuning knob); non-divisor overrides are ignored, and the forced
-    blocks produce the same numbers."""
+    tuning knob) through the auto-selection path — no explicit kwargs,
+    so the env plumbing itself is what is exercised; illegal overrides
+    (non-divisor, non-128-aligned, oversized whole-dim) are ignored."""
     monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "128")
-    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "64")
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "128")
     q, k, v = _qkv(s=256)
-    assert fa._supported(q, k) == (128, 64)
+    assert fa._supported(q, k) == (128, 128)
     ref = ra.reference_attention(q, k, v, causal=True)
-    out = fa.flash_attention(q, k, v, causal=True, interpret=True,
-                             block_q=128, block_k=64)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-2, rtol=1e-3)
-    # Non-divisor override falls back to auto-selection.
-    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "96")
-    assert fa._supported(q, k)[0] == 256
+    # Illegal overrides fall back to auto-selection: non-divisor,
+    # non-128-aligned divisor, and whole-dim beyond the VMEM cap.
+    for bad in ("96", "64", "1024"):
+        monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", bad)
+        assert fa._supported(q, k)[0] == 256, bad
