@@ -65,7 +65,8 @@ def _pick_block(size: int, env: str = "") -> Optional[int]:
         # divisor, or the whole (small) dim — anything else would fail
         # Mosaic's lane alignment / VMEM fit on silicon.
         if forced > 0 and size % forced == 0 and (
-                forced % 128 == 0 or (forced == size and size <= 512)):
+                (forced % 128 == 0 and forced <= 512)
+                or (forced == size and size <= 512)):
             return forced
     for c in _BLOCK_CANDIDATES:
         if size % c == 0 and c <= size:

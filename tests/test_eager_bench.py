@@ -112,6 +112,8 @@ def test_longctx_bench_mode_runs_ring_and_dense():
     for extra, want_attn, want_mesh in (
             ({}, "megatron", {"dp": 2, "mp": 1}),
             ({"BENCH_MP": "2", "BENCH_ATTN": "ring"}, "ring",
+             {"dp": 1, "mp": 2}),
+            ({"BENCH_MP": "2", "BENCH_ATTN": "ulysses"}, "ulysses",
              {"dp": 1, "mp": 2})):
         out = subprocess.run(
             [_sys.executable, os.path.join(REPO, "bench.py")],

@@ -134,7 +134,12 @@ def test_block_size_env_override(monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-2, rtol=1e-3)
     # Illegal overrides fall back to auto-selection: non-divisor,
-    # non-128-aligned divisor, and whole-dim beyond the VMEM cap.
+    # non-128-aligned divisor, and non-divisor larger than the dim.
     for bad in ("96", "64", "1024"):
         monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", bad)
         assert fa._supported(q, k)[0] == 256, bad
+    # A 128-aligned divisor above the 512 VMEM cap is rejected too:
+    # s=1024 forced to 1024 falls back to the auto-selected 512.
+    q2, k2, _ = _qkv(s=1024)
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "1024")
+    assert fa._supported(q2, k2)[0] == 512
