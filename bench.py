@@ -113,6 +113,21 @@ def _host_sync(x):
     return np.asarray(x)
 
 
+def _serialized_step_profile(step_once, n):
+    """One untimed warm call, then n host-synced timed calls of the
+    single-step path (dispatch visible, no scan amortization); returns
+    the sorted per-step latency list in seconds.  step_once() must run
+    one step, rebind its own donated state, and host-sync."""
+    step_once()
+    lat = []
+    for _ in range(n):
+        t1 = time.perf_counter()
+        step_once()
+        lat.append(time.perf_counter() - t1)
+    lat.sort()
+    return lat
+
+
 def _timed_scan_blocks(run_block, warm=None):
     """Shared timing harness for the scan-folded benchmark modes.
 
@@ -223,7 +238,30 @@ def bench_bert():
                                         positions, labels, iters)
         return loss
 
-    dt = _timed_scan_blocks(run_block)
+    t_c0 = time.perf_counter()
+    _host_sync(run_block())  # compile + first exec
+    compile_s = time.perf_counter() - t_c0
+    dt = _timed_scan_blocks(
+        run_block, warm=int(os.environ.get("BENCH_WARM_BLOCKS", "1")))
+
+    profile = None
+    if os.environ.get("BENCH_PROFILE") == "1":
+        # Serialized single-step latencies (dispatch visible) vs the
+        # scanned amortized rate — same diagnostic as the resnet mode.
+        args = (inputs, positions, labels) if gathered else (inputs,
+                                                             labels)
+
+        def step_once():
+            st["p"], st["o"], loss = step(st["p"], st["o"], *args)
+            _host_sync(loss)
+
+        lat = _serialized_step_profile(step_once, min(iters, 10))
+        profile = {
+            "compile_plus_first_exec_s": round(compile_s, 3),
+            "scan_step_ms": round(dt / iters * 1e3, 3),
+            "serialized_step_ms_p50": round(lat[len(lat) // 2] * 1e3, 3),
+            "serialized_step_ms_max": round(lat[-1] * 1e3, 3),
+        }
 
     seq_per_sec = batch * iters / dt / n_dev
     achieved = seq_per_sec * flops_per_seq
@@ -242,6 +280,7 @@ def bench_bert():
         "batch_per_chip": per_chip_batch,
         "remat": remat,
         "params": n_params,
+        **({"profile": profile} if profile else {}),
         "platform": jax.devices()[0].platform,
         **({"forced_cpu": True}
            if os.environ.get("BENCH_FORCE_CPU") == "1" else {}),
@@ -488,18 +527,15 @@ def _timed_resnet(mesh, per_chip_batch, image_size, depth, width, iters,
     if profile is not None:
         # Serialized single-step latency distribution: each step host-
         # synced, so dispatch+execute (no pipeline overlap) is visible.
-        # One untimed call first — jstep1 may not be compiled yet.
-        params, stats, opt_state, loss = jstep1(
-            params, stats, opt_state, images, labels)
-        _host_sync(loss)
-        lat = []
-        for _ in range(min(iters, 10)):
-            t1 = time.perf_counter()
-            params, stats, opt_state, loss = jstep1(
-                params, stats, opt_state, images, labels)
+        st1 = {"p": params, "s": stats, "o": opt_state}
+
+        def step_once():
+            st1["p"], st1["s"], st1["o"], loss = jstep1(
+                st1["p"], st1["s"], st1["o"], images, labels)
             _host_sync(loss)
-            lat.append(time.perf_counter() - t1)
-        lat.sort()
+
+        lat = _serialized_step_profile(step_once, min(iters, 10))
+        params, stats, opt_state = st1["p"], st1["s"], st1["o"]
         profile.update({
             # Scan warmup call = compile + iters executed steps; the
             # executed part is ~scan_step_ms * iters.
