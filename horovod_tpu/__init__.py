@@ -35,7 +35,8 @@ from .ops.collective import (
 )
 from .ops.compression import Compression
 from .optimizers import (
-    DistributedOptimizer, allreduce_gradients, grad, value_and_grad,
+    DistributedOptimizer, ZeroShardedOptimizer, allreduce_gradients,
+    grad, value_and_grad,
     broadcast_parameters, broadcast_optimizer_state,
     broadcast_object, allgather_object,
 )
