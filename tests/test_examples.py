@@ -12,11 +12,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
 
-def _run(args, timeout=240):
+def _run(args, timeout=240, extra_env=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # Keep the axon TPU plugin entirely out of the subprocess: with the
     # tunnel down, any accidental hardware-backend init hangs forever.
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    if extra_env:
+        env.update(extra_env)
     return subprocess.run([sys.executable] + args, capture_output=True,
                           text=True, timeout=timeout, env=env, cwd=REPO)
 
@@ -61,3 +63,11 @@ def test_elastic_pytorch_example_2proc(monkeypatch):
     rc = main(["-np", "2", "--controller-port", "28771", sys.executable,
                os.path.join(EXAMPLES, "elastic_pytorch_train.py")])
     assert rc == 0
+
+
+@pytest.mark.timeout(300)
+def test_zero_optimizer_example():
+    r = _run([os.path.join(EXAMPLES, "zero_optimizer.py")], extra_env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "per-rank opt state" in r.stdout
