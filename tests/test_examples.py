@@ -12,13 +12,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
 
-def _run(args, timeout=240, extra_env=None):
+def _run(args, timeout=240):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # Keep the axon TPU plugin entirely out of the subprocess: with the
     # tunnel down, any accidental hardware-backend init hangs forever.
+    # (conftest.py already placed --xla_force_host_platform_device_count
+    # in XLA_FLAGS, so subprocesses inherit the 8-device mesh.)
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    if extra_env:
-        env.update(extra_env)
     return subprocess.run([sys.executable] + args, capture_output=True,
                           text=True, timeout=timeout, env=env, cwd=REPO)
 
@@ -67,7 +67,6 @@ def test_elastic_pytorch_example_2proc(monkeypatch):
 
 @pytest.mark.timeout(300)
 def test_zero_optimizer_example():
-    r = _run([os.path.join(EXAMPLES, "zero_optimizer.py")], extra_env={
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    r = _run([os.path.join(EXAMPLES, "zero_optimizer.py")])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "per-rank opt state" in r.stdout
