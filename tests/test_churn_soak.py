@@ -49,7 +49,7 @@ SOAK_WORKER = textwrap.dedent("""
     # blacklisted permanently, and min_np=2 makes exactly one
     # blacklisted host affordable; the other churn events are capacity
     # changes (scale-up/down), which do not blacklist.
-    KILLS = {{"127.0.0.1:0": 40}}
+    KILLS = {{"127.0.0.1:0": {kill_epoch}}}
 
     hvd.init()
     state = elastic.ObjectState(epoch=0)
@@ -161,14 +161,21 @@ def _read_logs(prefix, slots):
 
 
 @pytest.mark.slow
-@pytest.mark.timeout(900)
+# Timeout scales with the configured soak length (~0.35 s/epoch observed;
+# 900 s floor covers the default 200 epochs with a wide margin).
+@pytest.mark.timeout(max(900, 2 * int(os.environ.get(
+    "HVD_TPU_SOAK_EPOCHS", "200"))))
 def test_churn_soak_kill_scale_device_autotune_join(tmp_path):
     log = str(tmp_path / "log")
     mark = str(tmp_path / "mark")
-    epochs = 200
+    # HVD_TPU_SOAK_EPOCHS cranks the duration (e.g. 600 ~= 10 min with
+    # extra scale events landing proportionally later); the default
+    # ~200 keeps the slow tier under ~90 s.
+    epochs = int(os.environ.get("HVD_TPU_SOAK_EPOCHS", "200"))
     script = tmp_path / "worker.py"
     script.write_text(SOAK_WORKER.format(repo=REPO, log=log, mark=mark,
-                                         epochs=epochs))
+                                         epochs=epochs,
+                                         kill_epoch=epochs // 5))
     import socket
     hostname = socket.gethostname()
     # Three distinct local "hosts" (all launch locally via _is_local):
@@ -192,20 +199,23 @@ def test_churn_soak_kill_scale_device_autotune_join(tmp_path):
 
     def churn_schedule():
         import time as _t
-        # After the kill settles (someone logs epoch 10 at size 2):
-        # scale UP by growing localhost to 2 slots; after epoch 16,
-        # scale back DOWN.  The blacklisted 127.0.0.1 stays listed —
-        # the driver must keep filtering it.
-        deadline = _t.time() + 600
+        # After the kill (epochs//5) settles: scale UP at epochs*2//5 by
+        # growing localhost to 2 slots; scale back DOWN at epochs*7//10.
+        # The blacklisted 127.0.0.1 stays listed — the driver must keep
+        # filtering it.  Deadline scales with the configured soak length
+        # (~0.35 s/epoch observed, generous 3x margin).
+        deadline = _t.time() + max(600, epochs)
         while _t.time() < deadline:
-            if any(e["epoch"] >= 80 for e in _read_logs(log, slots)):
+            if any(e["epoch"] >= epochs * 2 // 5
+                   for e in _read_logs(log, slots)):
                 discovery.set([HostInfo("localhost", 2),
                                HostInfo("127.0.0.1", 1),
                                HostInfo(hostname, 1)])
                 break
             _t.sleep(0.3)
         while _t.time() < deadline:
-            if any(e["epoch"] >= 140 for e in _read_logs(log, slots)):
+            if any(e["epoch"] >= epochs * 7 // 10
+                   for e in _read_logs(log, slots)):
                 discovery.set(list(base_hosts))
                 break
             _t.sleep(0.3)
