@@ -24,7 +24,7 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.parallel import ring_attention as ra

@@ -41,6 +41,7 @@ from .optimizers import (
     broadcast_object, allgather_object,
 )
 from .parallel import mesh as mesh_lib
+from . import checkpoint
 from . import elastic
 
 __all__ = [
@@ -59,8 +60,9 @@ __all__ = [
     "allreduce_async", "allgather_async", "broadcast_async",
     "alltoall_async", "poll", "synchronize",
     "Compression",
-    "DistributedOptimizer", "allreduce_gradients", "grad", "value_and_grad",
+    "DistributedOptimizer", "ZeroShardedOptimizer", "allreduce_gradients",
+    "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "elastic",
+    "mesh_lib", "checkpoint", "elastic",
 ]

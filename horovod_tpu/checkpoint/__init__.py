@@ -1,0 +1,41 @@
+"""Sharded checkpoint engine — ZeRO-1 state save/restore with elastic
+resharding.
+
+The piece ``broadcast_optimizer_state`` points at when it refuses
+rank-distinct ZeRO state: every rank writes its own shard, rank 0
+commits the manifest last (a partial write is never restorable), and a
+checkpoint written at world size N restores into a job running at world
+size M by reassembling the flat moment buffers and re-slicing.  Storage
+is plain numpy ``.npz`` + JSON — no Orbax required — layered under
+``utils/checkpoint.py``'s rank-0-writes path for replicated state.
+
+See ``docs/checkpointing.md`` for the manifest format, resharding
+semantics, and the ZeRO lifecycle.
+"""
+
+from .manifest import (
+    FORMAT_VERSION, MANIFEST_NAME, REPLICATED, SHARDED,
+    LeafSpec, Manifest, shard_filename, step_dirname,
+)
+from .engine import (
+    commit, gc_steps, is_committed, latest_step, list_steps,
+    read_manifest, read_shard, restore_leaves, save_leaves, step_dir,
+    write_shard, RestoredStep,
+)
+from .reshard import pad_flat, reassemble, reshard, shard_of
+from .zero import (
+    has_zero_leaves, is_zero_state,
+    restore_zero_state, save_zero_state, zero_init, zero_state_specs,
+)
+
+__all__ = [
+    "FORMAT_VERSION", "MANIFEST_NAME", "REPLICATED", "SHARDED",
+    "LeafSpec", "Manifest", "shard_filename", "step_dirname",
+    "commit", "gc_steps", "is_committed", "latest_step", "list_steps",
+    "read_manifest", "read_shard", "restore_leaves", "save_leaves",
+    "step_dir", "write_shard", "RestoredStep",
+    "pad_flat", "reassemble", "reshard", "shard_of",
+    "has_zero_leaves", "is_zero_state",
+    "restore_zero_state", "save_zero_state", "zero_init",
+    "zero_state_specs",
+]

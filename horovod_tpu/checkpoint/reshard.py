@@ -1,0 +1,53 @@
+"""Pure-numpy flat-shard math: pad, slice, reassemble, reshard.
+
+The ZeRO-1 shard layout (optimizers.py ``_my_shard``): a leaf's flat
+value is zero-padded to a multiple of the world size N and viewed as
+``(N, k)``; rank *r* owns row *r*.  Everything here is host-side numpy —
+no JAX, no Orbax — so the engine's durability and elastic-reshard logic
+work in any environment.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def pad_flat(x: np.ndarray, world_size: int) -> np.ndarray:
+    """Flatten and zero-pad to a multiple of ``world_size``."""
+    flat = np.asarray(x).reshape(-1)
+    pad = (-flat.size) % world_size
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), dtype=flat.dtype)])
+    return flat
+
+
+def shard_of(x: np.ndarray, world_size: int, rank: int) -> np.ndarray:
+    """Rank ``rank``'s flat shard of a full (unpadded) value."""
+    flat = pad_flat(x, world_size)
+    return flat.reshape(world_size, flat.size // world_size)[rank]
+
+
+def reassemble(shards: Sequence[np.ndarray], true_size: int) -> np.ndarray:
+    """Concatenate world-ordered shards and truncate the ZeRO padding."""
+    flat = np.concatenate([np.asarray(s).reshape(-1) for s in shards])
+    if flat.size < true_size:
+        raise ValueError(
+            f"shards hold {flat.size} elements < true_size {true_size}")
+    return flat[:true_size]
+
+
+def reshard(shards: Sequence[np.ndarray], true_size: int,
+            new_world_size: int) -> List[np.ndarray]:
+    """Re-slice shards written at world N into ``new_world_size`` shards.
+
+    The logical value is reassembled (padding dropped), re-padded for the
+    new world size, and split — bit-identical logical elements, only the
+    padding tail differs.  This is the elastic-resize path: a checkpoint
+    written by N ranks restores into a job running M ranks.
+    """
+    flat = reassemble(shards, true_size)
+    flat = pad_flat(flat, new_world_size)
+    k = flat.size // new_world_size
+    return [flat[r * k:(r + 1) * k] for r in range(new_world_size)]

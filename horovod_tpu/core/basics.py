@@ -46,6 +46,17 @@ def init(mesh=None,
 
     global_state.config = Config.from_env()
 
+    # --- persistent compilation cache -------------------------------------
+    # HVD_TPU_COMPILE_CACHE_DIR points XLA's persistent cache at a durable
+    # directory so re-runs (and elastic respawns) skip recompilation —
+    # silicon spends its live minutes executing instead of compiling.
+    # Setting the config does NOT initialize the accelerator backend, so
+    # it is safe before the launcher-worker topology resolution below.
+    if global_state.config.compile_cache_dir:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          global_state.config.compile_cache_dir)
+
     # --- topology ---------------------------------------------------------
     # Launcher-spawned workers MUST NOT touch the JAX backend here: N
     # workers initializing the accelerator platform on one host contend for

@@ -34,6 +34,7 @@ HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 ELASTIC = "ELASTIC"
 MESH_AXES = "MESH_AXES"                        # TPU-only: mesh axis spec
+COMPILE_CACHE_DIR = "COMPILE_CACHE_DIR"        # TPU-only: persistent XLA cache
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -102,6 +103,7 @@ class Config:
     hierarchical_allgather: bool = False
     elastic: bool = False
     mesh_axes: str = ""
+    compile_cache_dir: str = ""
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -133,6 +135,7 @@ class Config:
         cfg.hierarchical_allgather = get_bool(HIERARCHICAL_ALLGATHER)
         cfg.elastic = get_bool(ELASTIC)
         cfg.mesh_axes = get_env(MESH_AXES, "") or ""
+        cfg.compile_cache_dir = get_env(COMPILE_CACHE_DIR, "") or ""
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

@@ -154,13 +154,50 @@ class ObjectState(State):
         self.adopt_sync_generation()
 
 
+def _is_zero_sharded(x) -> bool:
+    from ..checkpoint import is_zero_state
+    return is_zero_state(x)
+
+
+def _has_zero_sharded(tree) -> bool:
+    from ..checkpoint import has_zero_leaves
+    return has_zero_leaves(tree)
+
+
 class TpuState(ObjectState):
     """Elastic state for JAX training: params/opt_state pytrees snapshotted
     to host memory on commit, broadcast from rank 0 on sync (the analog of
-    TorchState handlers, torch/elastic/state.py:27-80)."""
+    TorchState handlers, torch/elastic/state.py:27-80).
 
-    def __init__(self, params=None, opt_state=None, **kwargs):
+    ZeRO-sharded optimizer state (``ZeroShardedOptimizer``) is
+    rank-DISTINCT, so it cannot ride the sync broadcast — pass
+    ``checkpoint_dir`` and the sharded leaves get a durable lifecycle
+    through ``horovod_tpu.checkpoint`` instead: ``commit()`` writes
+    every rank's shard plus a rank-0 manifest under
+    ``<checkpoint_dir>/<tree_key>/``, and ``sync()`` after a reset
+    restores the newest committed step, *resharding* the flat moment
+    buffers when the elastic world resized.  Thread the state through
+    ``shard_map`` with ``checkpoint.zero_state_specs`` (global flat
+    buffers partitioned over the data axis) so commits can see every
+    local shard.  Use a fresh ``checkpoint_dir`` per training run: the
+    engine validates pytree structure on restore but cannot tell one
+    run's moments from another's."""
+
+    def __init__(self, params=None, opt_state=None, checkpoint_dir=None,
+                 checkpoint_keep: int = 3, checkpoint_mesh=None, **kwargs):
         self._tree_keys = []
+        self._checkpoint_dir = checkpoint_dir
+        self._checkpoint_keep = checkpoint_keep
+        self._checkpoint_mesh = checkpoint_mesh
+        self._ckpt_next_step = {}
+        # Step of the last FULLY committed checkpoint per tree key:
+        # engine manifest on disk AND the in-memory snapshot both done.
+        # sync() restores this step, not blindly the newest on disk — a
+        # crash after the engine commit but before super().commit()
+        # leaves a disk step one ahead of the rolled-back params, and
+        # pairing those would be exactly the torn state the engine
+        # exists to prevent.
+        self._ckpt_committed_step = {}
         if params is not None:
             self._tree_keys.append("params")
             kwargs["params"] = params
@@ -168,6 +205,59 @@ class TpuState(ObjectState):
             self._tree_keys.append("opt_state")
             kwargs["opt_state"] = opt_state
         super().__init__(**kwargs)
+
+    def _mesh(self):
+        if self._checkpoint_mesh is not None:
+            return self._checkpoint_mesh
+        from ..core import basics
+        return basics.mesh()
+
+    def _zero_dir(self, key: str):
+        import os
+        return os.path.join(self._checkpoint_dir, key)
+
+    def _next_ckpt_step(self, key: str) -> int:
+        # Monotonic across full job relaunches: seeded from the newest
+        # committed step on disk (NOT the sync generation, which resets
+        # to 0 on relaunch and would make gc_steps delete fresh commits
+        # while `latest` kept electing the stale pre-relaunch step).
+        # Ranks agree without a collective because (a) seeding happens
+        # at sync(), when every member — survivor or fresh — reads the
+        # same committed disk state (the cache is cleared there, so a
+        # committer crash that tore a step cannot leave survivors'
+        # counters ahead of a respawned rank's disk-derived seed), and
+        # (b) between syncs the counters advance in lockstep, with
+        # save_zero_state's post-commit barrier making the manifest
+        # durable before any process moves on.
+        if key not in self._ckpt_next_step:
+            from ..checkpoint import latest_step
+            latest = latest_step(self._zero_dir(key))
+            self._ckpt_next_step[key] = 0 if latest is None else latest + 1
+        return self._ckpt_next_step[key]
+
+    def commit(self):
+        saved_steps = {}
+        if self._checkpoint_dir is not None:
+            from ..checkpoint import save_zero_state
+            for k in self._tree_keys:
+                tree = getattr(self, k)
+                if _has_zero_sharded(tree):
+                    step = self._next_ckpt_step(k)
+                    save_zero_state(self._zero_dir(k), tree, step=step,
+                                    mesh=self._mesh(),
+                                    keep=self._checkpoint_keep)
+                    self._ckpt_next_step[k] = step + 1
+                    saved_steps[k] = step
+        try:
+            super().commit()
+        except HostsUpdatedInterrupt:
+            # The base commit raises AFTER save() snapshotted — the
+            # step IS fully committed (disk AND snapshot); the interrupt
+            # only re-runs rendezvous.  Record it, or the next sync()
+            # would pair current params with one-step-old moments.
+            self._ckpt_committed_step.update(saved_steps)
+            raise
+        self._ckpt_committed_step.update(saved_steps)
 
     def save(self):
         # Device→host snapshot so a TPU reset cannot lose it.
@@ -188,9 +278,68 @@ class TpuState(ObjectState):
         from ..optimizers import broadcast_parameters
         if root is None:
             root = self.elect_sync_root()
+        # Membership changed: drop cached commit-step counters so every
+        # member (survivor or fresh) re-seeds from the same committed
+        # disk state — a survivor's counter may be ahead of disk if the
+        # previous committer crashed mid-step.
+        self._ckpt_next_step.clear()
+        # Agree on WHICH step to restore: the root survivor's record of
+        # the last fully committed step (disk + snapshot).  A disk step
+        # with no surviving in-memory commit is a torn commit — params
+        # rolled back past it, so restoring it would pair step-K moments
+        # with step-K-1 params.  Fresh roots (relaunch) have no record
+        # and take the newest committed disk step.
+        from ..core.state import global_state
+        chosen = dict(self._ckpt_committed_step)
+        if global_state.initialized and global_state.size > 1:
+            from ..optimizers import broadcast_object
+            chosen = broadcast_object(chosen, root_rank=root)
+            self._ckpt_committed_step = dict(chosen)
         for k in self._tree_keys:
-            setattr(self, k, broadcast_parameters(getattr(self, k),
-                                                  root_rank=root))
+            tree = getattr(self, k)
+            if _has_zero_sharded(tree):
+                # Rank-distinct shards cannot ride the broadcast — rank
+                # 0's slice would overwrite every other rank's.  Restore
+                # the newest committed engine step instead, resharding
+                # the flat moment buffers when the elastic world resized.
+                if self._checkpoint_dir is not None:
+                    from ..checkpoint import (is_committed, latest_step,
+                                              restore_zero_state)
+                    step = chosen.get(k)
+                    if step is not None and not is_committed(
+                            self._zero_dir(k), step):
+                        step = None  # recorded step GC'd or torn: fall back
+                    if step is None:
+                        step = latest_step(self._zero_dir(k))
+                    if step is not None:
+                        setattr(self, k, restore_zero_state(
+                            self._zero_dir(k), tree, mesh=self._mesh(),
+                            step=step))
+                        continue
+                else:
+                    log.warning(
+                        "TpuState.%s holds ZeRO-sharded leaves but no "
+                        "checkpoint_dir was given; skipping sync for "
+                        "them — a world resize will NOT restore these "
+                        "moments (see docs/checkpointing.md)", k)
+                # No committed step (or no dir): the ZeRO leaves stay
+                # local (identical init state before the first commit),
+                # but replicated leaves living alongside them — e.g. a
+                # chained transform's count/schedule state — must still
+                # reach rejoining workers.
+                flat, treedef = jax.tree_util.tree_flatten(
+                    tree, is_leaf=_is_zero_sharded)
+                plain = [i for i, l in enumerate(flat)
+                         if not _is_zero_sharded(l)]
+                if plain:
+                    synced = broadcast_parameters(
+                        [flat[i] for i in plain], root_rank=root)
+                    for i, v in zip(plain, synced):
+                        flat[i] = v
+                    setattr(self, k, jax.tree_util.tree_unflatten(
+                        treedef, flat))
+                continue
+            setattr(self, k, broadcast_parameters(tree, root_rank=root))
         # Sync the plain-object part too.
         object_keys = [k for k in self._saved_state
                        if k not in self._tree_keys]

@@ -246,7 +246,7 @@ def serial_forward_loss(cfg: BertConfig, params, tokens, labels,
 
 
 def make_loss_fn(cfg: BertConfig, mesh, gathered: bool = False):
-    from jax import shard_map
+    from ..compat import shard_map
     specs = param_specs(cfg)
 
     if gathered:
@@ -312,8 +312,15 @@ def synthetic_batch(key, cfg: BertConfig, batch: int,
 def max_predictions(cfg: BertConfig, mask_rate: float = 0.15) -> int:
     """max_predictions_per_seq for the gathered MLM head, rounded up to a
     lane-friendly multiple of 8 (76.8 -> 80 at seq 512, matching the
-    canonical BERT pretraining recipe's 76-80)."""
-    return int(-(-cfg.seq_len * mask_rate // 8) * 8)
+    canonical BERT pretraining recipe's 76-80).
+
+    For short sequences the 8-rounding is clamped: it applies only while
+    it stays within 2x the exact mask count, so toy configs (seq 16:
+    2.4 -> 3 masked, not 8 = 50%) keep roughly the stated mask rate
+    instead of silently over-masking."""
+    exact = max(1, int(-(-cfg.seq_len * mask_rate // 1)))
+    padded = int(-(-exact // 8) * 8)
+    return min(padded if padded <= 2 * exact else exact, cfg.seq_len)
 
 
 def synthetic_mlm_batch(key, cfg: BertConfig, batch: int,

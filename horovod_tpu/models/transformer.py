@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..compat import axis_size
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import moe as moe_lib
@@ -232,7 +234,7 @@ def forward_loss(cfg: TransformerConfig, par: ParallelConfig,
     Returns a replicated scalar loss.
     """
     s_full = cfg.seq_len
-    mp_size = lax.axis_size("mp")
+    mp_size = axis_size("mp")
     s_local = s_full // mp_size
     mp_idx = lax.axis_index("mp")
 
@@ -269,7 +271,7 @@ def forward_loss(cfg: TransformerConfig, par: ParallelConfig,
 
 def make_loss_fn(cfg: TransformerConfig, par: ParallelConfig, mesh):
     """Global-array loss: shard_map of ``forward_loss`` over (dp, pp, mp)."""
-    from jax import shard_map
+    from ..compat import shard_map
     specs = param_specs(cfg, par)
     data_spec = P("dp")
 

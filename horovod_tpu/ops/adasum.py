@@ -26,6 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size
 
 
 def adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -96,7 +97,7 @@ def adasum_allreduce(tensor: jax.Array, axis_name: str,
     adasum_gpu_operations.cc); the tree fallback cannot do this, so
     shard_axis requires a power-of-two ``axis_name``.
     """
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     if P == 1:
         return tensor
     if P & (P - 1):
@@ -173,8 +174,8 @@ def adasum_allreduce_hierarchical(tensor: jax.Array, local_axis: str,
     Numerics: equals ``adasum_tree`` over the per-node means — asserted
     against that oracle on a 2x4 virtual mesh in tests/test_collectives.py.
     """
-    L = lax.axis_size(local_axis)
-    crossP = lax.axis_size(cross_axis)
+    L = axis_size(local_axis)
+    crossP = axis_size(cross_axis)
     if L == 1:
         return adasum_allreduce(tensor, cross_axis)
     if crossP == 1:

@@ -68,8 +68,8 @@ def _default_axis(axis_name: Optional[str]) -> str:
 
 
 def _axis_size(axis_name: str) -> int:
-    from jax import lax
-    return lax.axis_size(axis_name)
+    from ..compat import axis_size
+    return axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------

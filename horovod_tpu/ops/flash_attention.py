@@ -81,11 +81,13 @@ def _use_interpret() -> bool:
 
 
 def _compiler_params(n_parallel: int):
+    # Renamed upstream: TPUCompilerParams (<= 0.4.x) -> CompilerParams.
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
     try:
-        return pltpu.CompilerParams(
+        return cls(
             dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
     except TypeError:  # older/newer field sets
-        return pltpu.CompilerParams()
+        return cls()
 
 
 # ---------------------------------------------------------------------------

@@ -145,6 +145,21 @@ def DistributedOptimizer(optimizer,
 
 class _ZeroState(NamedTuple):
     inner: Any                # inner optimizer state over this rank's shards
+    sizes: Any                # params-structured true flat sizes (static at
+                              # init; the checkpoint engine reads them to
+                              # reshard moments across world-size changes)
+
+
+class ZeroGradientTransformation(NamedTuple):
+    """``optax.GradientTransformation`` surface (init/update) plus the
+    checkpoint lifecycle hooks ZeRO state needs — rank-distinct shards
+    cannot ride ``broadcast_optimizer_state``, they round-trip through
+    ``horovod_tpu.checkpoint`` instead."""
+
+    init: Callable
+    update: Callable
+    state_dict: Callable       # (path, state, step, mesh=...) -> Manifest
+    load_state_dict: Callable  # (path, like, mesh=..., step=...) -> state
 
 
 def ZeroShardedOptimizer(optimizer, op: int = C.Average,
@@ -171,6 +186,8 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     import optax
     from jax import lax
 
+    from .compat import axis_size
+
     ax = C._default_axis(axis_name)
 
     def _pad_flat(x, world):
@@ -186,14 +203,18 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
         return flat.reshape(world, flat.size // world)[idx]
 
     def init_fn(params):
-        world = lax.axis_size(ax)
+        world = axis_size(ax)
         idx = lax.axis_index(ax)
         shards = jax.tree_util.tree_map(
             lambda p: _my_shard(p, world, idx), params)
-        return _ZeroState(inner=optimizer.init(shards))
+        # True (unpadded) flat sizes are static shape facts, recorded in
+        # the state so the checkpoint engine can reshard the moments
+        # when a restore lands on a different world size.
+        sizes = jax.tree_util.tree_map(lambda p: p.size, params)
+        return _ZeroState(inner=optimizer.init(shards), sizes=sizes)
 
     def update_fn(grads, state: _ZeroState, params=None):
-        world = lax.axis_size(ax)
+        world = axis_size(ax)
         idx = lax.axis_index(ax)
 
         g_shards = jax.tree_util.tree_map(
@@ -209,9 +230,26 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
             return full[:ref.size].reshape(ref.shape).astype(ref.dtype)
 
         updates = jax.tree_util.tree_map(_regather, upd_shards, grads)
-        return updates, _ZeroState(inner=inner)
+        return updates, _ZeroState(inner=inner, sizes=state.sizes)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    def state_dict(path: str, state, step: int, **kwargs):
+        """Write one committed sharded-checkpoint step of this state
+        (every rank's shard + rank-0 manifest) — see
+        ``horovod_tpu.checkpoint.save_zero_state``."""
+        from .checkpoint import save_zero_state
+        kwargs.setdefault("axis_name", ax)
+        return save_zero_state(path, state, step=step, **kwargs)
+
+    def load_state_dict(path: str, like, **kwargs):
+        """Restore the newest committed step into ``like``'s structure,
+        resharded for the current world size — see
+        ``horovod_tpu.checkpoint.restore_zero_state``."""
+        from .checkpoint import restore_zero_state
+        kwargs.setdefault("axis_name", ax)
+        return restore_zero_state(path, like, **kwargs)
+
+    return ZeroGradientTransformation(init_fn, update_fn,
+                                      state_dict, load_state_dict)
 
 
 # ---------------------------------------------------------------------------
@@ -267,8 +305,11 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
         raise ValueError(
             "broadcast_optimizer_state on ZeroShardedOptimizer state "
             "would overwrite rank-distinct shards with rank 0's slice; "
-            "checkpoint/restore it per-rank (orbax with a sharded spec) "
-            "or re-init and warm up instead")
+            "use the sharded checkpoint engine instead — "
+            "horovod_tpu.checkpoint.save_zero_state / restore_zero_state "
+            "(or the transformation's state_dict/load_state_dict hooks), "
+            "which writes per-rank shards and reshards on restore when "
+            "the world size changed; see docs/checkpointing.md")
 
     def _maybe(x):
         if hasattr(x, "dtype") and hasattr(x, "shape"):

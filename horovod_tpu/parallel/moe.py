@@ -18,6 +18,7 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size
 
 
 class MoEParams(NamedTuple):
@@ -55,7 +56,7 @@ def moe_layer(params: MoEParams, x: jax.Array, axis_name: str,
       (tokens, d_model) combined expert outputs (zeros for dropped tokens —
       add the residual in the caller).
     """
-    ep = lax.axis_size(axis_name)
+    ep = axis_size(axis_name)
     t, d = x.shape
     n_local = params.w_in.shape[0]
     n_experts = ep * n_local

@@ -19,6 +19,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -42,7 +43,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
       (n_micro, mb, ...) outputs — valid on the **last** stage; other stages
       hold zeros (reduce with a stage mask, see ``last_stage_mask``).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     n_micro = x_microbatches.shape[0]
     ticks = n_micro + n_stages - 1
@@ -76,7 +77,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 def last_stage_mask(axis_name: str) -> jax.Array:
     """1.0 on the last pipeline stage, 0.0 elsewhere — for masking losses
     computed from ``pipeline_apply`` output before a psum over the axis."""
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     return (stage == n_stages - 1).astype(jnp.float32)
 

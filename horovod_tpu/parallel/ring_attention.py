@@ -35,6 +35,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size
 
 _NEG_INF = -1e30
 
@@ -93,7 +94,7 @@ def _ring_perm(sp):
 
 
 def _ring_attention_xla(q, k, v, axis_name, causal, scale):
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
 
@@ -138,7 +139,7 @@ def _ring_attention_xla(q, k, v, axis_name, causal, scale):
 
 def _ring_flash_forward(q, k, v, axis_name, causal, scale):
     from ..ops import flash_attention as fa
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     sq = q.shape[1]
     perm = _ring_perm(sp)
@@ -176,7 +177,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
 def _ring_flash_bwd(axis_name, causal, scale, res, g):
     from ..ops import flash_attention as fa
     q, k, v, out, lse = res
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     sq = q.shape[1]
     perm = _ring_perm(sp)

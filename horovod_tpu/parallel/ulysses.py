@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 from jax import lax
+from ..compat import axis_size
 
 from . import ring_attention as ra
 
@@ -53,7 +54,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q, k, v: (B, S_local, H, D) shards; returns the (B, S_local, H, D)
     output shard.  Requires H divisible by the axis size.
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     h = q.shape[2]
     if h % sp != 0:
         raise ValueError(

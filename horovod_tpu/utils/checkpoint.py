@@ -5,6 +5,14 @@ to host memory and Spark estimators write to a Store (SURVEY.md §5.4).  The
 TPU-native equivalent adds durable disk checkpoints via Orbax (the JAX
 ecosystem's checkpointer, multi-host aware) with the same rank-0-writes
 convention, plus plain-numpy fallbacks for environments without Orbax.
+
+Rank-DISTINCT state (``ZeroShardedOptimizer`` moments) cannot use the
+rank-0-writes convention: rank 0's slice is 1/N of the state.  Pytrees
+containing ``_ZeroState`` leaves are therefore delegated to the sharded
+engine in ``horovod_tpu.checkpoint`` — every rank writes its own shard,
+rank 0 commits the manifest last, and restores reshard across world-size
+changes.  The replicated path below stays as-is (Orbax optional, numpy
+pickle fallback always available); see docs/checkpointing.md.
 """
 
 from __future__ import annotations
@@ -24,10 +32,41 @@ def _orbax():
         return None
 
 
+def _has_sharded_leaves(tree: Any) -> bool:
+    from ..checkpoint import has_zero_leaves
+    return has_zero_leaves(tree)
+
+
 def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
                     rank: Optional[int] = None) -> None:
     """Write a pytree checkpoint; only rank 0 writes (pass rank, or the
-    runtime's rank is used)."""
+    runtime's rank is used).
+
+    Pytrees holding ZeRO-sharded optimizer state are delegated to the
+    sharded engine (``horovod_tpu.checkpoint.save_zero_state``): every
+    rank participates, so do not gate the call on rank yourself.  With
+    ``step=None`` each save appends a new engine step and keeps the
+    newest 3 (the legacy overwrite-in-place semantics, with crash
+    safety); explicit steps are immutable and retention is the
+    caller's — restarting loops should restore first or pass
+    ``step=None``."""
+    if _has_sharded_leaves(state):
+        from ..checkpoint import latest_step, save_zero_state
+        root = os.path.abspath(path)
+        keep = None
+        if step is None:
+            latest = latest_step(root)
+            step = 0 if latest is None else latest + 1
+            keep = 3
+        try:
+            save_zero_state(root, state, step=step, keep=keep)
+        except FileExistsError as e:
+            raise FileExistsError(
+                f"{e}  (sharded checkpoints are append-only: restore "
+                "before resuming so your step counter continues from "
+                "the checkpoint, or pass step=None to auto-append)"
+            ) from None
+        return
     if rank is None:
         from ..core.state import global_state
         rank = global_state.rank if global_state.initialized else 0
@@ -54,7 +93,22 @@ def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
 def restore_checkpoint(path: str, target: Any = None,
                        step: Optional[int] = None) -> Any:
     """Load a checkpoint written by ``save_checkpoint``; ``target`` (a pytree
-    of like-shaped arrays) guides structure when given."""
+    of like-shaped arrays) guides structure when given.
+
+    A ``target`` holding ZeRO-sharded state routes to the sharded
+    engine's restore (newest committed step when ``step`` is None),
+    resharded for the current world size — for engine checkpoints the
+    ``target`` is required (it supplies the pytree structure)."""
+    if target is not None and _has_sharded_leaves(target):
+        from ..checkpoint import restore_zero_state
+        return restore_zero_state(os.path.abspath(path), target, step=step)
+    if target is None:
+        from ..checkpoint import latest_step as engine_latest
+        if engine_latest(os.path.abspath(path)) is not None:
+            raise ValueError(
+                f"{path} is a sharded engine checkpoint (step dirs + "
+                "MANIFEST.json); pass target= a like-structured pytree "
+                "holding the ZeRO state so restore knows the layout")
     path = os.path.abspath(path if step is None else f"{path}-{step}")
     ocp = _orbax()
     if ocp is not None and os.path.isdir(path):

@@ -1,0 +1,486 @@
+"""Sharded checkpoint engine tests: ZeRO-1 save/restore with elastic
+resharding (ISSUE 1 acceptance criteria).
+
+World sizes are simulated with explicit sub-meshes of the 8 virtual CPU
+devices (conftest): a checkpoint written at world 4 restores into worlds
+4 and 2.  The engine itself is pure numpy + JSON — the no-Orbax test
+blocks the orbax import outright and everything still round-trips.
+"""
+
+import os
+import pickle
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.compat import shard_map
+from horovod_tpu.optimizers import ZeroShardedOptimizer
+
+PARAMS = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(4, 3),
+          "b": jnp.linspace(0.5, 2.0, 16)}
+
+
+def _mesh(world):
+    return Mesh(np.array(jax.devices()[:world]), ("data",))
+
+
+def _grads():
+    # Same param-shaped gradient on every rank: the reduce-scattered mean
+    # equals the serial gradient, so serial optax is an exact oracle.
+    return jax.tree_util.tree_map(
+        lambda p: 0.1 * (jnp.arange(p.size, dtype=p.dtype) + 1.0
+                         ).reshape(p.shape), PARAMS)
+
+
+def _step_fn(tx, mesh, state_specs):
+    def step(p, g, s):
+        updates, s2 = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s2
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(P(), P(), state_specs),
+                             out_specs=(P(), state_specs), check_vma=False))
+
+
+def _moment_leaves(state):
+    """The reassembled (truncated-to-true-size) vector moment arrays."""
+    out = []
+    leaves = jax.tree_util.tree_leaves(state)
+    for leaf in leaves:
+        if getattr(leaf, "ndim", 0) >= 1:
+            out.append(np.asarray(leaf).reshape(-1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pure shard math
+# ---------------------------------------------------------------------------
+
+def test_reshard_math_bit_identical():
+    rng = np.random.default_rng(0)
+    for true_size in (1, 5, 12, 16, 31):
+        full = rng.standard_normal(true_size).astype(np.float32)
+        for n in (1, 2, 4):
+            shards = [ckpt.shard_of(full, n, r) for r in range(n)]
+            back = ckpt.reassemble(shards, true_size)
+            np.testing.assert_array_equal(back, full)
+            for m in (1, 2, 3, 4, 8):
+                reshards = ckpt.reshard(shards, true_size, m)
+                assert len(reshards) == m
+                np.testing.assert_array_equal(
+                    ckpt.reassemble(reshards, true_size), full)
+
+
+def test_manifest_json_roundtrip():
+    spec = ckpt.LeafSpec(path=".inner[0].mu['w']", kind=ckpt.SHARDED,
+                         shape=[4, 3], dtype="float32", true_size=12)
+    m = ckpt.Manifest(step=7, world_size=4, leaves=[spec],
+                      extra={"note": "x"})
+    m2 = ckpt.Manifest.from_json(m.to_json())
+    assert m2.step == 7 and m2.world_size == 4
+    assert m2.leaves[0] == spec and m2.extra == {"note": "x"}
+    assert spec.padded_size(4) == 12 and spec.shard_size(4) == 3
+    with pytest.raises(ValueError, match="format_version"):
+        ckpt.Manifest.from_json(
+            m.to_json().replace('"format_version": 1', '"format_version": 99'))
+
+
+# ---------------------------------------------------------------------------
+# Durability protocol
+# ---------------------------------------------------------------------------
+
+def test_commit_refuses_missing_shards(tmp_path):
+    root = str(tmp_path)
+    spec = ckpt.LeafSpec(path=".x", kind=ckpt.SHARDED, shape=[8],
+                         dtype="float32", true_size=8)
+    manifest = ckpt.Manifest(step=3, world_size=2, leaves=[spec])
+    ckpt.write_shard(root, 3, 0, 2, {".x": np.zeros(4, np.float32)})
+    with pytest.raises(FileNotFoundError, match="missing shard"):
+        ckpt.commit(root, 3, manifest)
+    assert ckpt.latest_step(root) is None
+
+
+def test_crash_between_shards_and_manifest_is_never_latest(tmp_path):
+    """Acceptance: a kill between shard write and manifest commit leaves a
+    torn step that ``latest`` never selects; the prior step restores."""
+    root = str(tmp_path / "ckpt")
+    mesh4 = _mesh(4)
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    state = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    ckpt.save_zero_state(root, state, step=1, mesh=mesh4)
+    assert ckpt.latest_step(root) == 1
+
+    # Crash injection A: all shards of step 2 written, no manifest.
+    m = ckpt.read_manifest(root, 1)
+    for r in range(4):
+        ckpt.write_shard(root, 2, r, 4,
+                         ckpt.read_shard(root, 1, r, 4))
+    assert os.path.isdir(os.path.join(root, ckpt.step_dirname(2)))
+    assert ckpt.latest_step(root) == 1
+    assert not ckpt.is_committed(root, 2)
+
+    # Crash injection B: manifest present but a shard file lost.
+    ckpt.commit(root, 2, ckpt.Manifest(step=2, world_size=4,
+                                       leaves=m.leaves, extra=m.extra))
+    assert ckpt.latest_step(root) == 2
+    os.unlink(os.path.join(root, ckpt.step_dirname(2),
+                           ckpt.shard_filename(3, 4)))
+    assert ckpt.latest_step(root) == 1
+
+    # The prior step restores cleanly through the torn debris.
+    restored = ckpt.restore_zero_state(root, state, mesh=mesh4)
+    for a, b in zip(_moment_leaves(state), _moment_leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(FileNotFoundError, match="not a committed"):
+        ckpt.restore_leaves(root, 2, 4)
+
+
+def test_committed_steps_are_immutable(tmp_path):
+    """Rewriting a committed step in place could leave a manifest-valid
+    directory mixing old and new shards after a crash — refused."""
+    root = str(tmp_path)
+    spec = ckpt.LeafSpec(path=".x", kind=ckpt.SHARDED, shape=[2],
+                         dtype="float32", true_size=2)
+    ckpt.write_shard(root, 1, 0, 1, {".x": np.ones(2, np.float32)})
+    manifest = ckpt.Manifest(step=1, world_size=1, leaves=[spec])
+    ckpt.commit(root, 1, manifest)
+    with pytest.raises(FileExistsError, match="immutable"):
+        ckpt.write_shard(root, 1, 0, 1, {".x": np.zeros(2, np.float32)})
+    with pytest.raises(FileExistsError, match="immutable"):
+        ckpt.commit(root, 1, manifest)
+    np.testing.assert_array_equal(ckpt.read_shard(root, 1, 0, 1)[".x"],
+                                  np.ones(2, np.float32))
+
+
+def test_commit_refuses_shard_missing_leaf_key(tmp_path):
+    """A shard file lacking a manifest leaf would surface only as a
+    restore-time KeyError; commit checks the .npz keys and refuses."""
+    root = str(tmp_path)
+    spec_x = ckpt.LeafSpec(path=".x", kind=ckpt.SHARDED, shape=[2],
+                           dtype="float32", true_size=2)
+    spec_y = ckpt.LeafSpec(path=".y", kind=ckpt.SHARDED, shape=[2],
+                           dtype="float32", true_size=2)
+    ckpt.write_shard(root, 1, 0, 1, {".x": np.ones(2, np.float32)})
+    with pytest.raises(ValueError, match="missing leaves"):
+        ckpt.commit(root, 1, ckpt.Manifest(step=1, world_size=1,
+                                           leaves=[spec_x, spec_y]))
+    assert ckpt.latest_step(root) is None
+
+
+def test_gc_retention_and_torn_debris(tmp_path):
+    root = str(tmp_path)
+    spec = ckpt.LeafSpec(path=".x", kind=ckpt.SHARDED, shape=[2],
+                         dtype="float32", true_size=2)
+    for step in (1, 2, 3, 4):
+        ckpt.write_shard(root, step, 0, 1,
+                         {".x": np.full(2, step, np.float32)})
+        if step != 3:  # step 3 is torn crash debris
+            ckpt.commit(root, step, ckpt.Manifest(
+                step=step, world_size=1, leaves=[spec]))
+    deleted = ckpt.gc_steps(root, keep=2)
+    assert deleted == [1, 3]
+    assert ckpt.list_steps(root) == [2, 4]
+    # The newest committed step's data survived intact.
+    np.testing.assert_array_equal(
+        ckpt.read_shard(root, 4, 0, 1)[".x"], np.full(2, 4, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO state: save at world 4, restore at worlds 4 and 2
+# ---------------------------------------------------------------------------
+
+def test_zero_world4_restores_at_4_and_2_bit_identical(tmp_path):
+    """Acceptance: state saved at world 4 restores at worlds 4 and 2 with
+    bit-identical reassembled moments and identical post-restore update
+    steps vs an unsharded baseline."""
+    root = str(tmp_path / "zero")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    grads = _grads()
+
+    # Advance one step at world 4, then checkpoint.
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    specs4 = ckpt.zero_state_specs(s0)
+    p1, s1 = _step_fn(tx, mesh4, specs4)(PARAMS, grads, s0)
+    ckpt.save_zero_state(root, s1, step=1, mesh=mesh4)
+
+    # Serial optax oracle (identical grads on every rank -> mean == g).
+    op0 = optax.adam(1e-2).init(PARAMS)
+    ou1, op1 = optax.adam(1e-2).update(grads, op0, PARAMS)
+    bp1 = optax.apply_updates(PARAMS, ou1)
+    ou2, _ = optax.adam(1e-2).update(grads, op1, bp1)
+    bp2 = optax.apply_updates(bp1, ou2)
+
+    for mesh, world in ((mesh4, 4), (mesh2, 2)):
+        like = ckpt.zero_init(tx, PARAMS, mesh=mesh)
+        restored = ckpt.restore_zero_state(root, like, mesh=mesh)
+        # Bit-identical reassembled moments (padding tails excluded).
+        for a, b in zip(_moment_leaves(s1), _moment_leaves(restored)):
+            n = min(a.size, b.size)  # world-dependent padding may differ
+            np.testing.assert_array_equal(a[:n], b[:n])
+        # Post-restore update step at the NEW world size.
+        specs = ckpt.zero_state_specs(restored)
+        p1h = jax.tree_util.tree_map(np.asarray, p1)  # off mesh4's devices
+        p2, _ = _step_fn(tx, mesh, specs)(p1h, grads, restored)
+        for k in PARAMS:
+            np.testing.assert_allclose(np.asarray(p2[k]),
+                                       np.asarray(bp2[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    # World 4 restore must continue bitwise like the never-checkpointed run.
+    restored4 = ckpt.restore_zero_state(root, s1, mesh=mesh4)
+    cont_direct = _step_fn(tx, mesh4, specs4)(p1, grads, s1)
+    cont_restored = _step_fn(tx, mesh4, specs4)(p1, grads, restored4)
+    for a, b in zip(jax.tree_util.tree_leaves(cont_direct),
+                    jax.tree_util.tree_leaves(cont_restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_transformation_state_dict_hooks(tmp_path):
+    """ZeroShardedOptimizer exposes state_dict/load_state_dict lifecycle
+    hooks that route through the engine."""
+    root = str(tmp_path / "hooks")
+    tx = ZeroShardedOptimizer(optax.sgd(0.1, momentum=0.9))
+    mesh4 = _mesh(4)
+    state = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    manifest = tx.state_dict(root, state, step=5, mesh=mesh4)
+    assert manifest.world_size == 4 and manifest.step == 5
+    restored = tx.load_state_dict(root, state, mesh=mesh4)
+    for a, b in zip(_moment_leaves(state), _moment_leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_save_validates_broken_layout(tmp_path):
+    """A state whose vector leaves match neither the full padded buffer
+    nor one rank's shard fails loudly at save time."""
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    bad = jax.tree_util.tree_map(
+        lambda l: jnp.concatenate([l, l]) if getattr(l, "ndim", 0) else l,
+        state)
+    with pytest.raises(ValueError, match="expected"):
+        ckpt.save_zero_state(str(tmp_path), bad, step=0, mesh=mesh4)
+
+
+# ---------------------------------------------------------------------------
+# Elastic state objects with sharded leaves
+# ---------------------------------------------------------------------------
+
+def test_elastic_tpustate_roundtrip_sharded_leaves(tmp_path):
+    """Acceptance: the elastic state-object round-trip passes with sharded
+    leaves — commit() writes an engine step, sync() after a resize
+    restores it resharded instead of broadcasting."""
+    from horovod_tpu.elastic.state import TpuState
+
+    ckdir = str(tmp_path / "elastic")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    grads = _grads()
+
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    _, s1 = _step_fn(tx, mesh4, ckpt.zero_state_specs(s0))(
+        PARAMS, grads, s0)
+    state = TpuState(opt_state=s1, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) == 0
+
+    # Elastic resize 4 -> 2: a rejoining worker constructs fresh state and
+    # sync() restores the committed step, resharded for the new world.
+    fresh = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    resized = TpuState(opt_state=fresh, checkpoint_dir=ckdir,
+                       checkpoint_mesh=mesh2)
+    resized.sync(root=0)
+    for a, b in zip(_moment_leaves(s1), _moment_leaves(resized.opt_state)):
+        n = min(a.size, b.size)
+        np.testing.assert_array_equal(a[:n], b[:n])
+
+    # restore() rolls back to the synced snapshot after a failure.
+    mutated = jax.tree_util.tree_map(
+        lambda l: l + 1 if getattr(l, "ndim", 0) else l, resized.opt_state)
+    resized.opt_state = mutated
+    resized.restore()
+    for a, b in zip(_moment_leaves(s1), _moment_leaves(resized.opt_state)):
+        n = min(a.size, b.size)
+        np.testing.assert_array_equal(a[:n], b[:n])
+
+
+def test_elastic_tpustate_relaunch_steps_stay_monotonic(tmp_path):
+    """A full job relaunch resets the sync generation to 0; commit steps
+    must keep counting from the newest step on disk, or `latest` would
+    keep electing the stale pre-relaunch step while gc_steps deletes the
+    fresh low-numbered commits."""
+    from horovod_tpu.elastic.state import TpuState
+
+    ckdir = str(tmp_path / "relaunch")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    state = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()
+    state.commit()
+    zdir = os.path.join(ckdir, "opt_state")
+    assert ckpt.latest_step(zdir) == 1
+
+    # Relaunch: a brand-new TpuState (generation back at 0) over the
+    # same checkpoint_dir.
+    relaunched = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                          checkpoint_mesh=mesh4)
+    relaunched.commit()
+    assert ckpt.latest_step(zdir) == 2
+    assert ckpt.list_steps(zdir) == [0, 1, 2]
+
+
+def test_elastic_commit_interrupt_still_records_step(tmp_path):
+    """HostsUpdatedInterrupt raised by the base commit (host joined
+    mid-commit) comes AFTER the snapshot — the step is fully committed
+    and must be recorded, or the next sync() would restore one-step-old
+    moments under current params."""
+    from horovod_tpu.core.exceptions import HostsUpdatedInterrupt
+    from horovod_tpu.elastic.state import TpuState
+
+    ckdir = str(tmp_path / "interrupt")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    state = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.check_host_updates = lambda: (_ for _ in ()).throw(
+        HostsUpdatedInterrupt(skip_sync=False))
+    with pytest.raises(HostsUpdatedInterrupt):
+        state.commit()
+    assert state._ckpt_committed_step == {"opt_state": 0}
+
+
+def test_elastic_sync_restores_last_fully_committed_step(tmp_path):
+    """A crash between the engine commit and the in-memory snapshot
+    leaves a disk step one ahead of the rolled-back params; sync() must
+    restore the last FULLY committed step, not blindly the newest."""
+    from horovod_tpu.elastic.state import TpuState
+
+    ckdir = str(tmp_path / "torn")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    grads = _grads()
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    _, s1 = _step_fn(tx, mesh4, ckpt.zero_state_specs(s0))(
+        PARAMS, grads, s0)
+
+    state = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()  # fully committed: disk step 0 + snapshot
+    # Simulated crash window: step 1 lands on disk but super().commit()
+    # (the snapshot) never ran.
+    zdir = os.path.join(ckdir, "opt_state")
+    ckpt.save_zero_state(zdir, s1, step=1, mesh=mesh4)
+    assert ckpt.latest_step(zdir) == 1
+
+    state.sync(root=0)
+    for a, b in zip(_moment_leaves(s0), _moment_leaves(state.opt_state)):
+        np.testing.assert_array_equal(a, b)  # step 0, not torn step 1
+
+
+def test_elastic_sync_broadcasts_plain_leaves_alongside_zero():
+    """Replicated leaves living next to a _ZeroState (e.g. a chained
+    transform's schedule count) still ride the sync broadcast when the
+    ZeRO leaves themselves are skipped (no committed step yet)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic.state import TpuState
+
+    hvd.init()
+    mesh2 = _mesh(2)
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    tree = {"zero": s0, "count": jnp.asarray(7)}
+    state = TpuState(opt_state=tree, checkpoint_mesh=mesh2)
+    state.sync(root=0)
+    assert int(state.opt_state["count"]) == 7
+    for a, b in zip(_moment_leaves(s0),
+                    _moment_leaves(state.opt_state["zero"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_elastic_tpustate_warns_without_checkpoint_dir(caplog):
+    from horovod_tpu.elastic.state import TpuState
+
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh2 = _mesh(2)
+    s0 = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    state = TpuState(opt_state=s0, checkpoint_mesh=mesh2)
+    # The repo logger sets propagate=False, so hook caplog's handler on
+    # directly instead of relying on root propagation.
+    import logging as pylogging
+    logger = pylogging.getLogger("horovod_tpu")
+    logger.addHandler(caplog.handler)
+    try:
+        state.sync(root=0)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert any("checkpoint_dir" in r.getMessage() for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# No Orbax required
+# ---------------------------------------------------------------------------
+
+def test_engine_and_utils_work_without_orbax(tmp_path, monkeypatch):
+    """Acceptance: horovod_tpu.checkpoint works with no Orbax installed,
+    and utils.checkpoint delegates sharded pytrees to it (replicated
+    state takes the numpy-pickle fallback)."""
+    # A None sys.modules entry makes `import orbax...` raise ImportError.
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    from horovod_tpu.utils import checkpoint as utils_ckpt
+    assert utils_ckpt._orbax() is None
+
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh2 = _mesh(2)
+    state = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+
+    # Sharded pytree -> engine delegation (explicit mesh via the engine
+    # API; utils' generic entry points route to the same storage).
+    root = str(tmp_path / "sharded")
+    ckpt.save_zero_state(root, state, step=2, mesh=mesh2)
+    assert ckpt.latest_step(root) == 2
+    restored = ckpt.restore_zero_state(root, state, mesh=mesh2)
+    for a, b in zip(_moment_leaves(state), _moment_leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+    # Storage really is numpy + JSON — no Orbax artifacts.
+    step_dir = os.path.join(root, ckpt.step_dirname(2))
+    names = sorted(os.listdir(step_dir))
+    assert names == [ckpt.MANIFEST_NAME,
+                     ckpt.shard_filename(0, 2), ckpt.shard_filename(1, 2)]
+
+    # Replicated pytree -> rank-0 pickle fallback.
+    plain = {"w": np.arange(6.0, dtype=np.float32)}
+    path = str(tmp_path / "plain")
+    utils_ckpt.save_checkpoint(path, plain, rank=0)
+    back = utils_ckpt.restore_checkpoint(path)
+    np.testing.assert_array_equal(back["w"], plain["w"])
+
+
+def test_utils_checkpoint_delegates_sharded_pytrees(tmp_path):
+    """utils.checkpoint.save/restore route ZeRO-holding pytrees to the
+    sharded engine on the runtime mesh."""
+    import horovod_tpu as hvd
+    from horovod_tpu.utils import checkpoint as utils_ckpt
+
+    hvd.init()
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    state = ckpt.zero_init(tx, PARAMS)  # runtime mesh, world 8
+    path = str(tmp_path / "via_utils")
+    utils_ckpt.save_checkpoint(path, state, step=4, rank=3)  # rank ignored
+    assert ckpt.latest_step(path) == 4
+    restored = utils_ckpt.restore_checkpoint(path, target=state)
+    for a, b in zip(_moment_leaves(state), _moment_leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+    # step=None appends a fresh engine step (committed steps are
+    # immutable) rather than rewriting step 0 in place.
+    utils_ckpt.save_checkpoint(path, state)
+    assert ckpt.latest_step(path) == 5

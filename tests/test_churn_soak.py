@@ -165,7 +165,7 @@ def _read_logs(prefix, slots):
 # 900 s floor covers the default 200 epochs with a wide margin).
 @pytest.mark.timeout(max(900, 2 * int(os.environ.get(
     "HVD_TPU_SOAK_EPOCHS", "200"))))
-def test_churn_soak_kill_scale_device_autotune_join(tmp_path):
+def test_churn_soak_kill_scale_device_autotune_join(tmp_path, monkeypatch):
     log = str(tmp_path / "log")
     mark = str(tmp_path / "mark")
     # HVD_TPU_SOAK_EPOCHS cranks the duration (e.g. 600 ~= 10 min with
@@ -183,13 +183,15 @@ def test_churn_soak_kill_scale_device_autotune_join(tmp_path):
     base_hosts = [HostInfo("localhost", 1), HostInfo("127.0.0.1", 1),
                   HostInfo(hostname, 1)]
     discovery = FixedHosts(list(base_hosts))
-    os.environ["HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
-    os.environ["HVD_TPU_CPU_JAX_WORLD"] = "1"
-    os.environ["HVD_TPU_AUTOTUNE"] = "1"
+    # monkeypatch (not raw os.environ writes) so ambient HVD_TPU_* values
+    # are restored for later tests in the same process.
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "0.2")
+    monkeypatch.setenv("HVD_TPU_CPU_JAX_WORLD", "1")
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE", "1")
     # Fast-freezing tuner: the soak asserts survival, not convergence.
-    os.environ["HVD_TPU_AUTOTUNE_WARMUP_SAMPLES"] = "1"
-    os.environ["HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE"] = "5"
-    os.environ["HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "4"
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE", "5")
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "4")
 
     fd_dir = "/proc/self/fd"
     fds_before = len(os.listdir(fd_dir))
@@ -222,18 +224,10 @@ def test_churn_soak_kill_scale_device_autotune_join(tmp_path):
 
     t = threading.Thread(target=churn_schedule, daemon=True)
     t.start()
-    try:
-        driver = ElasticDriver(
-            discovery, [sys.executable, str(script)],
-            min_np=2, max_np=3, controller_base_port=29100, verbose=True)
-        rc = driver.run()
-    finally:
-        for k in ("HVD_TPU_CPU_JAX_WORLD", "HVD_TPU_AUTOTUNE",
-                  "HVD_TPU_AUTOTUNE_WARMUP_SAMPLES",
-                  "HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE",
-                  "HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
-                  "HVD_TPU_ELASTIC_DISCOVERY_INTERVAL"):
-            os.environ.pop(k, None)
+    driver = ElasticDriver(
+        discovery, [sys.executable, str(script)],
+        min_np=2, max_np=3, controller_base_port=29100, verbose=True)
+    rc = driver.run()
     assert rc == 0
 
     events = _read_logs(log, slots)

@@ -150,7 +150,7 @@ def test_compiled_dtype_fidelity():
     """Compiled-path Average/Product on integers return the input dtype,
     matching the eager contract."""
     import jax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
     import jax.numpy as jnp
     import horovod_tpu as hvd

@@ -8,7 +8,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 
 import horovod_tpu as hvd
 

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 
 import horovod_tpu as hvd
 from horovod_tpu.parallel import moe as moe_lib
@@ -256,7 +256,7 @@ def test_moe_capacity_drops_tokens():
 
 def test_ulysses_matches_reference():
     import numpy as np
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from horovod_tpu.parallel import ring_attention as ra
     from horovod_tpu.parallel.ulysses import ulysses_attention
@@ -292,7 +292,7 @@ def test_ulysses_matches_reference():
 
 def test_ulysses_rejects_indivisible_heads():
     import numpy as np
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from horovod_tpu.parallel.ulysses import ulysses_attention
 

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 
 import horovod_tpu as hvd
 from horovod_tpu.models import resnet

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 
 import horovod_tpu as hvd
 from horovod_tpu.ops.sync_batch_norm import sync_batch_norm
