@@ -1245,6 +1245,76 @@ def bench_eager_device():
     })
 
 
+def bench_data():
+    """Input-pipeline overlap: steps/sec with background prefetch on vs
+    off at a simulated host batch cost and step cost (defaults 5 ms
+    each — the shape where perfect overlap doubles throughput), plus
+    the mean host data-wait per step from the profiler's data_wait
+    spans.  Pure host-side measurement: no accelerator is touched, so
+    the number isolates the pipeline itself.  Select with
+    BENCH_MODEL=data or `bench.py --bench data`."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from horovod_tpu.data import ArraySource, DataLoader
+    from horovod_tpu.utils import profiler
+
+    host_ms = float(os.environ.get("BENCH_DATA_HOST_MS", "5"))
+    step_ms = float(os.environ.get("BENCH_DATA_STEP_MS", "5"))
+    steps = int(os.environ.get("BENCH_ITERS", "40"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    depth = int(os.environ.get("BENCH_DATA_QUEUE_DEPTH", "2"))
+
+    class _SlowSource(ArraySource):
+        # Simulated per-batch host cost (decode/augment stand-in).
+        def gather(self, indices):
+            time.sleep(host_ms / 1e3)
+            return super().gather(indices)
+
+    import numpy as np
+    src = _SlowSource(np.arange(batch * (steps + depth + 2)))
+
+    def run(prefetch: bool):
+        loader = DataLoader(src, batch, shuffle=False, policy="drop",
+                            prefetch=prefetch, queue_depth=depth)
+        it = iter(loader)
+        next(it)  # warm: thread spawn + first batch out of the timing
+        profiler.reset_data_wait_stats()
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(steps):
+            try:
+                next(it)
+            except StopIteration:
+                break
+            time.sleep(step_ms / 1e3)  # the "training step"
+            n += 1
+        dt = time.perf_counter() - t0
+        wait = profiler.data_wait_stats()
+        loader.close()
+        return n / dt, wait["total_s"] / max(n, 1)
+
+    sps_off, wait_off = run(prefetch=False)
+    sps_on, wait_on = run(prefetch=True)
+    serial_sps = 1e3 / (host_ms + step_ms)
+    ideal_sps = 1e3 / max(host_ms, step_ms)
+    _emit({
+        "metric": "data_pipeline_prefetch_throughput",
+        "value": round(sps_on, 2),
+        "unit": f"steps/sec (prefetch on, {host_ms:g}ms host + "
+                f"{step_ms:g}ms step)",
+        # Baseline = the serial pipeline this harness replaces.
+        "vs_baseline": round(sps_on / sps_off, 3),
+        "steps_per_sec_prefetch_off": round(sps_off, 2),
+        "data_wait_ms_per_step_on": round(wait_on * 1e3, 3),
+        "data_wait_ms_per_step_off": round(wait_off * 1e3, 3),
+        # 0 = serial, 1 = perfect host/step overlap.
+        "overlap_efficiency": round(
+            min((sps_on - serial_sps) / (ideal_sps - serial_sps), 1.0), 3)
+        if ideal_sps > serial_sps else None,
+        "queue_depth": depth,
+        "steps": steps,
+    })
+
+
 def _tpu_transport_alive() -> bool:
     """The axon TPU tunnel (loopback relay) can die; when it does, any
     TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
@@ -1265,6 +1335,14 @@ def _tpu_transport_alive() -> bool:
 
 def main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
+    if "--bench" in sys.argv:  # `bench.py --bench data` == BENCH_MODEL=data
+        i = sys.argv.index("--bench") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("usage: bench.py --bench "
+                             "{resnet|bert|longctx|scaling|data|...}")
+        mode = sys.argv[i]
+    if mode == "data":
+        return bench_data()  # host-only; never touches the accelerator
     if mode == "eager":
         return bench_eager()  # never touches the accelerator
     if mode == "eager_sweep":

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from horovod_tpu.compat import shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import mlp
@@ -63,14 +63,20 @@ def main():
             params, opt_state, xb, yb)
 
     jstep = jax.jit(step)
-    per_step = args.batch * n_dev
+    # Feed through the sharded input pipeline: deterministic per-rank
+    # sharding + background prefetch (host gather and H2D overlap the
+    # step).  shuffle=False + policy="drop" matches the old hand-rolled
+    # sequential full-batch feed exactly at world size 1.
+    loader = hvd.data.DataLoader(
+        hvd.data.ArraySource(np.asarray(x), np.asarray(y)),
+        batch_size=args.batch, shuffle=False, policy=hvd.data.DROP,
+        sharding=NamedSharding(mesh, P("data")))
     for epoch in range(args.epochs):
-        for i in range(0, x.shape[0] - per_step + 1, per_step):
-            xb = x[i: i + per_step]
-            yb = y[i: i + per_step]
+        for xb, yb in loader:
             params, opt_state, loss = jstep(params, opt_state, xb, yb)
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss {float(loss):.4f}")
+    loader.close()
     hvd.shutdown()
 
 

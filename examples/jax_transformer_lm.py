@@ -14,6 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 import horovod_tpu as hvd
@@ -50,12 +51,28 @@ def main():
     step, shard_params = tfm.make_train_step(cfg, par, mesh, tx)
     params = shard_params(params)
     opt_state = tx.init(params)
+    # A small synthetic corpus fed through the sharded input pipeline:
+    # the loader shards sequences over the dp axis (this process feeds
+    # every dp rank of the dp×pp×mp mesh) and prefetches the next batch
+    # while the step runs.  Epochs wrap transparently until the step
+    # budget is spent.
     tokens, labels = tfm.synthetic_batch(jax.random.PRNGKey(1), cfg,
-                                         args.batch * args.dp)
+                                         args.batch * args.dp * 4)
+    loader = hvd.data.DataLoader(
+        hvd.data.ArraySource(np.asarray(tokens), np.asarray(labels)),
+        batch_size=args.batch, shuffle=False, policy=hvd.data.DROP,
+        world_size=args.dp, local_ranks=range(args.dp))
+    it = iter(loader)
     for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        try:
+            tok, lab = next(it)
+        except StopIteration:
+            it = iter(loader)
+            tok, lab = next(it)
+        params, opt_state, loss = step(params, opt_state, tok, lab)
         if hvd.rank() == 0:
             print(f"step {i}: loss {float(loss):.4f}")
+    loader.close()
 
 
 if __name__ == "__main__":

@@ -42,6 +42,7 @@ from .optimizers import (
 )
 from .parallel import mesh as mesh_lib
 from . import checkpoint
+from . import data
 from . import elastic
 
 __all__ = [
@@ -64,5 +65,5 @@ __all__ = [
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "checkpoint", "elastic",
+    "mesh_lib", "checkpoint", "data", "elastic",
 ]

@@ -27,6 +27,9 @@ from .zero import (
     has_zero_leaves, is_zero_state,
     restore_zero_state, save_zero_state, zero_init, zero_state_specs,
 )
+from .data_state import (
+    DATA_ITERS_KEY, restore_data_state, save_data_state,
+)
 
 __all__ = [
     "FORMAT_VERSION", "MANIFEST_NAME", "REPLICATED", "SHARDED",
@@ -38,4 +41,5 @@ __all__ = [
     "has_zero_leaves", "is_zero_state",
     "restore_zero_state", "save_zero_state", "zero_init",
     "zero_state_specs",
+    "DATA_ITERS_KEY", "restore_data_state", "save_data_state",
 ]

@@ -35,6 +35,10 @@ BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 ELASTIC = "ELASTIC"
 MESH_AXES = "MESH_AXES"                        # TPU-only: mesh axis spec
 COMPILE_CACHE_DIR = "COMPILE_CACHE_DIR"        # TPU-only: persistent XLA cache
+# Input pipeline (horovod_tpu/data/).
+DATA_PREFETCH = "DATA_PREFETCH"                # background prefetch on/off
+DATA_QUEUE_DEPTH = "DATA_QUEUE_DEPTH"          # prefetch queue depth
+DATA_STALL_TIMEOUT_SECONDS = "DATA_STALL_TIMEOUT_SECONDS"  # 0 = warn only
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -104,6 +108,11 @@ class Config:
     elastic: bool = False
     mesh_axes: str = ""
     compile_cache_dir: str = ""
+    # Input pipeline: prefetch on, double buffering, no hard stall
+    # ceiling (the warning still fires at stall_warning_time_seconds).
+    data_prefetch: bool = True
+    data_queue_depth: int = 2
+    data_stall_timeout_seconds: float = 0.0
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -136,6 +145,11 @@ class Config:
         cfg.elastic = get_bool(ELASTIC)
         cfg.mesh_axes = get_env(MESH_AXES, "") or ""
         cfg.compile_cache_dir = get_env(COMPILE_CACHE_DIR, "") or ""
+        cfg.data_prefetch = get_bool(DATA_PREFETCH, cfg.data_prefetch)
+        cfg.data_queue_depth = max(
+            1, get_int(DATA_QUEUE_DEPTH, cfg.data_queue_depth))
+        cfg.data_stall_timeout_seconds = get_float(
+            DATA_STALL_TIMEOUT_SECONDS, cfg.data_stall_timeout_seconds)
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

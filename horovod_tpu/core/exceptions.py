@@ -49,3 +49,14 @@ class DuplicateNameError(HorovodTpuError):
 
 class WorkersAvailableException(HorovodTpuError):
     """Elastic driver: new workers are available for rendezvous."""
+
+
+class DataStallError(HorovodTpuError):
+    """The input pipeline produced no batch within the stall window.
+
+    The data-plane analog of the coordinator's stall inspector
+    (stall_inspector.h): a warning is logged after the warning window,
+    and when ``HVD_TPU_DATA_STALL_TIMEOUT_SECONDS`` > 0 the consumer
+    raises this error instead of blocking forever on a wedged producer
+    (dead filesystem, livelocked source, crashed loader thread).
+    """

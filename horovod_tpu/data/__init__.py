@@ -1,0 +1,42 @@
+"""Elastic sharded input pipeline.
+
+The input-side counterpart of the collective stack: deterministic
+per-rank sharding, background prefetch with double-buffered device
+transfer, and checkpointable iterators that resume mid-epoch — at the
+same or a different world size — with no duplicated and no dropped
+samples.
+
+Quick start::
+
+    import horovod_tpu as hvd
+
+    source = hvd.data.ArraySource(x, y)          # or Memmap/FileList
+    loader = hvd.data.DataLoader(source, batch_size=64, seed=0)
+    state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
+                                 train_loader=loader,
+                                 checkpoint_dir="/ckpts/run1")
+
+    for epoch in range(EPOCHS):
+        for xb, yb in loader:
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+            state.commit()
+    loader.close()
+
+See ``docs/data.md`` for sharding/prefetch/resume semantics and the
+elastic N→M worked example.
+"""
+
+from .loader import DataLoader
+from .prefetch import InlineIterator, PrefetchIterator
+from .sampler import DROP, PAD, ShardedIndexSampler
+from .sources import (ArraySource, DataSource, FileListSource,
+                      MemmapSource)
+from ..core.exceptions import DataStallError
+
+__all__ = [
+    "DataLoader",
+    "InlineIterator", "PrefetchIterator",
+    "DROP", "PAD", "ShardedIndexSampler",
+    "ArraySource", "DataSource", "FileListSource", "MemmapSource",
+    "DataStallError",
+]

@@ -1,0 +1,246 @@
+"""`DataLoader` — sharded, prefetched, checkpointable batch feed.
+
+One object ties the pipeline together:
+
+* a :class:`~horovod_tpu.data.sampler.ShardedIndexSampler` decides which
+  sample indices this process feeds (deterministic per-rank sharding,
+  seed-keyed per-epoch shuffle, drop/pad tail policy);
+* a :class:`~horovod_tpu.data.sources.DataSource` gathers those indices
+  into host batches;
+* a :class:`~horovod_tpu.data.prefetch.PrefetchIterator` (or its inline
+  twin when prefetch is off) overlaps the gather + ``jax.device_put``
+  with the training step.
+
+Topology: in a single-controller process that feeds the whole mesh
+(`hvd.size()` chips, one process), the loader emits the **global** batch
+— the contiguous concatenation of every local rank's shard — which is
+exactly what a ``shard_map`` with ``P("data")`` in-specs expects.  In a
+one-process-per-slot launch each process gets only its own rank's
+shard.  Both fall out of the same rank arithmetic
+(``size // process_count`` local ranks starting at ``hvd.rank()``).
+
+Checkpointing: ``state_dict()`` / ``load_state_dict()`` capture the
+(epoch, cursor, seed, world size) tuple at the **consumer** position —
+batches the prefetch producer ran ahead on are not counted — so a
+mid-epoch restore resumes with no duplicated and no dropped samples,
+at the same or a different world size.  Register the loader on
+``hvd.elastic.TpuState(...)`` and this state rides commit/restore/sync
+and the sharded checkpoint engine's manifest automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from .prefetch import InlineIterator, PrefetchIterator
+from .sampler import PAD, ShardedIndexSampler
+from .sources import ArraySource, DataSource
+
+
+def _runtime_config():
+    from ..core.state import global_state
+    if global_state.initialized and global_state.config is not None:
+        return global_state.config
+    from ..core.config import Config
+    return Config.from_env()
+
+
+def _resolve_topology() -> tuple:
+    """(world_size, first local rank, local rank count) from the runtime;
+    (1, 0, 1) when uninitialized (plain library use)."""
+    from ..core.state import global_state
+    if not global_state.initialized:
+        return 1, 0, 1
+    world = max(int(global_state.size), 1)
+    procs = max(int(global_state.process_count), 1)
+    n_local = max(world // procs, 1)
+    return world, int(global_state.rank), n_local
+
+
+class DataLoader:
+    """Iterate per-epoch over sharded batches of ``source``.
+
+    Args:
+      source: a :class:`DataSource` (bare arrays/tuples are wrapped in
+        :class:`ArraySource` for convenience).
+      batch_size: per-rank batch size.  A single-controller process
+        feeding N chips yields ``batch_size x N`` rows per step.
+      shuffle / seed / policy / epoch: sampler knobs (see sampler.py).
+      world_size / rank / local_ranks: explicit topology override.  By
+        default the runtime topology is used (and re-resolved after an
+        elastic reset via ``load_state_dict``); pass e.g.
+        ``world_size=dp, local_ranks=range(dp)`` to feed a dp-way data
+        axis of a larger dp×pp×mp mesh from one process.
+      prefetch: background prefetch on/off; default from
+        ``HVD_TPU_DATA_PREFETCH`` (on).
+      queue_depth: prefetch queue depth; default
+        ``HVD_TPU_DATA_QUEUE_DEPTH`` (2 = double buffering).
+      transfer: applied to each host batch in the producer —
+        typically ``lambda b: jax.device_put(b, sharding)``.  With
+        ``sharding=`` given, that exact transfer is built for you.
+      stall_timeout_s: hard ceiling on waiting for one batch; default
+        ``HVD_TPU_DATA_STALL_TIMEOUT_SECONDS`` (0 = warn only).
+    """
+
+    def __init__(self, source, batch_size: int, *,
+                 shuffle: bool = True, seed: int = 0, policy: str = PAD,
+                 epoch: int = 0,
+                 world_size: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 local_ranks: Optional[Sequence[int]] = None,
+                 prefetch: Optional[bool] = None,
+                 queue_depth: Optional[int] = None,
+                 transfer: Optional[Callable[[Any], Any]] = None,
+                 sharding=None,
+                 stall_timeout_s: Optional[float] = None,
+                 name: str = "data"):
+        if not isinstance(source, DataSource):
+            if isinstance(source, (tuple, list)):
+                source = ArraySource(*source)
+            else:
+                source = ArraySource(source)
+        self.source = source
+        self._name = name
+        cfg = _runtime_config()
+        self._prefetch = cfg.data_prefetch if prefetch is None \
+            else bool(prefetch)
+        self._depth = cfg.data_queue_depth if queue_depth is None \
+            else int(queue_depth)
+        self._stall_timeout_s = cfg.data_stall_timeout_seconds \
+            if stall_timeout_s is None else float(stall_timeout_s)
+        self._stall_warning_s = cfg.stall_warning_time_seconds
+        if sharding is not None and transfer is not None:
+            raise ValueError("pass either transfer= or sharding=, not both")
+        if sharding is not None:
+            transfer = _sharding_transfer(sharding)
+        self._transfer = transfer
+
+        self._explicit_topology = world_size is not None
+        if self._explicit_topology:
+            world = int(world_size)
+            if local_ranks is not None:
+                ranks = sorted(int(r) for r in local_ranks)
+                if rank is not None and rank != ranks[0]:
+                    raise ValueError("rank and local_ranks disagree")
+            else:
+                ranks = [int(rank) if rank is not None else 0]
+            if ranks[0] < 0 or ranks[-1] >= world:
+                # Out-of-range ranks would slice past the global batch
+                # and numpy would silently clamp to undersized batches.
+                raise ValueError(
+                    f"local_ranks {ranks} out of range for world "
+                    f"size {world}")
+        else:
+            if rank is not None or local_ranks is not None:
+                raise ValueError(
+                    "rank/local_ranks need an explicit world_size")
+            world, first, n_local = _resolve_topology()
+            ranks = list(range(first, first + n_local))
+        self._ranks = ranks
+        self.sampler = ShardedIndexSampler(
+            len(source), batch_size, world_size=world, rank=ranks[0],
+            shuffle=shuffle, seed=seed, policy=policy, epoch=epoch)
+
+        self._active = None        # live epoch iterator, if any
+        self._iter_start_state: Dict[str, Any] = self.sampler.state_dict()
+
+    # -- iteration ---------------------------------------------------------
+    def _epoch_gen(self):
+        while True:
+            idx = self.sampler.next_batch(self._ranks)
+            if idx is None:
+                break
+            yield self.source.gather(idx)
+        # Natural exhaustion (not close()): the next epoch begins here,
+        # so the post-epoch state snapshot already points at it.
+        self.sampler.advance_epoch()
+
+    def __iter__(self):
+        """One epoch (resuming mid-epoch when state says so).  Building
+        a new iterator closes the previous one — a single producer
+        owns the sampler at any time."""
+        self.close()
+        self._iter_start_state = self.sampler.state_dict()
+        gen = self._epoch_gen()
+        if self._prefetch:
+            self._active = PrefetchIterator(
+                gen, depth=self._depth, transfer=self._transfer,
+                state_fn=self.sampler.state_dict,
+                stall_warning_s=self._stall_warning_s,
+                stall_timeout_s=self._stall_timeout_s,
+                name=self._name)
+        else:
+            self._active = InlineIterator(
+                gen, transfer=self._transfer,
+                state_fn=self.sampler.state_dict)
+        return self._active
+
+    def __len__(self) -> int:
+        """Batches left in the current epoch (consumer view when no
+        iterator is live; the producer may have run ahead otherwise)."""
+        return self.sampler.batches_remaining()
+
+    @property
+    def batch_size(self) -> int:
+        return self.sampler.batch_size
+
+    @property
+    def feed_rows(self) -> int:
+        """Rows per yielded batch from this process (all local ranks)."""
+        return self.sampler.batch_size * len(self._ranks)
+
+    def close(self) -> None:
+        """Shut down any live prefetch producer (idempotent).  The
+        sampler rewinds to the consumer position: batches the producer
+        drew but never delivered are NOT skipped — they come back on
+        the next iteration."""
+        if self._active is not None:
+            state = self._active.consumer_state()
+            self._active.close()
+            self._active = None
+            if state is None:
+                state = self._iter_start_state
+            self.sampler.load_state_dict(state)
+
+    # -- resumable state ---------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Consumer-position snapshot, safe to call mid-iteration: while
+        a prefetch producer is running ahead, the state of the last
+        batch the training thread actually received is returned."""
+        if self._active is not None:
+            state = self._active.consumer_state()
+            if state is not None:
+                return dict(state)
+            return dict(self._iter_start_state)
+        return self.sampler.state_dict()
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Adopt a snapshot and re-seat in the CURRENT topology: after
+        an elastic resize the remaining indices of the epoch reshard
+        across the new world (pure index arithmetic, no replays)."""
+        self.close()
+        self.sampler.load_state_dict(state)
+        if not self._explicit_topology:
+            world, first, n_local = _resolve_topology()
+            self._ranks = list(range(first, first + n_local))
+            self.sampler.reshard(world, self._ranks[0])
+        self._iter_start_state = self.sampler.state_dict()
+
+    def __repr__(self) -> str:
+        s = self.sampler
+        return (f"DataLoader(n={s.num_samples}, batch={s.batch_size}, "
+                f"world={s.world_size}, ranks={self._ranks}, "
+                f"epoch={s.epoch}, cursor={s.cursor}, "
+                f"prefetch={'on' if self._prefetch else 'off'})")
+
+
+def _sharding_transfer(sharding) -> Callable[[Any], Any]:
+    """Leaf-wise ``device_put`` with one sharding — built lazily so the
+    loader itself never forces a JAX backend init."""
+    def _transfer(batch):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding), batch)
+    return _transfer

@@ -164,6 +164,21 @@ def _has_zero_sharded(tree) -> bool:
     return has_zero_leaves(tree)
 
 
+def _is_data_iterator(x) -> bool:
+    """Duck-typed checkpointable-iterator protocol (hvd.data.DataLoader
+    and friends): live objects with threads/queues cannot ride the
+    deepcopy snapshot path — their ``state_dict()`` does instead."""
+    return (not isinstance(x, (dict, list, tuple))
+            and callable(getattr(x, "state_dict", None))
+            and callable(getattr(x, "load_state_dict", None)))
+
+
+# Dedicated engine-step directory for iterator state when a TpuState
+# carries data iterators but no ZeRO-sharded trees (with ZeRO trees the
+# state rides those steps' manifests instead).
+_DATA_DIR_KEY = "data_iters"
+
+
 class TpuState(ObjectState):
     """Elastic state for JAX training: params/opt_state pytrees snapshotted
     to host memory on commit, broadcast from rank 0 on sync (the analog of
@@ -181,11 +196,25 @@ class TpuState(ObjectState):
     buffers partitioned over the data axis) so commits can see every
     local shard.  Use a fresh ``checkpoint_dir`` per training run: the
     engine validates pytree structure on restore but cannot tell one
-    run's moments from another's."""
+    run's moments from another's.
+
+    Checkpointable data iterators (``hvd.data.DataLoader`` — anything
+    with ``state_dict``/``load_state_dict``) passed as kwargs get the
+    iterator lifecycle: ``commit()`` snapshots their state (and, with a
+    ``checkpoint_dir``, persists it in the engine manifest alongside
+    the ZeRO shards), ``restore()`` rolls them back, and ``sync()``
+    broadcasts the committed position — then each loader reshards its
+    remaining epoch to the new world (``load_state_dict`` re-resolves
+    topology).  A mid-epoch restore resumes with no duplicated and no
+    dropped samples; see docs/data.md."""
 
     def __init__(self, params=None, opt_state=None, checkpoint_dir=None,
                  checkpoint_keep: int = 3, checkpoint_mesh=None, **kwargs):
         self._tree_keys = []
+        self._data_keys = [k for k, v in kwargs.items()
+                           if _is_data_iterator(v)]
+        data_objs = {k: kwargs.pop(k) for k in self._data_keys}
+        self._saved_data = {k: v.state_dict() for k, v in data_objs.items()}
         self._checkpoint_dir = checkpoint_dir
         self._checkpoint_keep = checkpoint_keep
         self._checkpoint_mesh = checkpoint_mesh
@@ -205,6 +234,8 @@ class TpuState(ObjectState):
             self._tree_keys.append("opt_state")
             kwargs["opt_state"] = opt_state
         super().__init__(**kwargs)
+        for k, v in data_objs.items():
+            setattr(self, k, v)
 
     def _mesh(self):
         if self._checkpoint_mesh is not None:
@@ -237,6 +268,16 @@ class TpuState(ObjectState):
 
     def commit(self):
         saved_steps = {}
+        # Iterator state is captured ONCE here and stamped into every
+        # manifest this commit writes: the committed step atomically
+        # pairs optimizer moments with the input position, so a restore
+        # can never resume the data stream at a different step.
+        data_states = {k: getattr(self, k).state_dict()
+                       for k in self._data_keys}
+        extra = None
+        if data_states:
+            from ..checkpoint import DATA_ITERS_KEY
+            extra = {DATA_ITERS_KEY: data_states}
         if self._checkpoint_dir is not None:
             from ..checkpoint import save_zero_state
             for k in self._tree_keys:
@@ -245,9 +286,17 @@ class TpuState(ObjectState):
                     step = self._next_ckpt_step(k)
                     save_zero_state(self._zero_dir(k), tree, step=step,
                                     mesh=self._mesh(),
-                                    keep=self._checkpoint_keep)
+                                    keep=self._checkpoint_keep,
+                                    extra=extra)
                     self._ckpt_next_step[k] = step + 1
                     saved_steps[k] = step
+            if data_states and not saved_steps:
+                # No ZeRO tree to ride: iterator state gets its own
+                # (tiny) engine step — same durability protocol.
+                step = self._next_ckpt_step(_DATA_DIR_KEY)
+                self._commit_data_step(step, data_states)
+                self._ckpt_next_step[_DATA_DIR_KEY] = step + 1
+                saved_steps[_DATA_DIR_KEY] = step
         try:
             super().commit()
         except HostsUpdatedInterrupt:
@@ -259,11 +308,53 @@ class TpuState(ObjectState):
             raise
         self._ckpt_committed_step.update(saved_steps)
 
+    def _read_data_iters_from_disk(self, chosen: dict):
+        """The committed iterator-state payload: from the chosen (or
+        newest committed) step of a ZeRO tree's manifest when one
+        exists, else from the dedicated iterator-state directory."""
+        if self._checkpoint_dir is None:
+            return None
+        from ..checkpoint import is_committed, restore_data_state
+        keys = [k for k in self._tree_keys
+                if _has_zero_sharded(getattr(self, k))]
+        keys.append(_DATA_DIR_KEY)
+        for k in keys:
+            d = self._zero_dir(k)
+            step = chosen.get(k)
+            if step is not None and not is_committed(d, step):
+                step = None
+            try:
+                state = restore_data_state(d, step=step)
+            except (OSError, ValueError, KeyError):
+                continue
+            if state:
+                return state
+        return None
+
+    def _commit_data_step(self, step: int, data_states: dict) -> None:
+        """One process (rank 0) writes the dedicated iterator-state
+        step; a barrier makes it durable before anyone moves on (the
+        save_zero_state protocol in miniature)."""
+        from ..checkpoint import save_data_state
+        writer = True
+        barrier = None
+        if global_state.initialized and global_state.process_count > 1:
+            from ..ops import collective as C
+            writer = global_state.process_rank == 0
+            barrier = C.barrier
+        if writer:
+            save_data_state(self._zero_dir(_DATA_DIR_KEY), data_states,
+                            step=step, keep=self._checkpoint_keep)
+        if barrier is not None:
+            barrier()
+
     def save(self):
         # Device→host snapshot so a TPU reset cannot lose it.
         for k in self._tree_keys:
             setattr(self, "_host_" + k, jax.tree_util.tree_map(
                 lambda x: np.asarray(x), getattr(self, k)))
+        for k in self._data_keys:
+            self._saved_data[k] = getattr(self, k).state_dict()
         super().save()
 
     def restore(self):
@@ -273,6 +364,9 @@ class TpuState(ObjectState):
             if host is not None:
                 setattr(self, k, jax.tree_util.tree_map(
                     lambda x: jax.numpy.asarray(x), host))
+        for k in self._data_keys:
+            getattr(self, k).load_state_dict(
+                copy.deepcopy(self._saved_data[k]))
 
     def sync(self, root: Optional[int] = None):
         from ..optimizers import broadcast_parameters
@@ -340,6 +434,25 @@ class TpuState(ObjectState):
                         treedef, flat))
                 continue
             setattr(self, k, broadcast_parameters(tree, root_rank=root))
+        # Data iterators: seed the committed position from disk (a full
+        # relaunch has no in-memory record), then let the elected
+        # root's view win — survivors carry the same committed state
+        # they wrote, so mixed survivor/fresh rounds converge.  Loading
+        # re-seats each loader in the CURRENT topology: the remaining
+        # epoch reshards N→M with no duplicated and no dropped samples.
+        if self._data_keys:
+            disk = self._read_data_iters_from_disk(chosen)
+            if disk:
+                for k, v in disk.items():
+                    if k in self._data_keys:
+                        self._saved_data[k] = v
+            if global_state.initialized and global_state.size > 1:
+                from ..optimizers import broadcast_object
+                self._saved_data = broadcast_object(self._saved_data,
+                                                    root_rank=root)
+            for k in self._data_keys:
+                getattr(self, k).load_state_dict(
+                    copy.deepcopy(self._saved_data[k]))
         # Sync the plain-object part too.
         object_keys = [k for k in self._saved_state
                        if k not in self._tree_keys]

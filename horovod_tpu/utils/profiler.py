@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time
 from typing import Optional
 
 
@@ -50,6 +52,52 @@ def op_range(name: str, payload_bytes: Optional[int] = None):
     else:
         with ann:
             yield
+
+
+class _WaitSpan:
+    """Filled in when the ``data_wait`` block exits."""
+
+    seconds: float = 0.0
+
+
+_data_wait_lock = threading.Lock()
+_data_wait_stats = {"count": 0, "total_s": 0.0, "last_s": 0.0}
+
+
+@contextlib.contextmanager
+def data_wait(name: str = "data_wait"):
+    """Annotate + time one step's blocking wait on the input pipeline.
+
+    The span shows up on the profiler host timeline (same mechanism as
+    ``op_range``) so an input-bound step is visually distinct from a
+    compute-bound one, and the duration feeds the module-level
+    ``data_wait_stats()`` counters the loader/bench report from.
+    Yields a :class:`_WaitSpan` whose ``seconds`` is set on exit."""
+    span = _WaitSpan()
+    t0 = time.perf_counter()
+    try:
+        with op_range(name):
+            yield span
+    finally:
+        span.seconds = time.perf_counter() - t0
+        with _data_wait_lock:
+            _data_wait_stats["count"] += 1
+            _data_wait_stats["total_s"] += span.seconds
+            _data_wait_stats["last_s"] = span.seconds
+
+
+def data_wait_stats() -> dict:
+    """Snapshot of cumulative data-wait spans: count / total_s / last_s
+    (+ derived mean_s).  Reset with :func:`reset_data_wait_stats`."""
+    with _data_wait_lock:
+        out = dict(_data_wait_stats)
+    out["mean_s"] = out["total_s"] / out["count"] if out["count"] else 0.0
+    return out
+
+
+def reset_data_wait_stats() -> None:
+    with _data_wait_lock:
+        _data_wait_stats.update(count=0, total_s=0.0, last_s=0.0)
 
 
 def start_trace(logdir: str) -> None:

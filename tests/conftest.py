@@ -37,6 +37,36 @@ def _fresh_runtime():
         hvd.shutdown()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _no_stray_background_threads():
+    """No non-daemon background thread started during the suite may
+    survive it: a leaked worker (a prefetch producer whose close() was
+    skipped, an autotune helper, a wedged controller loop) would hang
+    the interpreter at exit — in CI that reads as a timeout with no
+    traceback.  Threads alive before the session (pytest/plugin
+    machinery) are exempt; stragglers get a short grace join first so
+    a thread mid-teardown does not flake the whole run."""
+    import threading
+    # Thread OBJECTS, not idents: idents are recycled by the OS, and a
+    # held reference is what guarantees no identity reuse.
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t.is_alive() and t not in before
+              and t is not threading.main_thread()
+              # All non-daemon stragglers, PLUS this framework's own
+              # daemon workers (prefetch producers are daemonized so a
+              # crash can't hang the interpreter — but a LEAKED one
+              # still means a close() was skipped; catch it by name).
+              and (not t.daemon or t.name.startswith("hvd-tpu-"))]
+    for t in leaked:
+        t.join(timeout=5)
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "background threads survived the test session (skipped close()/"
+        f"join, interpreter exit may hang): {[t.name for t in leaked]}")
+
+
 # ---------------------------------------------------------------------------
 # Timeout enforcement.  pytest-timeout is not installed in this image, so
 # @pytest.mark.timeout marks would silently be no-ops; enforce them (plus a
