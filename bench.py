@@ -1315,6 +1315,78 @@ def bench_data():
     })
 
 
+def bench_metrics_overhead():
+    """Telemetry tax: steps/sec with hvd.metrics recording enabled vs
+    disabled (HVD_TPU_METRICS_DISABLE semantics), at the production
+    per-step instrumentation shape — one data-wait span, N eager
+    collective records, one step_end — around a simulated step cost
+    (default 5 ms, bench_data's shape).  Cross-rank sync stays at its
+    default cadence (off), matching the acceptance criterion.  Pure
+    host-side: no accelerator is touched, so the number isolates the
+    recorders themselves; ``hook_cost_us_per_step`` is the same delta
+    measured without the step cost (robust to sleep jitter).  Select
+    with BENCH_MODEL=metrics_overhead or
+    `bench.py --bench metrics_overhead`."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+    from horovod_tpu import metrics
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.utils import profiler
+
+    step_ms = float(os.environ.get("BENCH_METRICS_STEP_MS", "5"))
+    steps = int(os.environ.get("BENCH_ITERS", "400"))
+    n_coll = int(os.environ.get("BENCH_METRICS_COLLECTIVES", "4"))
+    payload = np.ones((64, 1024), dtype=np.float32)  # 256 KB "gradient"
+    agg = metrics.Aggregator()
+
+    def one_step(sleep_s):
+        with profiler.data_wait():
+            pass
+        for _ in range(n_coll):
+            with C._op_range("allreduce", "grad", payload):
+                pass
+        if sleep_s:
+            time.sleep(sleep_s)
+        agg.step_end()
+
+    def run(enabled, sleep_s, n):
+        metrics.set_enabled(enabled)
+        one_step(0)  # warm: metric children + annotation path created
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one_step(sleep_s)
+        return time.perf_counter() - t0
+
+    try:
+        sleep_s = step_ms / 1e3
+        t_on = run(True, sleep_s, steps)
+        t_off = run(False, sleep_s, steps)
+        # Hook-only delta at 20x the iterations: isolates recorder cost
+        # from sleep-granularity noise.
+        hooks_on = run(True, 0, steps * 20)
+        hooks_off = run(False, 0, steps * 20)
+    finally:
+        metrics.set_enabled(True)
+    sps_on = steps / t_on
+    sps_off = steps / t_off
+    overhead_pct = max((1.0 - sps_on / sps_off) * 100.0, 0.0)
+    hook_us = max(hooks_on - hooks_off, 0.0) / (steps * 20) * 1e6
+    _emit({
+        "metric": "metrics_instrumentation_overhead",
+        "value": round(overhead_pct, 3),
+        "unit": f"% steps/sec lost with recording on ({n_coll} "
+                f"collectives + data-wait + step_end per {step_ms:g}ms "
+                "step)",
+        # Baseline = the same step with recording disabled.
+        "vs_baseline": round(sps_on / sps_off, 4),
+        "steps_per_sec_instrumented": round(sps_on, 2),
+        "steps_per_sec_bare": round(sps_off, 2),
+        "hook_cost_us_per_step": round(hook_us, 2),
+        "sync_cadence": 0,
+        "steps": steps,
+    })
+
+
 def _tpu_transport_alive() -> bool:
     """The axon TPU tunnel (loopback relay) can die; when it does, any
     TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
@@ -1343,6 +1415,8 @@ def main():
         mode = sys.argv[i]
     if mode == "data":
         return bench_data()  # host-only; never touches the accelerator
+    if mode == "metrics_overhead":
+        return bench_metrics_overhead()  # host-only
     if mode == "eager":
         return bench_eager()  # never touches the accelerator
     if mode == "eager_sweep":

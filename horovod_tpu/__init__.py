@@ -44,6 +44,7 @@ from .parallel import mesh as mesh_lib
 from . import checkpoint
 from . import data
 from . import elastic
+from . import metrics
 
 __all__ = [
     "__version__",
@@ -65,5 +66,5 @@ __all__ = [
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "checkpoint", "data", "elastic",
+    "mesh_lib", "checkpoint", "data", "elastic", "metrics",
 ]

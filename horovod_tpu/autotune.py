@@ -222,6 +222,27 @@ class ParameterManager:
         self._plan_numeric = None
         self._window_start = time.perf_counter()
         self._bytes = 0
+        # Autotune decisions feed the metrics registry: which parameters
+        # are live right now, how many sample windows were scored, and
+        # whether the tuner froze — queryable next to the throughput
+        # they produced instead of buried in the CSV log.
+        from .metrics.registry import registry as _metrics_registry
+        _mreg = _metrics_registry()
+        self._m_samples = _mreg.counter(
+            "hvd_autotune_samples_total",
+            "Scored autotune sample windows")
+        self._m_decisions = _mreg.counter(
+            "hvd_autotune_decisions_total",
+            "Parameter applications by the autotuner")
+        self._m_fusion = _mreg.gauge(
+            "hvd_autotune_fusion_bytes",
+            "Fusion threshold currently applied by the autotuner")
+        self._m_cycle = _mreg.gauge(
+            "hvd_autotune_cycle_ms",
+            "Cycle time currently applied by the autotuner")
+        self._m_frozen = _mreg.gauge(
+            "hvd_autotune_frozen",
+            "1 once the autotuner froze its best parameters")
         self._propose()
 
     @property
@@ -249,6 +270,12 @@ class ParameterManager:
             self._current = ((int(2 ** x[0]), float(x[1]))
                              + self._round_toggles(x))
         self._apply(*self._current)
+        self._record_applied()
+
+    def _record_applied(self):
+        self._m_decisions.inc()
+        self._m_fusion.set(self._current[0])
+        self._m_cycle.set(self._current[1])
 
     def record_bytes(self, nbytes: int):
         """Feed data-plane traffic; closes a window when enough time passed
@@ -286,12 +313,15 @@ class ParameterManager:
         self._opt.observe(self._x_of_current(), score)
         self._log(score)
         self._samples += 1
+        self._m_samples.inc()
         if self._samples >= self._max_samples:
             best_x, best_y = self._opt.best()
             self._current = ((int(2 ** best_x[0]), float(best_x[1]))
                              + tuple(self._round_toggles(best_x)))
             self._apply(*self._current)
+            self._record_applied()
             self._frozen = True
+            self._m_frozen.set(1)
             self._log(best_y, tag="final")
         else:
             self._propose()

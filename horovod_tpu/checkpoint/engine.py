@@ -21,12 +21,39 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from . import manifest as M
 from . import reshard as R
+
+_ckpt_metrics = None
+
+
+def _metrics():
+    """Cached checkpoint metric children (hvd.metrics registry)."""
+    global _ckpt_metrics
+    if _ckpt_metrics is None:
+        from ..metrics.registry import registry
+        reg = registry()
+        buckets = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0)
+        _ckpt_metrics = (
+            reg.counter("hvd_checkpoint_bytes_written_total",
+                        "Shard + manifest bytes written"),
+            reg.counter("hvd_checkpoint_bytes_read_total",
+                        "Shard bytes read on restore"),
+            reg.counter("hvd_checkpoint_saves_total",
+                        "Committed checkpoint save operations"),
+            reg.counter("hvd_checkpoint_restores_total",
+                        "Checkpoint restore operations"),
+            reg.histogram("hvd_checkpoint_save_seconds",
+                          "save_leaves wall time", buckets=buckets),
+            reg.histogram("hvd_checkpoint_restore_seconds",
+                          "restore_leaves wall time", buckets=buckets),
+        )
+    return _ckpt_metrics
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
@@ -81,7 +108,9 @@ def write_shard(root: str, step: int, rank: int, world_size: int,
     np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
     path = os.path.join(step_dir(root, step),
                         M.shard_filename(rank, world_size))
-    _atomic_write_bytes(path, buf.getvalue())
+    data = buf.getvalue()
+    _atomic_write_bytes(path, data)
+    _metrics()[0].inc(len(data))
     return path
 
 
@@ -159,7 +188,12 @@ def read_shard(root: str, step: int, rank: int,
     path = os.path.join(step_dir(root, step),
                         M.shard_filename(rank, world_size))
     with np.load(path) as z:
-        return {k: z[k] for k in z.files}
+        out = {k: z[k] for k in z.files}
+    try:
+        _metrics()[1].inc(os.path.getsize(path))
+    except OSError:
+        pass
+    return out
 
 
 def gc_steps(root: str, keep: int = 3) -> List[int]:
@@ -199,6 +233,7 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
     writes and the manifest commit so the committer cannot outrun a slow
     writer.
     """
+    t0 = time.perf_counter()
     for rank, values in sorted(rank_values.items()):
         arrays = {}
         for spec, val in zip(specs, values):
@@ -212,6 +247,9 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
                           extra=extra or {})
     if committer:
         commit(root, step, manifest)
+    m = _metrics()
+    m[2].inc()
+    m[4].observe(time.perf_counter() - t0)
     return manifest
 
 
@@ -223,9 +261,13 @@ def restore_leaves(root: str, step: int,
         raise FileNotFoundError(
             f"step {step} in {root} is not a committed checkpoint "
             "(torn write or wrong directory)")
+    t0 = time.perf_counter()
     manifest = read_manifest(root, step)
     shards = [read_shard(root, step, r, manifest.world_size)
               for r in range(manifest.world_size)]
+    m = _metrics()
+    m[3].inc()
+    m[5].observe(time.perf_counter() - t0)
     return RestoredStep(manifest, shards, new_world_size)
 
 

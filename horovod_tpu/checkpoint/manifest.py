@@ -27,6 +27,7 @@ Leaf kinds:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 from typing import Any, Dict, List, Optional
 
@@ -35,6 +36,23 @@ MANIFEST_NAME = "MANIFEST.json"
 
 SHARDED = "sharded"
 REPLICATED = "replicated"
+
+# manifest.extra key of the run fingerprint (mesh shape + leaf-spec
+# hash) stamped by save_zero_state; restore refuses a mismatched
+# leaf-spec hash unless HVD_TPU_CKPT_ALLOW_FOREIGN=1.
+RUN_FINGERPRINT_KEY = "run_fingerprint"
+
+
+def spec_fingerprint(leaves: List["LeafSpec"]) -> str:
+    """Content hash of a leaf-spec list: path, kind, dtype and logical
+    size per leaf.  Deliberately world-size-invariant — an elastic N→M
+    restore of the SAME run must keep the same fingerprint; a different
+    model/optimizer (a different *run*) must not."""
+    h = hashlib.sha256()
+    for leaf in leaves:
+        h.update(f"{leaf.path}|{leaf.kind}|{leaf.dtype}|"
+                 f"{leaf.true_size}\n".encode())
+    return h.hexdigest()
 
 
 def step_dirname(step: int) -> str:

@@ -286,6 +286,51 @@ def zero_init(tx, params, mesh=None, axis_name: Optional[str] = None):
                              out_specs=out_specs, check_vma=False))(params)
 
 
+def _foreign_allowed() -> bool:
+    import os
+    return os.environ.get("HVD_TPU_CKPT_ALLOW_FOREIGN", "") == "1"
+
+
+def _recorded_fingerprint(manifest: M.Manifest) -> str:
+    """The manifest's stamped fingerprint; derived from its leaf specs
+    for checkpoints written before the stamp existed (same hash)."""
+    rec = (manifest.extra or {}).get(M.RUN_FINGERPRINT_KEY) or {}
+    return rec.get("leaf_spec_sha256") or M.spec_fingerprint(
+        manifest.leaves)
+
+
+def _check_run_fingerprint(root: str, fp: str, direction: str) -> None:
+    """Refuse to mix runs in one checkpoint directory: the engine
+    validates pytree structure but cannot tell one run's moments from
+    another's (docs/checkpointing.md) — the fingerprint can.  Escape
+    hatch: HVD_TPU_CKPT_ALLOW_FOREIGN=1."""
+    latest = E.latest_step(root)
+    if latest is None:
+        return
+    try:
+        manifest = E.read_manifest(root, latest)
+    except (OSError, ValueError, KeyError):
+        return
+    recorded = _recorded_fingerprint(manifest)
+    if recorded == fp:
+        return
+    if _foreign_allowed():
+        from ..utils import logging as log
+        log.warning(
+            "checkpoint %s: run fingerprint mismatch (%s... vs this "
+            "run's %s...) overridden by HVD_TPU_CKPT_ALLOW_FOREIGN=1",
+            direction, recorded[:12], fp[:12])
+        return
+    raise ValueError(
+        f"checkpoint directory {root} belongs to a different run: its "
+        f"newest committed step has leaf-spec fingerprint "
+        f"{recorded[:12]}..., this state fingerprints {fp[:12]}... "
+        f"(different model/optimizer structure, dtypes or sizes).  "
+        f"Refusing the cross-run {direction}: use a fresh "
+        f"checkpoint_dir per training run, or set "
+        f"HVD_TPU_CKPT_ALLOW_FOREIGN=1 to override.")
+
+
 def save_zero_state(root: str, state, step: int, mesh=None,
                     axis_name: Optional[str] = None,
                     keep: Optional[int] = None,
@@ -329,6 +374,20 @@ def save_zero_state(root: str, state, step: int, mesh=None,
                 f"{missing}; was the state threaded with "
                 "zero_state_specs so every local shard is addressable?")
 
+    # Run fingerprint: refuse to interleave a DIFFERENT run's steps into
+    # this directory (same fingerprint check as restore — a foreign
+    # save would poison `latest` resolution for both runs).
+    specs = [p.spec for p in plans]
+    fp = M.spec_fingerprint(specs)
+    _check_run_fingerprint(root, fp, direction="save")
+    extra = dict(extra or {})
+    extra[M.RUN_FINGERPRINT_KEY] = {
+        "leaf_spec_sha256": fp,
+        "mesh_shape": {str(a): int(mesh.shape[a])
+                       for a in mesh.axis_names},
+        "world_size": world,
+    }
+
     from ..core.state import global_state
     barrier = None
     committer = True
@@ -337,7 +396,7 @@ def save_zero_state(root: str, state, step: int, mesh=None,
         barrier = C.barrier
         committer = global_state.process_rank == 0
     manifest = E.save_leaves(
-        root, step, [p.spec for p in plans], rank_values, world,
+        root, step, specs, rank_values, world,
         committer=committer, extra=extra, barrier=barrier)
     if keep is not None and committer:
         E.gc_steps(root, keep=keep)
@@ -375,6 +434,22 @@ def restore_zero_state(root: str, like, mesh=None,
             raise FileNotFoundError(
                 f"no committed checkpoint step under {root}")
     restored = E.restore_leaves(root, step, world)
+    # Cross-run guard: the checkpoint's stamped fingerprint must match
+    # the restore target's structure (world-size-invariant, so elastic
+    # N→M restores of the same run always pass).
+    target_plans, _, _ = _plan_tree(like, restored.manifest.world_size,
+                                    validate=False)
+    target_fp = M.spec_fingerprint([p.spec for p in target_plans])
+    saved_fp = _recorded_fingerprint(restored.manifest)
+    if saved_fp != target_fp and not _foreign_allowed():
+        raise ValueError(
+            f"step {step} under {root} was written by a different run: "
+            f"checkpoint leaf-spec fingerprint {saved_fp[:12]}... != "
+            f"restore target's {target_fp[:12]}... (different model/"
+            f"optimizer structure, dtypes or sizes).  Refusing the "
+            f"cross-run restore: point checkpoint_dir at this run's "
+            f"directory, or set HVD_TPU_CKPT_ALLOW_FOREIGN=1 to "
+            f"override.")
     plans, groups, outer_def = _plan_tree_like(like, restored.manifest)
 
     new_leaves: List[Any] = []

@@ -174,6 +174,38 @@ def init(mesh=None,
         else:
             global_state.controller = native_runtime.attach()
 
+    # --- metrics ----------------------------------------------------------
+    # Topology gauges + (opt-in) the Prometheus scrape endpoint.  serve()
+    # is idempotent, so elastic re-inits keep the one server alive across
+    # rounds instead of rebinding the port; the daemon thread dies with
+    # the process (shutdown() deliberately leaves it serving — a reset
+    # mid-round must not blind the scraper).
+    from ..metrics.registry import registry as _metrics_registry
+    _mreg = _metrics_registry()
+    _mreg.counter("hvd_init_total", "Runtime initializations").inc()
+    _mreg.gauge("hvd_rank", "Chip-level rank of this process").set(
+        global_state.rank)
+    _mreg.gauge("hvd_size", "Total chips in the communicator").set(
+        global_state.size)
+    _mreg.gauge("hvd_elastic_round", "Current elastic rendezvous round "
+                "(-1 outside elastic jobs)").set(
+        global_state.elastic_round)
+    if global_state.config.metrics_port:
+        # Rank-gate the env-configured port: with several worker
+        # processes per host (LOCAL_SIZE > 1) only local rank 0 can own
+        # it.  Telemetry must never kill training — a bind failure
+        # (port held by a dying predecessor after an elastic respawn,
+        # another job, a stale server) degrades to a warning.
+        if global_state.local_rank == 0:
+            try:
+                from ..metrics import serve as _metrics_serve
+                _metrics_serve(port=global_state.config.metrics_port)
+            except OSError as e:
+                log.warning(
+                    "metrics: cannot serve on port %d (%s); continuing "
+                    "without a scrape endpoint",
+                    global_state.config.metrics_port, e)
+
     global_state.elastic_enabled = global_state.config.elastic
     global_state.initialized = True
     log.debug(

@@ -39,6 +39,12 @@ COMPILE_CACHE_DIR = "COMPILE_CACHE_DIR"        # TPU-only: persistent XLA cache
 DATA_PREFETCH = "DATA_PREFETCH"                # background prefetch on/off
 DATA_QUEUE_DEPTH = "DATA_QUEUE_DEPTH"          # prefetch queue depth
 DATA_STALL_TIMEOUT_SECONDS = "DATA_STALL_TIMEOUT_SECONDS"  # 0 = warn only
+# Metrics subsystem (horovod_tpu/metrics/).
+METRICS_SYNC_STEPS = "METRICS_SYNC_STEPS"      # cross-rank cadence; 0 = off
+METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
+METRICS_STRAGGLER_FACTOR = "METRICS_STRAGGLER_FACTOR"
+METRICS_STRAGGLER_MIN_SECONDS = "METRICS_STRAGGLER_MIN_SECONDS"
+METRICS_STRAGGLER_PATIENCE = "METRICS_STRAGGLER_PATIENCE"
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -113,6 +119,10 @@ class Config:
     data_prefetch: bool = True
     data_queue_depth: int = 2
     data_stall_timeout_seconds: float = 0.0
+    # Metrics: registry always records locally; cross-rank aggregation
+    # and the scrape endpoint are opt-in (both default off).
+    metrics_sync_steps: int = 0
+    metrics_port: int = 0
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -150,6 +160,9 @@ class Config:
             1, get_int(DATA_QUEUE_DEPTH, cfg.data_queue_depth))
         cfg.data_stall_timeout_seconds = get_float(
             DATA_STALL_TIMEOUT_SECONDS, cfg.data_stall_timeout_seconds)
+        cfg.metrics_sync_steps = max(
+            0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
+        cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

@@ -188,6 +188,10 @@ class PrefetchIterator:
                             "%s: input pipeline stalled — no batch for "
                             "%.0fs (source blocked or filesystem slow?)",
                             self._name, waited)
+                        from ..metrics.registry import registry
+                        registry().counter(
+                            "hvd_data_stall_warnings_total",
+                            "Input-pipeline stall warnings").inc()
                     if 0 < self._stall_timeout_s <= waited:
                         self.close()
                         raise DataStallError(

@@ -31,6 +31,14 @@ from ..core.state import global_state
 from ..utils import logging as log
 
 
+def _elastic_counter(name: str, help: str, **labels):
+    """Elastic lifecycle events in the hvd.metrics registry — commit/
+    restore/sync/reset rates are the fleet-health signals the driver's
+    free-text prints never made queryable."""
+    from ..metrics.registry import registry
+    return registry().counter(name, help, **labels)
+
+
 class State:
     """Base elastic state with commit/restore/sync and host-update checks."""
 
@@ -60,6 +68,8 @@ class State:
     def commit(self):
         self.save()
         self._sync_generation += 1
+        _elastic_counter("hvd_elastic_commits_total",
+                         "Elastic state commits").inc()
         notification_manager.poll()
         self.check_host_updates()
 
@@ -195,8 +205,9 @@ class TpuState(ObjectState):
     ``shard_map`` with ``checkpoint.zero_state_specs`` (global flat
     buffers partitioned over the data axis) so commits can see every
     local shard.  Use a fresh ``checkpoint_dir`` per training run: the
-    engine validates pytree structure on restore but cannot tell one
-    run's moments from another's.
+    engine's run fingerprint refuses cross-run saves/restores with a
+    pointed error (HVD_TPU_CKPT_ALLOW_FOREIGN=1 overrides), but
+    structurally identical runs are indistinguishable.
 
     Checkpointable data iterators (``hvd.data.DataLoader`` — anything
     with ``state_dict``/``load_state_dict``) passed as kwargs get the
@@ -478,6 +489,16 @@ def _reset():
     from ..core import basics
     basics.shutdown()
     basics.init()
+    # Re-zero the metrics aggregator's step counter: its sync cadence is
+    # a collective schedule keyed on the LOCAL step count, and a new
+    # round mixes survivors (counter mid-flight) with fresh spawns
+    # (counter 0).  Every member passes through this reset (survivor) or
+    # process start (fresh), so zeroing here re-aligns the fleet — a
+    # survivor syncing at a step a newcomer hasn't reached would pair
+    # its metrics allgather with the newcomer's next training
+    # collective.
+    from ..metrics.aggregate import aggregator
+    aggregator().reset()
 
 
 def run(func: Callable) -> Callable:
@@ -498,17 +519,34 @@ def run(func: Callable) -> Callable:
     def wrapper(state: State, *args, **kwargs):
         notification_manager.init()
         notification_manager.register_listener(state)
+        import time as _time
+        from ..metrics.registry import registry as _mreg
+        sync_gauge = _mreg().gauge(
+            "hvd_elastic_sync_seconds",
+            "Duration of the last elastic state sync")
         try:
             while True:
+                t0 = _time.perf_counter()
                 state.sync()
+                _elastic_counter("hvd_elastic_syncs_total",
+                                 "Elastic state syncs").inc()
+                sync_gauge.set(_time.perf_counter() - t0)
                 try:
                     return func(state, *args, **kwargs)
                 except HorovodInternalError:
                     log.warning("collective failure; restoring last "
                                 "committed state and re-initializing")
+                    _elastic_counter(
+                        "hvd_elastic_resets_total",
+                        "Elastic retry-loop resets by cause",
+                        cause="failure").inc()
                     state.restore()
                 except HostsUpdatedInterrupt:
                     log.info("host set updated; re-initializing")
+                    _elastic_counter(
+                        "hvd_elastic_resets_total",
+                        "Elastic retry-loop resets by cause",
+                        cause="hosts_updated").inc()
                 _reset()
                 state.on_reset()
         finally:

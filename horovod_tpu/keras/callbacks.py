@@ -114,6 +114,60 @@ class LearningRateScheduleCallback(_tf.keras.callbacks.Callback):
             print(f"\nEpoch {epoch}: lr = {lr:.6f}")
 
 
+class MetricsCallback(_tf.keras.callbacks.Callback):
+    """Feed ``hvd.metrics`` from a Keras training loop: one
+    ``step_end(batch_time)`` per batch (driving the step-time histogram
+    and — on the ``HVD_TPU_METRICS_SYNC_STEPS`` cadence — the cross-rank
+    aggregation + straggler detector), plus an optional per-epoch JSONL
+    snapshot in the same schema ``bench.py`` and the Prometheus endpoint
+    expose (docs/metrics.md).
+
+    Args:
+      jsonl_path: when given, append one registry snapshot per epoch to
+        this rotating JSONL file.
+      serve_port: when given, start the Prometheus endpoint on this port
+        at train begin (idempotent with ``init()``'s
+        ``HVD_TPU_METRICS_PORT`` auto-start).
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 serve_port: Optional[int] = None):
+        super().__init__()
+        self._jsonl_path = jsonl_path
+        self._serve_port = serve_port
+        self._sink = None
+        self._batch_t0: Optional[float] = None
+        self._epoch = 0
+
+    def on_train_begin(self, logs=None):
+        from .. import metrics
+        if self._jsonl_path:
+            self._sink = metrics.JsonlSink(self._jsonl_path)
+        if self._serve_port is not None:
+            metrics.serve(port=self._serve_port)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        import time
+        self._batch_t0 = time.perf_counter()
+
+    def on_train_batch_end(self, batch, logs=None):
+        import time
+        from .. import metrics
+        dt = None
+        if self._batch_t0 is not None:
+            dt = time.perf_counter() - self._batch_t0
+        metrics.step_end(dt)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch + 1
+        if self._sink is not None:
+            from .. import metrics
+            self._sink.write_snapshot(
+                epoch=epoch, rank=hvd_tf.rank(),
+                step=int(metrics.registry().counter(
+                    "hvd_steps_total", "Training steps observed").value))
+
+
 class CommitStateCallback(_tf.keras.callbacks.Callback):
     """Commit the elastic state every ``batches_per_commit`` batches
     (reference _keras/elastic.py:17-45): a worker failure rolls training

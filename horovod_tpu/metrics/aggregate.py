@@ -1,0 +1,236 @@
+"""Cross-rank metric aggregation: per-rank snapshots → a fleet view.
+
+Per-rank registries answer "what happened on THIS process"; fleet-scale
+questions ("which rank is slow and why") need every rank's numbers side
+by side.  This module allgathers compact per-rank snapshots over the
+existing collective path (``allgather_object`` — native controller, the
+jitted process mesh, or trivially for one process) on an opt-in cadence:
+
+    ``HVD_TPU_METRICS_SYNC_STEPS`` = N  →  every N-th ``step_end()``
+    runs one :meth:`Aggregator.sync`.  Default 0 = never — the hot path
+    pays nothing unless the operator asks.
+
+``step_end`` is the one hook training loops (and
+``keras.callbacks.MetricsCallback`` / ``bench.py``) call per step; it
+also feeds the local ``hvd_step_time_seconds`` histogram.  Because every
+rank steps in lockstep (SPMD), a step-count cadence is a safe collective
+schedule — no extra coordination needed.
+
+The wire snapshot is deliberately small: rank id, windowed step-time and
+data-wait sums/counts (deltas since the previous sync, so one slow hour
+cannot hide in a lifetime mean), plus the flat counter/gauge scalars.
+Rank 0 — and in fact every rank, the allgather is symmetric — holds the
+assembled fleet view (:meth:`fleet`) and runs the straggler detector
+over it (:mod:`.health`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .health import detector as _detector
+from .registry import registry as _registry
+
+_STEP_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 15.0, 60.0)
+
+
+def _sync_cadence() -> int:
+    from ..core.state import global_state
+    if global_state.initialized and global_state.config is not None:
+        return max(int(getattr(global_state.config,
+                               "metrics_sync_steps", 0)), 0)
+    from ..core.config import get_int
+    return max(get_int("METRICS_SYNC_STEPS", 0), 0)
+
+
+def _data_wait_totals() -> tuple:
+    """(total_s, count, reset_generation) of data-wait spans from the
+    registry (the migrated ``utils/profiler.data_wait_stats`` storage).
+    The generation lets window marks detect a mid-window
+    ``reset_data_wait_stats()`` even when the count climbs back past
+    its mark."""
+    reg = _registry()
+    count = reg.counter("hvd_data_wait_spans_total",
+                        "Number of input-pipeline wait spans")
+    return (reg.counter("hvd_data_wait_seconds_total",
+                        "Cumulative input-pipeline wait").value,
+            count.value, count.resets)
+
+
+class Aggregator:
+    """Step accounting + cadence-driven cross-rank sync."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step = 0
+        self._step_sum = 0.0
+        self._step_count = 0
+        # Window marks: values at the last sync, subtracted to report
+        # deltas instead of lifetime totals.
+        self._mark_step_sum = 0.0
+        self._mark_step_count = 0
+        self._mark_wait_sum = 0.0
+        self._mark_wait_count = 0
+        self._mark_wait_gen = 0
+        self._last_step_ts: Optional[float] = None
+        self._fleet: Optional[List[dict]] = None
+        self._fleet_step = -1
+
+    # -- per-step hook -----------------------------------------------------
+
+    def step_end(self, step_time_s: Optional[float] = None) -> None:
+        """Record one training step.  ``step_time_s`` omitted → derived
+        from the wall clock between consecutive calls (first call only
+        counts the step, it has no interval yet).  Runs a cross-rank
+        sync when the cadence divides the step index."""
+        now = time.perf_counter()
+        reg = _registry()
+        with self._lock:
+            if step_time_s is None and self._last_step_ts is not None:
+                step_time_s = now - self._last_step_ts
+            self._last_step_ts = now
+            self._step += 1
+            step = self._step
+            if step_time_s is not None:
+                self._step_sum += step_time_s
+                self._step_count += 1
+        reg.counter("hvd_steps_total", "Training steps observed").inc()
+        if step_time_s is not None:
+            reg.histogram("hvd_step_time_seconds",
+                          "Training step wall time",
+                          buckets=_STEP_TIME_BUCKETS).observe(step_time_s)
+        cadence = _sync_cadence()
+        if cadence > 0 and step % cadence == 0:
+            self.sync()
+
+    # -- cross-rank sync ---------------------------------------------------
+
+    def local_snapshot(self) -> dict:
+        """The compact wire snapshot for this rank: windowed deltas plus
+        the flat scalar view of the registry.  A data-wait counter that
+        was reset underneath the marks (``reset_data_wait_stats()``
+        mid-window, detected via its reset generation) contributes
+        everything since the reset — never a negative delta."""
+        from ..core.state import global_state
+        wait_sum, wait_count, wait_gen = _data_wait_totals()
+        with self._lock:
+            if wait_gen != self._mark_wait_gen:
+                dw_sum, dw_count = wait_sum, wait_count
+            else:
+                dw_sum = wait_sum - self._mark_wait_sum
+                dw_count = wait_count - self._mark_wait_count
+            snap = {
+                "rank": int(global_state.process_rank),
+                "step": self._step,
+                "step_time_sum": self._step_sum - self._mark_step_sum,
+                "step_count": self._step_count - self._mark_step_count,
+                "data_wait_sum": dw_sum,
+                "data_wait_count": dw_count,
+            }
+        snap["scalars"] = _registry().scalars()
+        return snap
+
+    def _advance_window(self) -> None:
+        wait_sum, wait_count, wait_gen = _data_wait_totals()
+        with self._lock:
+            self._mark_step_sum = self._step_sum
+            self._mark_step_count = self._step_count
+            self._mark_wait_sum = wait_sum
+            self._mark_wait_count = wait_count
+            self._mark_wait_gen = wait_gen
+
+    def sync(self) -> List[dict]:
+        """Allgather every rank's snapshot; evaluate rank health.  A
+        collective — every rank must call it at the same step (the
+        cadence in ``step_end`` guarantees this for SPMD loops, and an
+        elastic reset re-zeroes every member's step counter so rejoined
+        worlds stay aligned — see elastic/state.py ``_reset``)."""
+        t0 = time.perf_counter()
+        snap = self.local_snapshot()
+        from ..core.state import global_state
+        if global_state.initialized and (
+                global_state.process_count > 1
+                or global_state.controller is not None):
+            from ..optimizers import allgather_object
+            gathered = allgather_object(snap, name="hvd.metrics.sync")
+        else:
+            gathered = [snap]
+        self._advance_window()
+        # Warnings from one rank only — the report itself (and the
+        # blacklist hint) is identical everywhere, the allgather is
+        # symmetric.
+        _detector().evaluate(
+            gathered, warn=global_state.process_rank == 0)
+        reg = _registry()
+        reg.counter("hvd_metrics_syncs_total",
+                    "Cross-rank metric aggregations").inc()
+        reg.gauge("hvd_metrics_sync_seconds",
+                  "Duration of the last metrics aggregation "
+                  "(gather + health scoring)").set(
+            time.perf_counter() - t0)
+        with self._lock:
+            self._fleet = gathered
+            self._fleet_step = snap["step"]
+        return gathered
+
+    # -- read side ---------------------------------------------------------
+
+    def fleet(self) -> Optional[List[dict]]:
+        """Per-rank snapshots from the most recent sync (None before the
+        first)."""
+        with self._lock:
+            return list(self._fleet) if self._fleet is not None else None
+
+    def fleet_scalars(self) -> Dict[int, Dict[str, float]]:
+        """{rank: flat scalars} from the last sync — the queryable fleet
+        surface ("sum hvd_collective_bytes_total over ranks")."""
+        fleet = self.fleet() or []
+        return {int(s["rank"]): dict(s.get("scalars", {})) for s in fleet}
+
+    def reset(self) -> None:
+        """Zero the step counter and open a fresh window anchored at the
+        data-wait counters' CURRENT values (they are lifetime counters
+        and survive an elastic reset on surviving workers)."""
+        wait_sum, wait_count, wait_gen = _data_wait_totals()
+        with self._lock:
+            self._step = 0
+            self._step_sum = 0.0
+            self._step_count = 0
+            self._mark_step_sum = 0.0
+            self._mark_step_count = 0
+            self._mark_wait_sum = wait_sum
+            self._mark_wait_count = wait_count
+            self._mark_wait_gen = wait_gen
+            self._last_step_ts = None
+            self._fleet = None
+            self._fleet_step = -1
+
+
+_aggregator: Optional[Aggregator] = None
+_aggregator_lock = threading.Lock()
+
+
+def aggregator() -> Aggregator:
+    global _aggregator
+    with _aggregator_lock:
+        if _aggregator is None:
+            _aggregator = Aggregator()
+        return _aggregator
+
+
+def step_end(step_time_s: Optional[float] = None) -> None:
+    """Module-level convenience: ``hvd.metrics.step_end()`` once per
+    training step."""
+    aggregator().step_end(step_time_s)
+
+
+def sync() -> List[dict]:
+    return aggregator().sync()
+
+
+def fleet_snapshot() -> Optional[List[dict]]:
+    return aggregator().fleet()

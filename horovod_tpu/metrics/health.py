@@ -1,0 +1,208 @@
+"""Straggler / rank-health detection from aggregated fleet snapshots.
+
+"Which rank is slow and why" is the question fleet-scale training lives
+or dies on (the reference shipped its timeline as a first-class product
+for exactly this, arXiv:1802.05799).  This module answers it from the
+cross-rank aggregation (:mod:`.aggregate`): each sync round carries every
+rank's windowed mean step time and mean data-wait; the detector scores
+each rank against the fleet median and attributes the slowdown.
+
+Scoring (robust by construction — a single straggler cannot drag the
+baseline it is compared against):
+
+* ``score(r) = mean_step_time(r) / median over ranks``
+* flagged when ``score >= factor`` (``HVD_TPU_METRICS_STRAGGLER_FACTOR``,
+  default 1.5) AND the absolute excess clears a noise floor
+  (``HVD_TPU_METRICS_STRAGGLER_MIN_SECONDS``, default 1 ms).
+* cause: ``input`` when the rank's data-wait explains most of its excess
+  over the median (input pipeline, not compute/network), else
+  ``compute`` — the input-wait vs compute split of Awan et al.
+  (arXiv:1810.11112) applied per rank.
+
+A rank flagged in ``HVD_TPU_METRICS_STRAGGLER_PATIENCE`` *consecutive*
+evaluations lands in :meth:`StragglerDetector.blacklist_hint` — the hook
+an elastic driver (``runner/elastic_driver.py`` ``health_hook=``) or
+operator tooling consumes; one noisy window never condemns a host.
+
+Every evaluation also:
+
+* emits a ``log.warning`` per flagged rank (rank 0 only, to keep logs
+  fleet-readable),
+* drops a ``hvd.straggler.rank<N>`` timeline marker through the profiler
+  (visible on an XProf host trace next to the step it slowed),
+* updates ``hvd_straggler_*`` gauges/counters in the registry so the
+  Prometheus surface can alert on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .registry import registry as _registry
+
+
+def _cfg_float(name: str, default: float) -> float:
+    from ..core.config import get_float
+    return get_float(name, default)
+
+
+def _cfg_int(name: str, default: int) -> int:
+    from ..core.config import get_int
+    return get_int(name, default)
+
+
+@dataclasses.dataclass
+class RankHealth:
+    """One rank's verdict for one evaluation window."""
+
+    rank: int
+    step_time_mean: float        # seconds, windowed
+    data_wait_mean: float        # seconds, windowed
+    score: float                 # step_time_mean / fleet median
+    flagged: bool
+    cause: str                   # "input" | "compute" | "" (healthy)
+    steps: int                   # window sample count
+
+
+class StragglerDetector:
+    def __init__(self, factor: Optional[float] = None,
+                 min_seconds: Optional[float] = None,
+                 patience: Optional[int] = None):
+        self.factor = factor if factor is not None else \
+            _cfg_float("METRICS_STRAGGLER_FACTOR", 1.5)
+        self.min_seconds = min_seconds if min_seconds is not None else \
+            _cfg_float("METRICS_STRAGGLER_MIN_SECONDS", 1e-3)
+        self.patience = patience if patience is not None else \
+            _cfg_int("METRICS_STRAGGLER_PATIENCE", 2)
+        self._lock = threading.Lock()
+        self._consecutive: Dict[int, int] = {}
+        self._last_report: List[RankHealth] = []
+
+    # -- pure scoring ------------------------------------------------------
+
+    def score_ranks(self, per_rank: Sequence[dict]) -> List[RankHealth]:
+        """Score windowed per-rank stats.  ``per_rank`` entries:
+        ``{"rank", "step_time_sum", "step_count", "data_wait_sum"[,
+        "data_wait_count"]}`` (the aggregate wire shape).  Ranks with an
+        empty window score 1.0 and are never flagged (no evidence)."""
+        stats = []
+        for entry in per_rank:
+            n = int(entry.get("step_count", 0))
+            mean = (float(entry.get("step_time_sum", 0.0)) / n) if n else 0.0
+            wait = (float(entry.get("data_wait_sum", 0.0)) / n) if n else 0.0
+            stats.append((int(entry["rank"]), mean, wait, n))
+        with_data = sorted(m for _, m, _, n in stats if n > 0)
+        if not with_data:
+            return [RankHealth(r, m, w, 1.0, False, "", n)
+                    for r, m, w, n in stats]
+        k = len(with_data)
+        median = (with_data[k // 2] if k % 2 else
+                  0.5 * (with_data[k // 2 - 1] + with_data[k // 2]))
+        out = []
+        for r, mean, wait, n in stats:
+            if n == 0 or median <= 0.0:
+                out.append(RankHealth(r, mean, wait, 1.0, False, "", n))
+                continue
+            score = mean / median
+            excess = mean - median
+            flagged = score >= self.factor and excess >= self.min_seconds
+            cause = ""
+            if flagged:
+                # Input-bound when the rank's data-wait covers most of
+                # what it is slower by; otherwise compute/comm-bound.
+                cause = "input" if wait >= 0.5 * excess else "compute"
+            out.append(RankHealth(r, mean, wait, score, flagged, cause, n))
+        return out
+
+    # -- stateful evaluation ----------------------------------------------
+
+    def evaluate(self, per_rank: Sequence[dict],
+                 warn: bool = True) -> List[RankHealth]:
+        """Score + update consecutive-flag streaks, emit warnings,
+        timeline markers and registry metrics.  Returns the report."""
+        report = self.score_ranks(per_rank)
+        reg = _registry()
+        flagged = [h for h in report if h.flagged]
+        with self._lock:
+            seen = {h.rank for h in report}
+            for h in report:
+                if h.flagged:
+                    self._consecutive[h.rank] = \
+                        self._consecutive.get(h.rank, 0) + 1
+                else:
+                    self._consecutive.pop(h.rank, None)
+            # Ranks that left the world take their streaks with them.
+            for r in [r for r in self._consecutive if r not in seen]:
+                self._consecutive.pop(r, None)
+            self._last_report = report
+        reg.gauge(
+            "hvd_straggler_ranks",
+            "Ranks flagged as stragglers in the last evaluation"
+        ).set(len(flagged))
+        for h in flagged:
+            reg.counter(
+                "hvd_straggler_flags_total",
+                "Straggler flags per rank", rank=str(h.rank),
+                cause=h.cause).inc()
+            self._timeline_marker(h)
+            if warn:
+                from ..utils import logging as log
+                log.warning(
+                    "straggler: rank %d step time %.1f ms = %.2fx fleet "
+                    "median (%s-bound, data-wait %.1f ms/step, %d-step "
+                    "window)", h.rank, h.step_time_mean * 1e3, h.score,
+                    h.cause, h.data_wait_mean * 1e3, h.steps)
+        return report
+
+    @staticmethod
+    def _timeline_marker(h: RankHealth) -> None:
+        # A zero-length profiler span: shows up as a named marker on the
+        # XProf host timeline next to the window it describes.
+        try:
+            from ..utils.profiler import op_range
+            with op_range(f"hvd.straggler.rank{h.rank}"
+                          f"#score={h.score:.2f},cause={h.cause}"):
+                pass
+        except Exception:  # noqa: BLE001 — observability never breaks
+            pass
+
+    def last_report(self) -> List[RankHealth]:
+        with self._lock:
+            return list(self._last_report)
+
+    def blacklist_hint(self) -> List[int]:
+        """Ranks flagged in >= ``patience`` consecutive evaluations —
+        the hint surface the elastic driver's ``health_hook`` consumes
+        (mapped rank→hostname by the caller, which knows the slot
+        assignment)."""
+        with self._lock:
+            return sorted(r for r, n in self._consecutive.items()
+                          if n >= self.patience)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._consecutive.clear()
+            self._last_report = []
+
+
+_detector: Optional[StragglerDetector] = None
+_detector_lock = threading.Lock()
+
+
+def detector() -> StragglerDetector:
+    """Process-global detector (thresholds frozen at first use)."""
+    global _detector
+    with _detector_lock:
+        if _detector is None:
+            _detector = StragglerDetector()
+        return _detector
+
+
+def straggler_report() -> List[RankHealth]:
+    return detector().last_report()
+
+
+def blacklist_hint() -> List[int]:
+    return detector().blacklist_hint()

@@ -178,7 +178,9 @@ def _mlm_loss(cfg: BertConfig, params, hidden, labels):
     largest matmul in the model off the MXU fast path and materialized a
     (B,S,V) fp32 tensor, 4 GB at batch 64/seq 512); the softmax
     normalizer is accumulated in fp32 via logsumexp, with the upcast
-    fused into the reduction so no fp32 copy of the logits lands in HBM.
+    fused into the reduction so no fp32 copy of the logits lands in HBM,
+    and the picked logit is recomputed with fp32 accumulation so the
+    per-position CE never sees a bf16-rounded value.
     For pretraining-shaped workloads prefer `_mlm_loss_gathered`, which
     only projects the ~15% masked positions (real-BERT
     max_predictions_per_seq semantics)."""
@@ -187,9 +189,18 @@ def _mlm_loss(cfg: BertConfig, params, hidden, labels):
     logits = logits + params["mlm_bias"].astype(h.dtype)
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     safe_labels = jnp.maximum(labels, 0)
-    picked = jnp.take_along_axis(
-        logits, safe_labels[..., None], axis=-1)[..., 0]
-    ll = picked.astype(jnp.float32) - lse
+    # The picked logit is recomputed as a per-position dot with fp32
+    # accumulation instead of gathered from the bf16 logits tensor: the
+    # big einsum rounds every logit to bf16 (8 mantissa bits), and for
+    # the ONE logit that enters the CE directly that rounding lands 1:1
+    # in the per-position loss — upcasting after the gather cannot
+    # recover it.  Cost: a (B,S,d) elementwise dot, ~1/V of the vocab
+    # projection.
+    w = jnp.take(params["embed"], safe_labels, axis=0).astype(h.dtype)
+    picked = jnp.einsum("bsd,bsd->bs", h, w,
+                        preferred_element_type=jnp.float32)
+    picked = picked + params["mlm_bias"][safe_labels].astype(jnp.float32)
+    ll = picked - lse
     mask = (labels != IGNORE_INDEX).astype(jnp.float32)
     return -(ll * mask).sum(), mask.sum()
 

@@ -31,10 +31,12 @@ class ResNetConfig(NamedTuple):
     sync_bn_axis: Optional[str] = None   # mesh axis for cross-replica BN
     bn_momentum: float = 0.9
     # Compute the 7x7/s2 stem as a 4x4/s1 conv over a 2x2 space-to-depth
-    # transform of the input (3 -> 12 channels): bit-identical math, but
-    # the MXU sees a dense 12-channel contraction at half the spatial
-    # size instead of a 3-channel one padded 42x to the lane width — the
-    # standard TPU ResNet stem formulation (MLPerf conv0 space-to-depth).
+    # transform of the input (3 -> 12 channels): mathematically
+    # equivalent (exact-arithmetic equal; float rounding differs, the
+    # test compares at rtol 1e-4), and the MXU sees a dense 12-channel
+    # contraction at half the spatial size instead of a 3-channel one
+    # padded 42x to the lane width — the standard TPU ResNet stem
+    # formulation (MLPerf conv0 space-to-depth).
     stem_s2d: bool = False
 
 

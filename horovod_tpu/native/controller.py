@@ -183,6 +183,16 @@ class NativeController:
             cfg.timeline_filename.encode(), cfg.cache_capacity)
         if rc != 0:
             raise NativeError(self._last_error())
+        # Metric children cached on the instance: _wait runs per eager
+        # op, the registry lookup must not.
+        from ..metrics.registry import registry as _metrics_registry
+        _mreg = _metrics_registry()
+        self._m_ops = _mreg.counter(
+            "hvd_native_ops_total",
+            "Completed native-runtime eager operations")
+        self._m_fused = _mreg.gauge(
+            "hvd_native_last_fused_names",
+            "Names in the most recent fused allreduce Response")
         # Node topology for hierarchical collectives (from the launcher's
         # env contract; reference HOROVOD_HIERARCHICAL_ALLREDUCE knob).
         local_size = int(_config.get_env("LOCAL_SIZE", "1") or 1)
@@ -273,6 +283,8 @@ class NativeController:
             err = self._last_error()
             self._lib.hvd_native_release(handle)
             raise NativeError(err)
+        self._m_ops.inc()
+        self._m_fused.set(self._lib.hvd_native_last_fused_names())
         self._autotune_tick()
 
     def _autotune_tick(self):

@@ -18,7 +18,9 @@ Reduce-op codes match the reference C API (operations.cc:911-913).
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -44,13 +46,49 @@ Max = ReduceOp(4)
 Product = ReduceOp(5)
 
 
+# Per-kind metric children cached after first use (the registry lookup
+# costs a lock + dict walk; the cached child is a straight attribute).
+_coll_metrics = {}
+
+
+def _collective_metrics(kind: str):
+    rec = _coll_metrics.get(kind)
+    if rec is None:
+        from ..metrics.registry import (DEFAULT_TIME_BUCKETS, registry)
+        reg = registry()
+        rec = (
+            reg.counter("hvd_collective_ops_total",
+                        "Eager collective operations", kind=kind),
+            reg.counter("hvd_collective_bytes_total",
+                        "Eager collective payload bytes", kind=kind),
+            reg.histogram("hvd_collective_latency_seconds",
+                          "Eager collective wall time (enqueue to "
+                          "result)", buckets=DEFAULT_TIME_BUCKETS,
+                          kind=kind),
+        )
+        _coll_metrics[kind] = rec
+    return rec
+
+
+@contextlib.contextmanager
 def _op_range(kind: str, name, tensor):
-    """Profiler span around an eager collective (NVTX-range analog,
-    utils/profiler.py); payload size mirrors the reference's grouped-bytes
-    annotation (operations.cc:1018-1033)."""
+    """Profiler span + metrics around an eager collective (NVTX-range
+    analog, utils/profiler.py); payload size mirrors the reference's
+    grouped-bytes annotation (operations.cc:1018-1033).  The same span
+    feeds ``hvd_collective_{ops,bytes}_total`` and the latency histogram
+    in the ``hvd.metrics`` registry."""
     from ..utils.profiler import op_range
     nbytes = getattr(tensor, "nbytes", None)
-    return op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes)
+    ops, bts, lat = _collective_metrics(kind)
+    t0 = time.perf_counter()
+    try:
+        with op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes):
+            yield
+    finally:
+        ops.inc()
+        if nbytes:
+            bts.inc(float(nbytes))
+        lat.observe(time.perf_counter() - t0)
 
 
 def _is_tracer(tensor) -> bool:

@@ -202,6 +202,24 @@ def _negotiated_executor(ctl):
     cache_cap = int(os.environ.get("HVD_TPU_DEVICE_EXEC_CACHE", "256"))
     ctl._device_exec_cache = cache
     ctl._device_exec_cache_hits = 0
+    # Response-signature cache hit rate + fusion batch size feed the
+    # metrics registry: fusion efficiency and negotiation amortization
+    # are exactly the continuously-collected numbers systematic
+    # bottleneck analysis needs (arXiv:1810.11112).
+    from ..metrics.registry import registry as _metrics_registry
+    _mreg = _metrics_registry()
+    _m_hits = _mreg.counter("hvd_response_cache_hits_total",
+                            "Device-plane response-signature cache hits")
+    _m_misses = _mreg.counter(
+        "hvd_response_cache_misses_total",
+        "Device-plane response-signature cache misses (compiles)")
+    _m_fused = _mreg.histogram(
+        "hvd_fusion_batch_names",
+        "Tensors per negotiated device-plane Response (fusion batch)",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+    _m_staged = _mreg.counter(
+        "hvd_device_plane_bytes_total",
+        "Payload bytes executed on the negotiated device plane")
 
     def _build(rtype, sizes, present, shapes, np_dtype, op, root,
                prescale, postscale, mesh):
@@ -410,9 +428,15 @@ def _negotiated_executor(ctl):
             cache[key] = run
             while len(cache) > cache_cap:
                 cache.popitem(last=False)
+            _m_misses.inc()
         else:
             cache.move_to_end(key)
             ctl._device_exec_cache_hits += 1
+            _m_hits.inc()
+        _m_fused.observe(len(names))
+        if rtype in (0, 2):
+            _m_staged.inc(float(sum(sizes)) *
+                          np.dtype(np_dtype).itemsize)
         outs = run(*(inputs[nm] for nm in pres_names))
         if rtype in (0, 2):
             return dict(zip(pres_names, outs))

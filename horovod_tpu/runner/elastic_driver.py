@@ -88,8 +88,16 @@ class ElasticDriver:
                  iface: Optional[str] = None,
                  ssh_identity_file: Optional[str] = None,
                  output_dir: Optional[str] = None,
-                 prefix_timestamp: bool = False):
+                 prefix_timestamp: bool = False,
+                 health_hook=None):
         self._discovery = discovery
+        # Optional straggler-health hint (hvd.metrics): a callable
+        # returning hostnames to keep out of new rounds — a SOFT
+        # blacklist re-evaluated each discovery, unlike the hard
+        # failure blacklist.  Typical wiring: a sidecar maps
+        # hvd.metrics.blacklist_hint() ranks to hostnames via the
+        # round's slot assignment and feeds them here.
+        self._health_hook = health_hook
         self._command = command
         self._platform_policy = platform_policy
         self._min_np = min_np
@@ -140,6 +148,18 @@ class ElasticDriver:
         self._succeeded = False  # any worker exited 0: job is completing
         self._result: Optional[int] = None
         self._result_cv = threading.Condition()
+
+    @staticmethod
+    def _metric(name: str, help: str, **labels):
+        """Driver-side counters/gauges (the driver process has its own
+        registry; serve it with hvd.metrics.serve() for scraping)."""
+        from ..metrics.registry import registry
+        return registry().counter(name, help, **labels)
+
+    @staticmethod
+    def _gauge(name: str, help: str):
+        from ..metrics.registry import registry
+        return registry().gauge(name, help)
 
     # -- public ------------------------------------------------------------
 
@@ -197,6 +217,27 @@ class ElasticDriver:
     def _discover_filtered(self) -> List[HostInfo]:
         hosts = self._discovery.find_available_hosts_and_slots()
         hosts = [h for h in hosts if h.hostname not in self._blacklist]
+        if self._health_hook is not None:
+            try:
+                hinted = set(self._health_hook() or ())
+            except Exception as e:  # noqa: BLE001 — a hint, not an oracle
+                if self._verbose:
+                    print(f"[elastic] health hook error (ignored): {e}")
+                hinted = set()
+            if hinted:
+                kept = [h for h in hosts if h.hostname not in hinted]
+                # Never hint the job below min-np: a flaky detector must
+                # not be able to starve the world a hard failure would.
+                if sum(h.slots for h in kept) >= self._min_np:
+                    dropped = [h.hostname for h in hosts
+                               if h.hostname in hinted]
+                    if dropped and self._verbose:
+                        print(f"[elastic] health hint excludes "
+                              f"{','.join(dropped)} from this round")
+                    self._metric("hvd_elastic_health_exclusions_total",
+                                 "Hosts excluded by the health "
+                                 "hint").inc(len(hosts) - len(kept))
+                    hosts = kept
         if self._max_np is not None:
             # Trim to max_np slots.
             out, total = [], 0
@@ -230,6 +271,14 @@ class ElasticDriver:
     def _start_round(self, hosts: List[HostInfo]):
         with self._lock:
             self._round += 1
+            self._metric("hvd_elastic_rounds_total",
+                         "Rendezvous rounds published").inc()
+            self._gauge("hvd_elastic_world_slots",
+                        "Slots in the current round").set(
+                sum(h.slots for h in hosts))
+            self._gauge("hvd_elastic_blacklisted_hosts",
+                        "Hosts on the hard blacklist").set(
+                len(self._blacklist))
             self._current_hosts = hosts
             np_ = sum(h.slots for h in hosts)
             slots = get_host_assignments(hosts, np_)
@@ -408,6 +457,8 @@ class ElasticDriver:
             # cascade and never trip blacklist/min-np).
             self._last_failure_ts = now
             self._blacklist.add(slot.hostname)
+            self._metric("hvd_elastic_worker_failures_total",
+                         "Worker failures that blacklisted a host").inc()
             if self._verbose:
                 print(f"[elastic] worker {sid} failed (exit {code}); "
                       f"blacklisting {slot.hostname}")

@@ -125,12 +125,17 @@ class _KVServer(ThreadingHTTPServer):
             self._store.pop((scope, key), None)
 
 
-class RendezvousServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 secret: Optional[str] = None):
-        self._server = _KVServer((host, port), secret=secret)
+class BackgroundHTTPServer:
+    """A ``ThreadingHTTPServer`` driven from a daemon thread — the shared
+    serving scaffold of the rendezvous KV server and the metrics
+    subsystem's Prometheus endpoint (``horovod_tpu/metrics/exporters.py``).
+    Subclasses construct ``self._server`` before calling ``start()``."""
+
+    _server: ThreadingHTTPServer
+
+    def __init__(self, server: ThreadingHTTPServer):
+        self._server = server
         self._thread: Optional[threading.Thread] = None
-        self.secret = secret
 
     @property
     def port(self) -> int:
@@ -142,16 +147,26 @@ class RendezvousServer:
         self._thread.start()
         return self.port
 
+    def stop(self):
+        # shutdown() blocks on serve_forever's exit handshake — calling
+        # it on a server that was never start()ed would wait forever.
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+        self._server.server_close()
+
+
+class RendezvousServer(BackgroundHTTPServer):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 secret: Optional[str] = None):
+        super().__init__(_KVServer((host, port), secret=secret))
+        self.secret = secret
+
     def put(self, scope: str, key: str, value: bytes):
         self._server.store_put(scope, key, value)
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         return self._server.store_get(scope, key)
-
-    def stop(self):
-        self._server.shutdown()
-        if self._thread:
-            self._thread.join(timeout=5)
 
 
 def http_get(addr: str, scope: str, key: str, timeout: float = 5.0,

@@ -60,8 +60,34 @@ class _WaitSpan:
     seconds: float = 0.0
 
 
-_data_wait_lock = threading.Lock()
-_data_wait_stats = {"count": 0, "total_s": 0.0, "last_s": 0.0}
+_dw_metrics = None
+_dw_lock = threading.Lock()
+
+
+def _data_wait_metrics():
+    """The registry-backed storage of the data-wait stats (the private
+    module dict this module used to keep now lives in ``hvd.metrics``,
+    so the cross-rank aggregation and the Prometheus surface see the
+    same numbers ``data_wait_stats()`` reports)."""
+    global _dw_metrics
+    if _dw_metrics is None:
+        with _dw_lock:
+            if _dw_metrics is None:
+                from ..metrics.registry import (DEFAULT_TIME_BUCKETS,
+                                                registry)
+                reg = registry()
+                _dw_metrics = (
+                    reg.counter("hvd_data_wait_seconds_total",
+                                "Cumulative input-pipeline wait"),
+                    reg.counter("hvd_data_wait_spans_total",
+                                "Number of input-pipeline wait spans"),
+                    reg.gauge("hvd_data_wait_last_seconds",
+                              "Most recent input-pipeline wait"),
+                    reg.histogram("hvd_data_wait_seconds",
+                                  "Input-pipeline wait per span",
+                                  buckets=DEFAULT_TIME_BUCKETS),
+                )
+    return _dw_metrics
 
 
 @contextlib.contextmanager
@@ -70,8 +96,9 @@ def data_wait(name: str = "data_wait"):
 
     The span shows up on the profiler host timeline (same mechanism as
     ``op_range``) so an input-bound step is visually distinct from a
-    compute-bound one, and the duration feeds the module-level
-    ``data_wait_stats()`` counters the loader/bench report from.
+    compute-bound one, and the duration feeds the ``hvd_data_wait_*``
+    metrics in the ``hvd.metrics`` registry — the same counters
+    ``data_wait_stats()`` reports and the straggler detector reads.
     Yields a :class:`_WaitSpan` whose ``seconds`` is set on exit."""
     span = _WaitSpan()
     t0 = time.perf_counter()
@@ -80,24 +107,27 @@ def data_wait(name: str = "data_wait"):
             yield span
     finally:
         span.seconds = time.perf_counter() - t0
-        with _data_wait_lock:
-            _data_wait_stats["count"] += 1
-            _data_wait_stats["total_s"] += span.seconds
-            _data_wait_stats["last_s"] = span.seconds
+        total, count, last, hist = _data_wait_metrics()
+        total.inc(span.seconds)
+        count.inc()
+        last.set(span.seconds)
+        hist.observe(span.seconds)
 
 
 def data_wait_stats() -> dict:
     """Snapshot of cumulative data-wait spans: count / total_s / last_s
-    (+ derived mean_s).  Reset with :func:`reset_data_wait_stats`."""
-    with _data_wait_lock:
-        out = dict(_data_wait_stats)
+    (+ derived mean_s).  Backed by the ``hvd.metrics`` registry
+    (``hvd_data_wait_*``); reset with :func:`reset_data_wait_stats`."""
+    total, count, last, _hist = _data_wait_metrics()
+    out = {"count": int(count.value), "total_s": total.value,
+           "last_s": last.value}
     out["mean_s"] = out["total_s"] / out["count"] if out["count"] else 0.0
     return out
 
 
 def reset_data_wait_stats() -> None:
-    with _data_wait_lock:
-        _data_wait_stats.update(count=0, total_s=0.0, last_s=0.0)
+    for metric in _data_wait_metrics():
+        metric.reset()
 
 
 def start_trace(logdir: str) -> None:

@@ -484,3 +484,55 @@ def test_utils_checkpoint_delegates_sharded_pytrees(tmp_path):
     # immutable) rather than rewriting step 0 in place.
     utils_ckpt.save_checkpoint(path, state)
     assert ckpt.latest_step(path) == 5
+
+
+# ---------------------------------------------------------------------------
+# Per-run directory fingerprinting (PR 3 satellite, deferred from PR 1)
+# ---------------------------------------------------------------------------
+
+def test_run_fingerprint_stamped_and_resize_invariant(tmp_path):
+    """save_zero_state stamps a run fingerprint into the manifest; the
+    leaf-spec hash is world-size-invariant so elastic N->M restores of
+    the SAME run keep passing the cross-run guard."""
+    root = str(tmp_path / "z")
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    s4 = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    ckpt.save_zero_state(root, s4, step=0, mesh=mesh4)
+    manifest = ckpt.read_manifest(root, 0)
+    from horovod_tpu.checkpoint.manifest import RUN_FINGERPRINT_KEY
+    fp = manifest.extra[RUN_FINGERPRINT_KEY]
+    assert fp["world_size"] == 4
+    assert fp["mesh_shape"] == {"data": 4}
+    assert len(fp["leaf_spec_sha256"]) == 64
+    # Same run at world 2: restore passes AND a further save into the
+    # same directory passes (fingerprint is resize-invariant).
+    like2 = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    restored = ckpt.restore_zero_state(root, like2, mesh=mesh2)
+    ckpt.save_zero_state(root, restored, step=1, mesh=mesh2)
+    m2 = ckpt.read_manifest(root, 1)
+    assert (m2.extra[RUN_FINGERPRINT_KEY]["leaf_spec_sha256"]
+            == fp["leaf_spec_sha256"])
+
+
+def test_run_fingerprint_refuses_cross_run_restore(tmp_path, monkeypatch):
+    """A directory written by a different run (different param struct)
+    is refused at restore AND at save with a pointed error, unless
+    HVD_TPU_CKPT_ALLOW_FOREIGN=1."""
+    monkeypatch.delenv("HVD_TPU_CKPT_ALLOW_FOREIGN", raising=False)
+    root = str(tmp_path / "z")
+    mesh2 = _mesh(2)
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    s = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    ckpt.save_zero_state(root, s, step=0, mesh=mesh2)
+
+    other_params = {"w": jnp.ones((5, 2)), "extra": jnp.ones((7,))}
+    other = ckpt.zero_init(tx, other_params, mesh=mesh2)
+    with pytest.raises(ValueError, match="different run"):
+        ckpt.restore_zero_state(root, other, mesh=mesh2)
+    with pytest.raises(ValueError, match="different run"):
+        ckpt.save_zero_state(root, other, step=1, mesh=mesh2)
+    # Escape hatch: the env override downgrades the save refusal.
+    monkeypatch.setenv("HVD_TPU_CKPT_ALLOW_FOREIGN", "1")
+    ckpt.save_zero_state(root, other, step=1, mesh=mesh2)
+    assert ckpt.latest_step(root) == 1

@@ -1,0 +1,494 @@
+"""hvd.metrics tests: registry semantics, histogram buckets, Prometheus
+exposition golden, JSONL rotation, cross-rank aggregation and straggler
+scoring on synthetic skewed step times (ISSUE 3 acceptance criteria).
+
+The multi-rank paths are exercised with synthetic per-rank snapshots in
+one process — the same wire shape ``Aggregator.sync`` allgathers — so
+the detector sees exactly what a real 4-process fleet with one slowed
+rank would feed it, without multiprocess machinery in tier 1.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from horovod_tpu import metrics
+from horovod_tpu.metrics.aggregate import Aggregator
+from horovod_tpu.metrics.exporters import (JsonlSink, MetricsServer,
+                                           render_prometheus)
+from horovod_tpu.metrics.health import StragglerDetector
+from horovod_tpu.metrics.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total", "ops")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("temp")
+    g.set(4.0)
+    g.inc()
+    g.dec(2.0)
+    assert g.value == 3.0
+
+
+def test_get_or_create_returns_same_child_and_labels_split_series():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", kind="allreduce")
+    b = reg.counter("x_total", kind="allreduce")
+    c = reg.counter("x_total", kind="broadcast")
+    assert a is b and a is not c
+    a.inc(2)
+    c.inc(5)
+    flat = reg.scalars()
+    assert flat["x_total{kind=allreduce}"] == 2
+    assert flat["x_total{kind=broadcast}"] == 5
+
+
+def test_kind_conflict_and_invalid_names_raise():
+    reg = MetricsRegistry()
+    reg.counter("n_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("n_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("ok_total", **{"bad-label": "v"})
+    reg.histogram("h_s", buckets=(1.0, 2.0))
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h_s", buckets=(1.0, 5.0))
+
+
+def test_histogram_bucket_boundaries_le_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    # le semantics: a value equal to a bound lands IN that bucket.
+    assert h.cumulative_counts() == [2, 4, 5, 6]
+    assert h.count == 6
+    assert h.sum == pytest.approx(106.65)
+
+
+def test_registry_reset_keeps_cached_children_valid():
+    reg = MetricsRegistry()
+    c = reg.counter("r_total")
+    h = reg.histogram("r_s", buckets=(1.0,))
+    c.inc(3)
+    h.observe(0.5)
+    reg.reset()
+    assert c.value == 0
+    assert h.count == 0
+    c.inc()  # the same child object keeps recording after reset
+    assert reg.scalars()["r_total"] == 1
+
+
+def test_disable_knob_makes_recording_noop():
+    reg = MetricsRegistry()
+    c = reg.counter("d_total")
+    metrics.set_enabled(False)
+    try:
+        c.inc(5)
+        assert c.value == 0
+    finally:
+        metrics.set_enabled(True)
+    c.inc(2)
+    assert c.value == 2
+
+
+def test_concurrent_increments_are_not_lost():
+    reg = MetricsRegistry()
+    c = reg.counter("mt_total")
+    n, per = 4, 5000
+
+    def work():
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n * per
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (golden)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_format_golden():
+    reg = MetricsRegistry()
+    reg.counter("demo_ops_total", "Demo ops", kind="allreduce").inc(3)
+    reg.gauge("demo_temp", "Temp").set(1.5)
+    h = reg.histogram("demo_lat_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)
+    h.observe(2.0)
+    expected = (
+        "# HELP demo_lat_seconds Latency\n"
+        "# TYPE demo_lat_seconds histogram\n"
+        'demo_lat_seconds_bucket{le="0.1"} 0\n'
+        'demo_lat_seconds_bucket{le="1"} 2\n'
+        'demo_lat_seconds_bucket{le="+Inf"} 3\n'
+        "demo_lat_seconds_sum 2.75\n"
+        "demo_lat_seconds_count 3\n"
+        "# HELP demo_ops_total Demo ops\n"
+        "# TYPE demo_ops_total counter\n"
+        'demo_ops_total{kind="allreduce"} 3\n'
+        "# HELP demo_temp Temp\n"
+        "# TYPE demo_temp gauge\n"
+        "demo_temp 1.5\n"
+    )
+    assert render_prometheus(reg) == expected
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("esc_total", path='a"b\\c').inc()
+    out = render_prometheus(reg)
+    assert 'esc_total{path="a\\"b\\\\c"} 1' in out
+
+
+def test_metrics_http_endpoint_serves_exposition():
+    reg = MetricsRegistry()
+    reg.counter("served_total", "Served").inc(7)
+    server = MetricsServer(host="127.0.0.1", port=0, reg=reg)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "served_total 7" in body
+        assert "# TYPE served_total counter" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert resp.read() == b"ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink rotation
+# ---------------------------------------------------------------------------
+
+def test_jsonl_sink_rotates_and_lines_stay_parseable(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(path, max_bytes=200, backups=2)
+    for i in range(12):
+        sink.write({"step": i, "pad": "x" * 40})
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")  # backups=2 bounds the chain
+    steps = []
+    for p in (path + ".2", path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                steps.append(json.loads(line)["step"])
+    # No line was torn by rotation and order is preserved oldest→newest.
+    assert steps == sorted(steps)
+    assert steps[-1] == 11
+
+
+def test_jsonl_write_snapshot_carries_registry_scalars(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("snap_total").inc(4)
+    sink = JsonlSink(str(tmp_path / "s.jsonl"))
+    sink.write_snapshot(reg=reg, step=9)
+    with open(tmp_path / "s.jsonl", encoding="utf-8") as f:
+        rec = json.loads(f.read())
+    assert rec["step"] == 9
+    assert rec["metrics"]["snap_total"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Straggler scoring (synthetic multi-rank snapshots, one slowed rank)
+# ---------------------------------------------------------------------------
+
+def _fleet(step_means, wait_means, steps=20):
+    return [{"rank": r, "step_time_sum": m * steps, "step_count": steps,
+             "data_wait_sum": w * steps, "data_wait_count": steps}
+            for r, (m, w) in enumerate(zip(step_means, wait_means))]
+
+
+def test_straggler_detector_flags_artificially_slowed_rank():
+    det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=2)
+    # Ranks 0-2 step in 10 ms; rank 3 was slowed to 25 ms by its input
+    # pipeline (20 ms of data wait) — the acceptance shape.
+    report = det.evaluate(_fleet([0.010, 0.010, 0.010, 0.025],
+                                 [0.0, 0.0, 0.0, 0.020]), warn=False)
+    flagged = [h for h in report if h.flagged]
+    assert [h.rank for h in flagged] == [3]
+    assert flagged[0].score == pytest.approx(2.5)
+    assert flagged[0].cause == "input"
+    # Healthy ranks score ~1 and carry no cause.
+    assert all(h.cause == "" for h in report if not h.flagged)
+
+
+def test_straggler_compute_bound_attribution_and_noise_floor():
+    det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+    # Slow rank with negligible data wait → compute/comm-bound.
+    report = det.score_ranks(_fleet([0.010, 0.010, 0.010, 0.030],
+                                    [0.0, 0.0, 0.0, 0.001]))
+    assert report[3].flagged and report[3].cause == "compute"
+    # Microsecond-scale skew clears the ratio but not the noise floor.
+    report = det.score_ranks(_fleet([1e-5, 1e-5, 1e-5, 3e-5],
+                                    [0.0, 0.0, 0.0, 0.0]))
+    assert not any(h.flagged for h in report)
+    # Empty windows (a rank that recorded no steps) are never flagged.
+    fleet = _fleet([0.01, 0.01, 0.01], [0.0] * 3) + [
+        {"rank": 3, "step_time_sum": 0.0, "step_count": 0,
+         "data_wait_sum": 0.0}]
+    assert not any(h.flagged for h in det.score_ranks(fleet))
+
+
+def test_straggler_blacklist_hint_needs_consecutive_flags():
+    det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=2)
+    slow = _fleet([0.01, 0.01, 0.01, 0.05], [0.0] * 4)
+    healthy = _fleet([0.01] * 4, [0.0] * 4)
+    det.evaluate(slow, warn=False)
+    assert det.blacklist_hint() == []          # one window is not enough
+    det.evaluate(slow, warn=False)
+    assert det.blacklist_hint() == [3]         # two consecutive → hinted
+    det.evaluate(healthy, warn=False)
+    assert det.blacklist_hint() == []          # recovery clears the streak
+
+
+def test_straggler_rank_departure_clears_streak():
+    det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+    det.evaluate(_fleet([0.01, 0.01, 0.01, 0.05], [0.0] * 4), warn=False)
+    assert det.blacklist_hint() == [3]
+    # Rank 3 left the world (elastic scale-down): hint must not linger.
+    det.evaluate(_fleet([0.01, 0.01, 0.01], [0.0] * 3), warn=False)
+    assert det.blacklist_hint() == []
+
+
+def test_straggler_flags_surface_in_registry():
+    metrics.registry().reset()
+    det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+    det.evaluate(_fleet([0.01, 0.01, 0.01, 0.05], [0.0] * 4), warn=False)
+    flat = metrics.registry().scalars()
+    assert flat["hvd_straggler_ranks"] == 1
+    assert flat["hvd_straggler_flags_total{cause=compute,rank=3}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Aggregation: step accounting, cadence, fleet view
+# ---------------------------------------------------------------------------
+
+def _set_cadence(monkeypatch, n):
+    monkeypatch.setenv("HVD_TPU_METRICS_SYNC_STEPS", str(n))
+    from horovod_tpu.core.state import global_state
+    if global_state.initialized and global_state.config is not None:
+        monkeypatch.setattr(global_state.config, "metrics_sync_steps", n,
+                            raising=False)
+
+
+def test_aggregator_sync_cadence_and_fleet_view(monkeypatch):
+    _set_cadence(monkeypatch, 3)
+    agg = Aggregator()
+    assert agg.fleet() is None
+    for _ in range(3):
+        agg.step_end(0.01)
+    fleet = agg.fleet()
+    assert fleet is not None and len(fleet) == 1  # world of one
+    snap = fleet[0]
+    assert snap["step"] == 3
+    assert snap["step_count"] == 3
+    assert snap["step_time_sum"] == pytest.approx(0.03)
+    assert any(k.startswith("hvd_") for k in snap["scalars"])
+
+
+def test_aggregator_windows_are_deltas_not_lifetime(monkeypatch):
+    _set_cadence(monkeypatch, 0)
+    agg = Aggregator()
+    for _ in range(4):
+        agg.step_end(0.02)
+    agg.sync()
+    for _ in range(2):
+        agg.step_end(0.08)
+    snap = agg.local_snapshot()
+    # Only the two post-sync steps are in the window — one slow hour
+    # cannot hide inside a lifetime mean.
+    assert snap["step_count"] == 2
+    assert snap["step_time_sum"] == pytest.approx(0.16)
+
+
+def test_aggregator_derives_step_time_from_wall_clock(monkeypatch):
+    _set_cadence(monkeypatch, 0)
+    agg = Aggregator()
+    agg.step_end()          # first call: no interval yet
+    agg.step_end()          # second call: derived interval recorded
+    snap = agg.local_snapshot()
+    assert snap["step"] == 2
+    assert snap["step_count"] == 1
+    assert snap["step_time_sum"] >= 0.0
+
+
+def test_fleet_scalars_queryable_per_rank(monkeypatch):
+    _set_cadence(monkeypatch, 0)
+    agg = Aggregator()
+    agg.step_end(0.01)
+    agg.sync()
+    per_rank = agg.fleet_scalars()
+    assert set(per_rank) == {0}
+    assert per_rank[0].get("hvd_steps_total", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# data-wait migration (profiler → registry)
+# ---------------------------------------------------------------------------
+
+def test_data_wait_spans_land_in_registry():
+    from horovod_tpu.utils import profiler
+    profiler.reset_data_wait_stats()
+    with profiler.data_wait():
+        pass
+    with profiler.data_wait():
+        pass
+    flat = metrics.registry().scalars()
+    assert flat["hvd_data_wait_spans_total"] == 2
+    assert flat["hvd_data_wait_seconds_total"] >= 0.0
+    stats = profiler.data_wait_stats()
+    assert stats["count"] == 2
+    assert stats["total_s"] == pytest.approx(
+        flat["hvd_data_wait_seconds_total"])
+    profiler.reset_data_wait_stats()
+    assert profiler.data_wait_stats()["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Instrumented subsystems write the expected families
+# ---------------------------------------------------------------------------
+
+def test_eager_collectives_record_ops_bytes_latency():
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    metrics.registry().reset()
+    x = np.ones((16,), dtype=np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.broadcast(x, root_rank=0)
+    flat = metrics.registry().scalars()
+    assert flat["hvd_collective_ops_total{kind=allreduce}"] == 1
+    assert flat["hvd_collective_ops_total{kind=broadcast}"] == 1
+    assert flat["hvd_collective_bytes_total{kind=allreduce}"] == x.nbytes
+    assert flat["hvd_collective_latency_seconds_count"
+                "{kind=allreduce}"] == 1
+
+
+def test_checkpoint_engine_records_bytes_and_durations(tmp_path):
+    import numpy as np
+    from horovod_tpu import checkpoint as ckpt
+    metrics.registry().reset()
+    spec = ckpt.LeafSpec(path=".w", kind=ckpt.REPLICATED, shape=[3],
+                         dtype="float32", true_size=3)
+    vals = {0: [np.ones(3, np.float32)], 1: [np.ones(3, np.float32)]}
+    ckpt.save_leaves(str(tmp_path), 0, [spec], vals, 2)
+    ckpt.restore_leaves(str(tmp_path), 0, 2)
+    flat = metrics.registry().scalars()
+    assert flat["hvd_checkpoint_saves_total"] == 1
+    assert flat["hvd_checkpoint_restores_total"] == 1
+    assert flat["hvd_checkpoint_bytes_written_total"] > 0
+    assert flat["hvd_checkpoint_bytes_read_total"] > 0
+    assert flat["hvd_checkpoint_save_seconds_count"] == 1
+
+
+def test_elastic_driver_health_hook_soft_excludes_hosts():
+    from horovod_tpu.runner.elastic_driver import ElasticDriver, FixedHosts
+    from horovod_tpu.runner.hosts import HostInfo
+
+    hosts = [HostInfo("a", 2), HostInfo("b", 2), HostInfo("c", 2)]
+    hints = {"c"}
+    driver = ElasticDriver(FixedHosts(hosts), ["true"], min_np=2,
+                           max_np=None, health_hook=lambda: hints)
+    try:
+        got = [h.hostname for h in driver._discover_filtered()]
+        assert got == ["a", "b"]
+        # A hint can never push the world below min-np (unlike the hard
+        # blacklist): hinting every host keeps the full set.
+        hints = {"a", "b", "c"}
+        got = [h.hostname for h in driver._discover_filtered()]
+        assert got == ["a", "b", "c"]
+        # A crashing hook is ignored — it is a hint, not an oracle.
+        driver._health_hook = lambda: 1 / 0
+        got = [h.hostname for h in driver._discover_filtered()]
+        assert got == ["a", "b", "c"]
+    finally:
+        driver._rendezvous.stop()
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_window_deltas_survive_data_wait_reset(monkeypatch):
+    """A counter reset underneath the aggregator's window marks (e.g.
+    profiler.reset_data_wait_stats mid-window) must yield 'since the
+    reset', never a negative delta."""
+    from horovod_tpu.utils import profiler
+    _set_cadence(monkeypatch, 0)
+    agg = Aggregator()
+    profiler.reset_data_wait_stats()
+    with profiler.data_wait():
+        pass
+    agg.sync()                            # marks at current totals
+    profiler.reset_data_wait_stats()      # counter restarts under mark
+    with profiler.data_wait():
+        pass
+    snap = agg.local_snapshot()
+    assert snap["data_wait_count"] == 1
+    assert snap["data_wait_sum"] >= 0.0
+
+
+def test_elastic_reset_realigns_aggregator_cadence():
+    """The elastic world reset re-zeroes the aggregator's step counter
+    so survivors and fresh spawns agree on the sync-cadence schedule."""
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic import state as es
+    from horovod_tpu.metrics.aggregate import aggregator
+    hvd.init()
+    agg = aggregator()
+    agg.step_end(0.01)
+    agg.step_end(0.01)
+    assert agg._step >= 2
+    es._reset()
+    assert aggregator()._step == 0
+
+
+def test_init_survives_occupied_metrics_port(monkeypatch):
+    """A bind failure on HVD_TPU_METRICS_PORT degrades to a warning:
+    telemetry must never kill training."""
+    import socket
+    import horovod_tpu as hvd
+    from horovod_tpu.core import basics
+    metrics.stop_serving()                # force a real bind attempt
+    sock = socket.socket()
+    sock.bind(("0.0.0.0", 0))
+    port = sock.getsockname()[1]
+    monkeypatch.setenv("HVD_TPU_METRICS_PORT", str(port))
+    basics.shutdown()
+    try:
+        hvd.init()                        # must not raise
+        assert hvd.is_initialized()
+    finally:
+        sock.close()
+        monkeypatch.delenv("HVD_TPU_METRICS_PORT")
+        metrics.stop_serving()
+        basics.shutdown()
+        hvd.init()                        # restore the usual suite state
