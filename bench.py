@@ -1387,6 +1387,73 @@ def bench_metrics_overhead():
     })
 
 
+def bench_flight_overhead():
+    """Flight-recorder tax: steps/sec with the debug ring buffer
+    recording vs disabled, at the production per-step event shape — one
+    data-wait span, N collective enqueue/done pairs, plus the metrics
+    hooks those paths always run — around a simulated step cost (5 ms,
+    the metrics_overhead shape).  Both arms keep METRICS recording ON,
+    so the delta isolates the flight recorder itself.  The acceptance
+    bar is <1% steps/sec (``bar_pct``); like metrics_overhead,
+    ``hook_cost_us_per_step`` re-measures the delta without the sleep.
+    Select with `bench.py --bench flight_overhead`."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+    from horovod_tpu import debug
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.utils import profiler
+
+    step_ms = float(os.environ.get("BENCH_FLIGHT_STEP_MS", "5"))
+    steps = int(os.environ.get("BENCH_ITERS", "400"))
+    n_coll = int(os.environ.get("BENCH_FLIGHT_COLLECTIVES", "4"))
+    payload = np.ones((64, 1024), dtype=np.float32)  # 256 KB "gradient"
+
+    def one_step(sleep_s):
+        with profiler.data_wait():
+            pass
+        for _ in range(n_coll):
+            with C._op_range("allreduce", "grad", payload):
+                pass
+        if sleep_s:
+            time.sleep(sleep_s)
+
+    def run(enabled, sleep_s, n):
+        debug.set_enabled(enabled)
+        one_step(0)  # warm: metric children + ring buffer created
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one_step(sleep_s)
+        return time.perf_counter() - t0
+
+    try:
+        sleep_s = step_ms / 1e3
+        t_on = run(True, sleep_s, steps)
+        t_off = run(False, sleep_s, steps)
+        hooks_on = run(True, 0, steps * 20)
+        hooks_off = run(False, 0, steps * 20)
+    finally:
+        debug.set_enabled(True)
+    sps_on = steps / t_on
+    sps_off = steps / t_off
+    overhead_pct = max((1.0 - sps_on / sps_off) * 100.0, 0.0)
+    hook_us = max(hooks_on - hooks_off, 0.0) / (steps * 20) * 1e6
+    _emit({
+        "metric": "flight_recorder_overhead",
+        "value": round(overhead_pct, 3),
+        "unit": f"% steps/sec lost with the flight recorder on "
+                f"({2 * n_coll} ring events per {step_ms:g}ms step)",
+        # Baseline = the same step with the recorder disabled.
+        "vs_baseline": round(sps_on / sps_off, 4),
+        "steps_per_sec_recording": round(sps_on, 2),
+        "steps_per_sec_disabled": round(sps_off, 2),
+        "hook_cost_us_per_step": round(hook_us, 2),
+        "bar_pct": 1.0,
+        "within_bar": bool(overhead_pct < 1.0),
+        "ring_capacity": debug.recorder().capacity,
+        "steps": steps,
+    })
+
+
 def _tpu_transport_alive() -> bool:
     """The axon TPU tunnel (loopback relay) can die; when it does, any
     TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
@@ -1417,6 +1484,8 @@ def main():
         return bench_data()  # host-only; never touches the accelerator
     if mode == "metrics_overhead":
         return bench_metrics_overhead()  # host-only
+    if mode == "flight_overhead":
+        return bench_flight_overhead()  # host-only
     if mode == "eager":
         return bench_eager()  # never touches the accelerator
     if mode == "eager_sweep":

@@ -18,6 +18,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Hang forensics: a wedged test run must leave stack traces, not a bare
+# `timeout -k` kill.  PYTHONFAULTHANDLER makes fatal signals dump all
+# threads; tests/conftest.py additionally arms
+# faulthandler.dump_traceback_later just under each tier's budget
+# (HVD_TPU_CI_HANG_DUMP_S, seconds; 0 disables) so a silently-stuck
+# suite prints where every thread is before the watchdog kills it.
+export PYTHONFAULTHANDLER=1
+
 # Launcher-spawned autotune workers (tests/test_autotune.py writes and
 # execs autotune_worker.py scripts) can outlive an interrupted pytest:
 # VERDICT found four alive hours after a run.  Reap any that survive
@@ -32,7 +40,8 @@ trap cleanup_orphans EXIT INT TERM
 TIER_FAST=(
   test_basics.py test_bert.py test_checkpoint_engine.py test_chips.py
   test_ci_tiers.py
-  test_collectives.py test_data_pipeline.py test_flash_attention.py
+  test_collectives.py test_data_pipeline.py test_debug_flight.py
+  test_flash_attention.py
   test_launch_flags.py
   test_metrics.py
   test_optimizers.py test_parallel.py test_probe_rendezvous.py
@@ -60,12 +69,24 @@ TIER_SLOW=(
   test_tf_elastic.py
 )
 
+# Per-tier stack-dump deadline: just under the tier's wall budget (the
+# driver's tier-1 verify runs under `timeout -k 10 870`, so fast dumps
+# at 850 s; the longer tiers get ceilings matched to their budgets).
+hang_dump_s() {
+  case "$1" in
+    fast)   echo 850 ;;
+    matrix) echo 1800 ;;
+    *)      echo 3600 ;;
+  esac
+}
+
 run_tier() {
   local name="$1"; shift
   local files=()
   for f in "$@"; do files+=("tests/$f"); done
   echo "=== tier: ${name} ($# files) ==="
-  python -m pytest "${files[@]}" -q
+  HVD_TPU_CI_HANG_DUMP_S="${HVD_TPU_CI_HANG_DUMP_S:-$(hang_dump_s "$name")}" \
+    python -m pytest "${files[@]}" -q
 }
 
 case "${1:-all}" in

@@ -43,6 +43,7 @@ from .optimizers import (
 from .parallel import mesh as mesh_lib
 from . import checkpoint
 from . import data
+from . import debug
 from . import elastic
 from . import metrics
 
@@ -66,5 +67,5 @@ __all__ = [
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "checkpoint", "data", "elastic", "metrics",
+    "mesh_lib", "checkpoint", "data", "debug", "elastic", "metrics",
 ]

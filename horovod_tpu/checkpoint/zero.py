@@ -31,6 +31,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ..debug import flight as _flight
 from . import engine as E
 from . import manifest as M
 from . import reshard as R
@@ -348,6 +349,11 @@ def save_zero_state(root: str, state, step: int, mesh=None,
     if mesh is None:
         from ..core import basics
         mesh = basics.mesh()
+    # Flight recorder: a rank that stops submitting collectives while
+    # inside this call (shard writes, the commit barrier) attributes as
+    # checkpoint-bound in a hang report — the begin event with no commit
+    # after it is the signal.
+    _flight.record("checkpoint.save.begin", root, step=int(step))
     ax = _default_axis(axis_name)
     world = _axis_world(mesh, ax)
     plans, groups, _ = _plan_tree(state, world)
@@ -406,6 +412,7 @@ def save_zero_state(root: str, state, step: int, mesh=None,
         # elastic commit loop) can key decisions off `latest_step`
         # without racing the committer's manifest write.
         barrier()
+    _flight.record("checkpoint.save.commit", root, step=int(step))
     return manifest
 
 
@@ -433,6 +440,7 @@ def restore_zero_state(root: str, like, mesh=None,
         if step is None:
             raise FileNotFoundError(
                 f"no committed checkpoint step under {root}")
+    _flight.record("checkpoint.restore.begin", root, step=int(step))
     restored = E.restore_leaves(root, step, world)
     # Cross-run guard: the checkpoint's stamped fingerprint must match
     # the restore target's structure (world-size-invariant, so elastic
@@ -459,7 +467,9 @@ def restore_zero_state(root: str, like, mesh=None,
             new_leaves.append(restored.full_value(spec))
         else:
             new_leaves.append(jnp.asarray(restored.padded_full(spec)))
-    return _rebuild(groups, outer_def, new_leaves)
+    out = _rebuild(groups, outer_def, new_leaves)
+    _flight.record("checkpoint.restore.done", root, step=int(step))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -206,6 +206,44 @@ def init(mesh=None,
                     "without a scrape endpoint",
                     global_state.config.metrics_port, e)
 
+    # --- flight recorder / hang diagnosis ---------------------------------
+    # The recorder itself is always armed (ring-buffer appends are
+    # unmeasurable — bench.py --bench flight_overhead); what init() adds
+    # is the dump/triage plumbing: identity for dumps, the SIGUSR1
+    # trigger, the coordinator clock-offset estimate (piggybacked on the
+    # rendezvous channel every worker already polls), the per-rank debug
+    # endpoint + its KV-published address, and — on the coordinator rank
+    # of launcher-run jobs — the stall→hang-report escalation watchdog.
+    if not global_state.config.flight_disable:
+        from .. import debug as _debug
+        _debug.flight.set_identity(rank=global_state.rank,
+                                   world=global_state.size)
+        _debug.flight.record("init", None, rank=global_state.rank,
+                             size=global_state.size,
+                             round=global_state.elastic_round)
+        _debug.install_signal_handler()
+        _rdv = _os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+        if _rdv:
+            try:
+                _debug.estimate_clock_offset(_rdv, samples=3)
+            except Exception as e:  # noqa: BLE001 — telemetry never kills
+                log.debug("flight: clock-offset estimate failed: %r", e)
+        if global_state.controller is not None:
+            if _rdv:
+                try:
+                    _debug.serve_and_publish(
+                        rank=global_state.controller.rank(), rdv_addr=_rdv,
+                        port=global_state.config.flight_port)
+                except OSError as e:
+                    log.warning("flight: cannot serve debug endpoint "
+                                "(%s); continuing without one", e)
+            if global_state.config.flight_escalate and \
+                    global_state.controller.rank() == 0:
+                _debug.start_stall_watchdog(
+                    global_state.controller,
+                    report_dir=global_state.config.flight_dir,
+                    rdv_addr=_rdv)
+
     global_state.elastic_enabled = global_state.config.elastic
     global_state.initialized = True
     log.debug(
@@ -240,6 +278,16 @@ def _build_default_mesh(axes: Optional[Sequence[str]] = None):
 
 def shutdown() -> None:
     """Tear down the runtime (reference: horovod_shutdown, operations.cc)."""
+    # Stop the hang watchdog BEFORE the controller it polls goes away
+    # (its thread is named hvd-tpu-*, so a leak fails the test suite's
+    # stray-thread check).  The debug HTTP endpoint, like the metrics
+    # server, deliberately stays up across elastic resets.
+    try:
+        from .. import debug as _debug
+        _debug.stop_stall_watchdog()
+        _debug.flight.record("shutdown", None)
+    except Exception:  # noqa: BLE001 - best-effort teardown
+        pass
     if global_state.controller is not None:
         try:
             global_state.controller.shutdown()

@@ -45,6 +45,13 @@ METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
 METRICS_STRAGGLER_FACTOR = "METRICS_STRAGGLER_FACTOR"
 METRICS_STRAGGLER_MIN_SECONDS = "METRICS_STRAGGLER_MIN_SECONDS"
 METRICS_STRAGGLER_PATIENCE = "METRICS_STRAGGLER_PATIENCE"
+# Flight recorder / hang diagnosis (horovod_tpu/debug/).
+FLIGHT_DISABLE = "FLIGHT_DISABLE"              # recorder off entirely
+FLIGHT_CAPACITY = "FLIGHT_CAPACITY"            # ring-buffer events
+FLIGHT_DIR = "FLIGHT_DIR"                      # dumps + hang reports
+FLIGHT_PORT = "FLIGHT_PORT"                    # debug endpoint; 0 = ephemeral
+FLIGHT_LAST_EVENTS = "FLIGHT_LAST_EVENTS"      # events quoted per rank
+FLIGHT_ESCALATE = "FLIGHT_ESCALATE"            # stall -> hang report
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -123,6 +130,15 @@ class Config:
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
     metrics_port: int = 0
+    # Flight recorder: always-on ring buffer (cost is unmeasurable —
+    # bench.py --bench flight_overhead pins it under 1%); the stall →
+    # hang-report escalation runs wherever the native controller does.
+    flight_disable: bool = False
+    flight_capacity: int = 4096
+    flight_dir: str = "."
+    flight_port: int = 0
+    flight_last_events: int = 20
+    flight_escalate: bool = True
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -163,6 +179,14 @@ class Config:
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)
+        cfg.flight_disable = get_bool(FLIGHT_DISABLE, cfg.flight_disable)
+        cfg.flight_capacity = max(
+            1, get_int(FLIGHT_CAPACITY, cfg.flight_capacity))
+        cfg.flight_dir = get_env(FLIGHT_DIR, cfg.flight_dir) or "."
+        cfg.flight_port = get_int(FLIGHT_PORT, cfg.flight_port)
+        cfg.flight_last_events = max(
+            1, get_int(FLIGHT_LAST_EVENTS, cfg.flight_last_events))
+        cfg.flight_escalate = get_bool(FLIGHT_ESCALATE, cfg.flight_escalate)
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

@@ -34,6 +34,7 @@ import time
 from typing import Any, Callable, Iterator, Optional
 
 from ..core.exceptions import DataStallError
+from ..debug import flight as _flight
 from ..utils import logging as log
 from ..utils import profiler
 
@@ -177,6 +178,8 @@ class PrefetchIterator:
                     if not self._thread.is_alive() and self._q.empty():
                         # Producer died without posting an END/ERROR —
                         # only possible if it was killed abruptly.
+                        _flight.record("data.producer_dead", self._name,
+                                       waited_s=waited)
                         self.close()
                         raise DataStallError(
                             f"{self._name}: producer thread died without "
@@ -188,15 +191,24 @@ class PrefetchIterator:
                             "%s: input pipeline stalled — no batch for "
                             "%.0fs (source blocked or filesystem slow?)",
                             self._name, waited)
+                        _flight.record("data.stall_warning", self._name,
+                                       waited_s=waited)
                         from ..metrics.registry import registry
                         registry().counter(
                             "hvd_data_stall_warnings_total",
                             "Input-pipeline stall warnings").inc()
                     if 0 < self._stall_timeout_s <= waited:
+                        _flight.record("data.stall_timeout", self._name,
+                                       waited_s=waited)
                         self.close()
                         raise DataStallError(
                             f"{self._name}: no batch within the "
                             f"{self._stall_timeout_s:.0f}s stall window")
+        if waited:
+            # Slow-path only (the queue was empty for >= one 0.5 s poll):
+            # a run of data.wait events in the flight buffer is what the
+            # hang report's input-bound attribution keys on.
+            _flight.record("data.wait", self._name, waited_s=waited)
         if kind == _ERROR:
             self.close()
             raise payload

@@ -1,0 +1,63 @@
+"""``hvd.debug`` — post-mortem observability: flight recorder,
+distributed hang diagnosis and fleet-merged traces.
+
+The diagnosis half of observability (``hvd.metrics`` is the live half):
+
+* :mod:`~horovod_tpu.debug.flight` — per-rank ring buffer of structured
+  events from every subsystem that can block a step; dump via
+  :func:`dump`, SIGUSR1, or ``GET /debug/flight``.
+* :mod:`~horovod_tpu.debug.http` — ``/debug/flight`` + ``/debug/stacks``
+  endpoints on the shared BackgroundHTTPServer scaffold (also mounted on
+  the metrics server when one is running).
+* :mod:`~horovod_tpu.debug.hang` — coordinator watchdog that escalates a
+  native stall-inspector warning into ``hang_report_<step>.json`` naming
+  the stuck collective, the missing ranks, and each missing rank's last
+  flight events with an input/compute/checkpoint-bound attribution.
+* :mod:`~horovod_tpu.debug.merge` — ``python -m horovod_tpu.debug.merge``
+  merges per-rank dumps (+ the native Chrome timeline) into one
+  clock-aligned trace with a process row per rank.
+
+See docs/debugging.md for the worked hang-triage example.
+"""
+
+from . import flight
+from .flight import (FlightRecorder, dump, estimate_clock_offset,
+                     install_signal_handler, record, recorder, set_enabled,
+                     snapshot)
+
+
+def serve(port: int = 0, host: str = "0.0.0.0"):
+    """Start the per-rank debug HTTP endpoint (idempotent)."""
+    from . import http as _http
+    return _http.serve(port=port, host=host)
+
+
+def serve_and_publish(rank=None, rdv_addr=None, port: int = 0):
+    """Start the debug endpoint and publish its address to the
+    rendezvous KV for the coordinator's hang watchdog."""
+    from . import http as _http
+    return _http.serve_and_publish(rank=rank, rdv_addr=rdv_addr, port=port)
+
+
+def stop_serving():
+    from . import http as _http
+    _http.stop_serving()
+
+
+def start_stall_watchdog(controller, **kwargs):
+    """Start the coordinator-side hang-escalation watchdog."""
+    from . import hang as _hang
+    return _hang.start_stall_watchdog(controller, **kwargs)
+
+
+def stop_stall_watchdog():
+    from . import hang as _hang
+    _hang.stop_stall_watchdog()
+
+
+__all__ = [
+    "flight", "FlightRecorder", "record", "recorder", "snapshot", "dump",
+    "set_enabled", "install_signal_handler", "estimate_clock_offset",
+    "serve", "serve_and_publish", "stop_serving",
+    "start_stall_watchdog", "stop_stall_watchdog",
+]

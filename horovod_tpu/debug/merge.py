@@ -1,0 +1,206 @@
+"""Fleet trace merge: per-rank flight dumps + the native Chrome timeline
+→ one clock-aligned Chrome/Perfetto trace.
+
+::
+
+    python -m horovod_tpu.debug.merge -o merged.json \\
+        flight_rank0.json flight_rank1.json [--timeline timeline.json]
+
+Neither existing view shows the whole slice: the per-rank profiler sees
+one process, the coordinator timeline sees only negotiation.  The merge
+puts every rank on one time axis — a process row per rank (flight events
+on the ``flight`` thread, native timeline events on the ``native``
+thread of the recording coordinator's rows) — so a single
+``chrome://tracing`` / Perfetto load answers "who arrived late".
+
+Clock alignment:
+
+* Flight events carry wall timestamps plus each dump's coordinator
+  clock-offset estimate (``clock.offset_s``, from
+  :func:`horovod_tpu.debug.flight.estimate_clock_offset`): aligned
+  wall = ``t_wall - offset_s``.
+* The native timeline's timestamps are microseconds from the
+  coordinator's steady clock at ``Timeline::Start``.  The coordinator's
+  flight dump records the wall time of that start
+  (``meta.native_init_wall`` / ``meta.timeline_start_wall``), giving
+  the anchor; without one the timeline is left-aligned to the earliest
+  flight event and a ``merge.unanchored`` metadata arg says so.
+
+Completed collectives (``collective.done`` events with ``dur_s``) render
+as complete ("X") slices; everything else renders as instants — robust
+to interleaved async ops, where begin/end pairs would violate Chrome's
+per-thread stack nesting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+_TID_NATIVE = 0
+_TID_FLIGHT = 1
+
+
+def load_dump(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def load_timeline(path: str) -> List[dict]:
+    """Native Chrome timeline: tolerant of a truncated file (a process
+    that died mid-run leaves the JSON array unterminated)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        # Repair: drop a trailing partial line, close the array.
+        body = text.strip()
+        if body.endswith(","):
+            body = body[:-1]
+        if not body.endswith("]"):
+            body = body.rstrip(",\n ") + "\n]"
+        try:
+            obj = json.loads(body)
+        except ValueError:
+            lines = [ln.rstrip(",") for ln in text.splitlines()
+                     if ln.strip().startswith("{")]
+            obj = []
+            for ln in lines:
+                try:
+                    obj.append(json.loads(ln.rstrip(",")))
+                except ValueError:
+                    continue
+    if isinstance(obj, dict):
+        obj = obj.get("traceEvents", [])
+    return [e for e in obj if isinstance(e, dict)]
+
+
+def _aligned_wall(ev: dict, offset_s: float) -> float:
+    return float(ev["t_wall"]) - offset_s
+
+
+def merge_dumps(dumps: List[dict],
+                timeline_events: Optional[List[dict]] = None) -> dict:
+    """Pure merge: flight dumps (+ optional native timeline events) →
+    a Chrome trace object ``{"traceEvents": [...]}``."""
+    ranks: Dict[int, dict] = {}
+    for d in dumps:
+        r = d.get("rank")
+        r = int(r) if r is not None else len(ranks)
+        ranks[r] = d
+
+    # Global origin: earliest aligned flight wall time (the trace reads
+    # in relative microseconds, like the native timeline does).
+    starts = []
+    for r, d in ranks.items():
+        off = float(d.get("clock", {}).get("offset_s", 0.0))
+        for ev in d.get("events", []):
+            starts.append(_aligned_wall(ev, off))
+            break  # events are oldest-first: the first is the earliest
+    anchor_wall = None
+    coord = ranks.get(0)
+    if coord is not None:
+        meta = coord.get("meta", {})
+        raw = meta.get("timeline_start_wall", meta.get("native_init_wall"))
+        if raw is not None:
+            anchor_wall = float(raw) - float(
+                coord.get("clock", {}).get("offset_s", 0.0))
+            starts.append(anchor_wall)
+    base = min(starts) if starts else 0.0
+
+    out: List[dict] = []
+    for r in sorted(ranks):
+        d = ranks[r]
+        host = d.get("host", "")
+        out.append({"name": "process_name", "ph": "M", "pid": r,
+                    "tid": _TID_FLIGHT,
+                    "args": {"name": f"rank {r}"
+                             + (f" ({host})" if host else "")}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": r,
+                    "tid": _TID_FLIGHT, "args": {"sort_index": r}})
+        out.append({"name": "thread_name", "ph": "M", "pid": r,
+                    "tid": _TID_FLIGHT,
+                    "args": {"name": "flight recorder"}})
+        off = float(d.get("clock", {}).get("offset_s", 0.0))
+        for ev in d.get("events", []):
+            ts_us = round((_aligned_wall(ev, off) - base) * 1e6)
+            args = {k: v for k, v in ev.items()
+                    if k not in ("t_wall", "t_mono", "kind", "name")}
+            name = ev.get("name") or ev.get("kind", "event")
+            kind = ev.get("kind", "event")
+            dur_s = ev.get("dur_s")
+            if kind == "collective.done" and dur_s is not None:
+                out.append({"name": name, "cat": kind, "ph": "X",
+                            "ts": ts_us - round(float(dur_s) * 1e6),
+                            "dur": round(float(dur_s) * 1e6),
+                            "pid": r, "tid": _TID_FLIGHT, "args": args})
+            else:
+                out.append({"name": name, "cat": kind, "ph": "i",
+                            "ts": ts_us, "s": "t", "pid": r,
+                            "tid": _TID_FLIGHT, "args": args})
+
+    if timeline_events:
+        tl_min = min((float(e.get("ts", 0.0)) for e in timeline_events
+                      if e.get("ph") != "M"), default=0.0)
+        if anchor_wall is not None:
+            shift_us = (anchor_wall - base) * 1e6
+        else:
+            shift_us = -tl_min  # left-align: no anchor available
+        seen_tids = set()
+        for e in timeline_events:
+            if e.get("ph") == "M":
+                continue  # rank rows are re-labeled below
+            ev = dict(e)
+            pid = int(ev.get("pid", 0))
+            ev["pid"] = pid
+            ev["tid"] = _TID_NATIVE
+            ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us)
+            if anchor_wall is None:
+                ev.setdefault("args", {})
+                if isinstance(ev["args"], dict):
+                    ev["args"]["merge.unanchored"] = True
+            out.append(ev)
+            if pid not in seen_tids:
+                seen_tids.add(pid)
+                out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                            "tid": _TID_NATIVE,
+                            "args": {"name": "native runtime"}})
+                if pid not in ranks:
+                    out.append({"name": "process_name", "ph": "M",
+                                "pid": pid, "tid": _TID_NATIVE,
+                                "args": {"name": f"rank {pid}"}})
+
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.debug.merge",
+        description="Merge per-rank flight dumps (+ the native Chrome "
+                    "timeline) into one clock-aligned Chrome trace.")
+    p.add_argument("dumps", nargs="+",
+                   help="flight_rank<N>.json files (one per rank)")
+    p.add_argument("-o", "--output", default="merged_trace.json")
+    p.add_argument("--timeline", default=None,
+                   help="native Chrome timeline (HVD_TPU_TIMELINE file)")
+    args = p.parse_args(argv)
+
+    dumps = [load_dump(path) for path in args.dumps]
+    timeline = load_timeline(args.timeline) if args.timeline else None
+    trace = merge_dumps(dumps, timeline_events=timeline)
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    pids = sorted({e.get("pid") for e in trace["traceEvents"]})
+    sys.stderr.write(
+        f"merged {len(args.dumps)} flight dump(s)"
+        + (" + native timeline" if timeline else "")
+        + f" -> {args.output} ({len(trace['traceEvents'])} events, "
+        f"process rows for ranks {pids})\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

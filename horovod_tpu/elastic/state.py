@@ -28,6 +28,7 @@ import numpy as np
 
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..core.state import global_state
+from ..debug import flight as _flight
 from ..utils import logging as log
 
 
@@ -68,6 +69,8 @@ class State:
     def commit(self):
         self.save()
         self._sync_generation += 1
+        _flight.record("elastic.commit", None,
+                       generation=self._sync_generation)
         _elastic_counter("hvd_elastic_commits_total",
                          "Elastic state commits").inc()
         notification_manager.poll()
@@ -155,6 +158,7 @@ class ObjectState(State):
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self, root: Optional[int] = None):
+        _flight.record("elastic.sync", None, root=root)
         if self._saved_state:
             if root is None:
                 root = self.elect_sync_root()
@@ -381,6 +385,7 @@ class TpuState(ObjectState):
 
     def sync(self, root: Optional[int] = None):
         from ..optimizers import broadcast_parameters
+        _flight.record("elastic.sync", None, root=root)
         if root is None:
             root = self.elect_sync_root()
         # Membership changed: drop cached commit-step counters so every
@@ -487,6 +492,7 @@ def _reset():
     rendezvous round can change the world size (reference
     tensorflow/elastic.py:64-66 does shutdown()+init())."""
     from ..core import basics
+    _flight.record("elastic.reset", None)
     basics.shutdown()
     basics.init()
     # Re-zero the metrics aggregator's step counter: its sync cadence is
@@ -536,6 +542,7 @@ def run(func: Callable) -> Callable:
                 except HorovodInternalError:
                     log.warning("collective failure; restoring last "
                                 "committed state and re-initializing")
+                    _flight.record("elastic.restore", None, cause="failure")
                     _elastic_counter(
                         "hvd_elastic_resets_total",
                         "Elastic retry-loop resets by cause",

@@ -106,6 +106,32 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "2")
             self.end_headers()
             self.wfile.write(b"ok")
+        elif path in ("/debug/flight", "/debug/stacks"):
+            # The metrics port doubles as a debug surface: one scrape
+            # endpoint per host already exists, so the flight dump and
+            # all-thread stacks ride it instead of demanding a second
+            # port (debug/http.py serves the same handlers standalone —
+            # and the same HMAC gate applies on BOTH mounts, or setting
+            # the launch secret would protect one copy of the paths
+            # while this one stayed open).
+            from ..debug.http import (render_flight_json,
+                                      render_stacks_text,
+                                      request_authorized)
+            key = path.rsplit("/", 1)[1]
+            if not request_authorized(self.headers, key):
+                self.send_response(403)
+                self.end_headers()
+                return
+            if path == "/debug/flight":
+                body, ctype = render_flight_json(), "application/json"
+            else:
+                body, ctype = (render_stacks_text(),
+                               "text/plain; charset=utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_response(404)
             self.end_headers()

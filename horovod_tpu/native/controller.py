@@ -119,6 +119,9 @@ def load_library():
     lib.hvd_native_join.restype = ctypes.c_int
     lib.hvd_native_barrier.restype = ctypes.c_int
     lib.hvd_native_last_error.restype = ctypes.c_char_p
+    lib.hvd_native_stalled_json.restype = ctypes.c_int
+    lib.hvd_native_stalled_json.argtypes = [
+        ctypes.POINTER(ctypes.c_char), ctypes.c_int]
     lib.hvd_native_start_timeline.argtypes = [ctypes.c_char_p]
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
     lib.hvd_native_set_tuned_toggles.argtypes = [
@@ -175,6 +178,12 @@ class NativeController:
     def __init__(self, rank: int, size: int, coord_addr: str):
         self._lib = load_library()
         cfg = _config.Config.from_env()
+        # Timeline/merge anchor: the native runtime's steady-clock t0 is
+        # set inside hvd_native_init (Timeline::Start); bracketing the
+        # call and taking the midpoint bounds the anchor to half the
+        # init time (ms-scale — triage precision, not profiling).
+        import time as _time
+        _t0 = _time.time()
         rc = self._lib.hvd_native_init(
             rank, size, coord_addr.encode(),
             cfg.fusion_threshold_bytes, cfg.cycle_time_ms,
@@ -183,6 +192,11 @@ class NativeController:
             cfg.timeline_filename.encode(), cfg.cache_capacity)
         if rc != 0:
             raise NativeError(self._last_error())
+        from ..debug import flight as _flight
+        _flight.set_identity(rank=rank, world=size)
+        _flight.set_meta("native_init_wall", (_t0 + _time.time()) / 2.0)
+        _flight.record("native.attach", None, rank=rank, size=size,
+                       coord_addr=coord_addr)
         # Metric children cached on the instance: _wait runs per eager
         # op, the registry lookup must not.
         from ..metrics.registry import registry as _metrics_registry
@@ -282,6 +296,8 @@ class NativeController:
         if self._lib.hvd_native_wait(handle) != 0:
             err = self._last_error()
             self._lib.hvd_native_release(handle)
+            from ..debug import flight as _flight
+            _flight.record("collective.error", None, error=err[:256])
             raise NativeError(err)
         self._m_ops.inc()
         self._m_fused.set(self._lib.hvd_native_last_fused_names())
@@ -676,6 +692,24 @@ class NativeController:
         live evidence of the current fusion threshold (autotune)."""
         return self._lib.hvd_native_last_fused_names()
 
+    def stalled(self) -> list:
+        """Stall-inspector snapshot (coordinator only; [] elsewhere):
+        tensors past the warning window, each with ``name``, request
+        ``type``, ``age_s`` and the ``missing`` / ``submitted`` rank
+        lists — the evidence the hang-report escalation consumes
+        (debug/hang.py)."""
+        import json
+        n = self._lib.hvd_native_stalled_json(None, 0)
+        buf = ctypes.create_string_buffer(max(n + 1, 3))
+        self._lib.hvd_native_stalled_json(buf, len(buf))
+        try:
+            return json.loads(buf.value.decode() or "[]")
+        except ValueError:
+            # The table can change between the sizing and filling calls;
+            # a truncated fill parses as garbage exactly once — treat as
+            # "nothing stalled" and let the next poll see stable state.
+            return []
+
     def last_allgather_schedule(self) -> int:
         """0 = flat ring, 1 = hierarchical (chain fan-out),
         2 = hierarchical (CMA star fan-out) — most recent allgather."""
@@ -705,7 +739,12 @@ class NativeController:
         return self._lib.hvd_native_size()
 
     def start_timeline(self, filename: str):
+        import time as _time
+        t0 = _time.time()
         self._lib.hvd_native_start_timeline(filename.encode())
+        # Merge anchor for runtime-started timelines (debug/merge.py).
+        from ..debug import flight as _flight
+        _flight.set_meta("timeline_start_wall", (t0 + _time.time()) / 2.0)
 
     def stop_timeline(self):
         self._lib.hvd_native_stop_timeline()

@@ -244,6 +244,21 @@ void hvd_native_counters(int64_t* bytes, double* seconds) {
   Runtime::Get().ReadCounters(bytes, seconds);
 }
 
+// Stall-inspector snapshot for the Python-side hang-diagnosis watchdog:
+// fills buf with a JSON array of tensors past the warning window (name,
+// request type, age, missing + submitted rank lists).  Returns the number
+// of bytes written (truncated to cap-1), or the full length when buf is
+// NULL — call twice to size.  Coordinator-only; other ranks get "[]".
+int hvd_native_stalled_json(char* buf, int cap) {
+  std::string s = Runtime::Get().StalledJson();
+  int n = static_cast<int>(s.size());
+  if (!buf || cap <= 0) return n;
+  int c = n < cap - 1 ? n : cap - 1;
+  memcpy(buf, s.data(), c);
+  buf[c] = '\0';
+  return c;
+}
+
 void hvd_native_start_timeline(const char* filename) {
   Runtime::Get().StartTimeline(filename);
 }

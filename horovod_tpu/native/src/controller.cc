@@ -169,6 +169,12 @@ void Controller::AbsorbCacheHits(const std::vector<RequestList>& lists,
 }
 
 ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
+  // One lock for the whole round: table_/arrival_order_/joined_ mutate
+  // throughout, and StalledJson() (watchdog thread) must never observe a
+  // half-built round.  Rounds are short (validation + response building,
+  // no network I/O happens under Coordinate), so the watchdog's read
+  // waits at most one round.
+  std::lock_guard<std::mutex> table_lk(table_mu_);
   const int size = net_->size();
   ResponseList rl;
   // Snapshot the tuned toggles once per round so every response of the
@@ -363,10 +369,59 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
 void Controller::RecordReady(const std::string& name, int32_t rank) {
   // Per-rank NEGOTIATE ready instant — the reference timeline's #1
   // debugging feature: which rank is late for which tensor
-  // (timeline.cc:496-541).
+  // (timeline.cc:496-541).  pid = the reporting rank, so each rank's
+  // readiness renders on its own process row.
   if (timeline_ && timeline_->active())
     timeline_->Record(name, "i", "NEGOTIATE_READY",
-                      "{\"rank\":" + std::to_string(rank) + "}");
+                      "{\"rank\":" + std::to_string(rank) + "}", rank);
+}
+
+std::vector<int32_t> Controller::MissingRanks(const PendingTensor& pt) const {
+  std::vector<int32_t> missing;
+  for (int r = 0; r < net_->size(); ++r)
+    if (!pt.by_rank.count(r) && !joined_.count(r)) missing.push_back(r);
+  return missing;
+}
+
+namespace {
+std::string RankListStr(const std::vector<int32_t>& ranks) {
+  std::string s = "[";
+  for (size_t i = 0; i < ranks.size(); ++i)
+    s += (i ? "," : "") + std::to_string(ranks[i]);
+  return s + "]";
+}
+
+std::string JsonEscape(const std::string& in) {
+  std::string out;
+  for (char c : in) {
+    if (c == '"' || c == '\\') out += '\\';
+    if (static_cast<unsigned char>(c) < 0x20) { out += ' '; continue; }
+    out += c;
+  }
+  return out;
+}
+}  // namespace
+
+std::string Controller::StalledJson() {
+  std::lock_guard<std::mutex> lk(table_mu_);
+  auto now = std::chrono::steady_clock::now();
+  std::ostringstream os;
+  os << "[";
+  bool first = true;
+  for (const auto& [name, pt] : table_) {
+    double age = std::chrono::duration<double>(now - pt.first_report).count();
+    if (age <= cfg_.stall_warning_s) continue;
+    std::vector<int32_t> submitted;
+    for (const auto& [r, q] : pt.by_rank) submitted.push_back(r);
+    os << (first ? "" : ",") << "{\"name\":\"" << JsonEscape(name)
+       << "\",\"type\":" << static_cast<int>(pt.first.type)
+       << ",\"age_s\":" << age
+       << ",\"missing\":" << RankListStr(MissingRanks(pt))
+       << ",\"submitted\":" << RankListStr(submitted) << "}";
+    first = false;
+  }
+  os << "]";
+  return os.str();
 }
 
 void Controller::CheckStalls(ResponseList& rl) {
@@ -377,21 +432,21 @@ void Controller::CheckStalls(ResponseList& rl) {
       Response resp;
       resp.type = pt.first.type;
       resp.names = {name};
+      // The error every blocked rank sees must name the culprits, not
+      // just the tensor — rank lists are the actionable half of a stall
+      // post-mortem (which host to inspect / evict).
       resp.error = "stalled for " + std::to_string((int)age) +
-                   "s; missing ranks exceeded shutdown window";
+                   "s; missing rank(s) " + RankListStr(MissingRanks(pt)) +
+                   " never submitted within the shutdown window";
       rl.responses.push_back(resp);
       continue;
     }
     if (!pt.stall_warned && age > cfg_.stall_warning_s) {
       pt.stall_warned = true;
-      std::string missing;
-      for (int r = 0; r < net_->size(); ++r)
-        if (!pt.by_rank.count(r) && !joined_.count(r))
-          missing += (missing.empty() ? "" : ",") + std::to_string(r);
       fprintf(stderr,
               "[hvd_tpu coordinator] WARNING: tensor %s submitted by some "
-              "ranks but rank(s) [%s] have not yet (%.0fs); possible stall\n",
-              name.c_str(), missing.c_str(), age);
+              "ranks but rank(s) %s have not yet (%.0fs); possible stall\n",
+              name.c_str(), RankListStr(MissingRanks(pt)).c_str(), age);
     }
   }
   // Purge entries flagged as errors by the stall shutdown above.

@@ -16,6 +16,7 @@
 #include <chrono>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <set>
 #include <string>
 #include <vector>
@@ -63,6 +64,13 @@ class Controller {
   // Coordinator-side timeline: per-rank NEGOTIATE ready instants are
   // recorded as each rank's report arrives (reference timeline.cc:496-541).
   void set_timeline(Timeline* t) { timeline_ = t; }
+
+  // Stall-inspector snapshot for the flight-recorder escalation path
+  // (debug/hang.py): JSON array of tensors past the warning window, each
+  // naming the stuck collective, its age and the per-tensor missing /
+  // submitted rank lists.  Coordinator-only (other ranks see "[]").
+  // Thread-safe against the background loop's Coordinate().
+  std::string StalledJson();
   int64_t effective_fusion_threshold() const {
     int64_t dyn = fusion_threshold_.load();
     return dyn > 0 ? dyn : cfg_.fusion_threshold_bytes;
@@ -90,7 +98,13 @@ class Controller {
   std::atomic<bool> hier_allreduce_{false};
   std::atomic<bool> hier_allgather_{false};
   std::atomic<bool> cache_on_{true};
-  // Coordinator-only state (persists across rounds).
+  // Missing (non-joined, not-yet-reported) ranks for one pending tensor.
+  std::vector<int32_t> MissingRanks(const PendingTensor& pt) const;
+
+  // Coordinator-only state (persists across rounds).  table_mu_ lets
+  // StalledJson() — called from an application watchdog thread — read
+  // table_/joined_ while the background loop's Coordinate() mutates them.
+  std::mutex table_mu_;
   ResponseCache cache_;
   std::map<std::string, PendingTensor> table_;
   std::vector<std::string> arrival_order_;

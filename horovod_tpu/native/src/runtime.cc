@@ -31,7 +31,7 @@ Status Runtime::Init(int rank, int size, const std::string& coord_addr,
   fusion_threshold_ = fusion_threshold;
   cycle_time_ms_ = cycle_time_ms;
   if (!timeline_file.empty() && rank == 0)
-    timeline_.Start(timeline_file, rank);
+    timeline_.Start(timeline_file, rank, size);
   stop_ = false;
   shutdown_requested_ = false;
   loop_exited_ = false;
@@ -822,7 +822,13 @@ void Runtime::ReadCounters(int64_t* bytes, double* seconds) {
 }
 
 void Runtime::StartTimeline(const std::string& filename) {
-  timeline_.Start(filename, net_ ? net_->rank() : 0);
+  timeline_.Start(filename, net_ ? net_->rank() : 0,
+                  net_ ? net_->size() : 1);
+}
+
+std::string Runtime::StalledJson() {
+  if (!initialized_ || !controller_) return "[]";
+  return controller_->StalledJson();
 }
 
 void Runtime::StopTimeline() { timeline_.Stop(); }

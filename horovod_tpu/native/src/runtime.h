@@ -103,6 +103,9 @@ class Runtime {
   void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();
+  // Stall-inspector snapshot (controller::StalledJson); "[]" when not
+  // initialized or not the coordinator.
+  std::string StalledJson();
   // Test/observability hook: names in the most recent (possibly fused)
   // allreduce Response this rank executed — shows the live fusion
   // threshold's effect (autotune integration evidence).

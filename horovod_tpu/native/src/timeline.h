@@ -20,16 +20,23 @@ namespace hvdtpu {
 class Timeline {
  public:
   ~Timeline() { Stop(); }
-  void Start(const std::string& filename, int rank);
+  // size: communicator size — one process_name/process_sort_index
+  // metadata row is emitted per rank up front, so per-rank events (pid =
+  // rank) render as one labeled row per rank in chrome://tracing instead
+  // of interleaving on the recorder's pid.
+  void Start(const std::string& filename, int rank, int size = 1);
   void Stop();
   bool active() const { return active_; }
 
   // ph: "B" begin / "E" end / "i" instant. category groups rows.  args,
   // when non-empty, is a pre-rendered JSON object body (e.g. {"rank":2})
   // attached to the event — used for the per-rank NEGOTIATE ready instants
-  // (reference timeline.cc:496-541).
+  // (reference timeline.cc:496-541).  pid < 0 means "the recording
+  // rank"; events that belong to a specific rank (negotiate readiness)
+  // pass that rank so the trace attributes them to the right row.
   void Record(const std::string& name, const char* ph,
-              const std::string& category, const std::string& args = "");
+              const std::string& category, const std::string& args = "",
+              int pid = -1);
   void MarkCycle();
 
  private:
@@ -40,6 +47,7 @@ class Timeline {
     char ph;
     int64_t ts_us;
     std::string args;
+    int pid;
   };
   std::atomic<bool> active_{false};
   bool stop_requested_ = false;

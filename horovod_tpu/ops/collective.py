@@ -27,6 +27,7 @@ import numpy as np
 
 from ..core import handles as _handles
 from ..core.state import global_state, DATA_AXIS
+from ..debug import flight as _flight
 from . import eager as _eager
 from .adasum import adasum_allreduce, adasum_tree
 
@@ -80,6 +81,10 @@ def _op_range(kind: str, name, tensor):
     from ..utils.profiler import op_range
     nbytes = getattr(tensor, "nbytes", None)
     ops, bts, lat = _collective_metrics(kind)
+    # Flight recorder: the enqueue event is what a hang report quotes —
+    # an op stuck inside the yield never reaches the done event, so the
+    # dangling enqueue IS the evidence of where the rank blocked.
+    _flight.record("collective.enqueue", name, op=kind, bytes=nbytes)
     t0 = time.perf_counter()
     try:
         with op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes):
@@ -88,7 +93,9 @@ def _op_range(kind: str, name, tensor):
         ops.inc()
         if nbytes:
             bts.inc(float(nbytes))
-        lat.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        lat.observe(dt)
+        _flight.record("collective.done", name, op=kind, dur_s=dt)
 
 
 def _is_tracer(tensor) -> bool:

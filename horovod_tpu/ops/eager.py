@@ -35,6 +35,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..core.state import global_state
+from ..debug import flight as _flight
 
 
 def _np(tensor):
@@ -400,6 +401,13 @@ def _negotiated_executor(ctl):
     def impl(rtype, names, sizes, np_dtype, op, root, prescale, postscale,
              inputs):
         import jax
+        # Flight recorder: one event per negotiated Response, on the
+        # background thread — if the SPMD collective below never returns
+        # (a peer died inside XLA, where no stall inspector can see),
+        # this dangling negotiate.execute event names the fused batch
+        # that hung.
+        _flight.record("negotiate.execute", names[0] if names else None,
+                       rtype=rtype, n=len(names))
         mesh = _cached_process_mesh()
         if getattr(ctl, "_device_exec_mesh", None) is not mesh:
             # Elastic world rebuild: the cached programs bake in the old

@@ -23,10 +23,17 @@ import hmac as _hmac
 import os
 import secrets as _secrets
 import threading
+import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 _SIG_HEADER = "X-HVD-Signature"
+
+# Server wall clock for the flight recorder's coordinator clock-offset
+# estimate (debug/flight.estimate_clock_offset piggybacks NTP-style
+# samples on this channel).  Module-level indirection so tests can
+# inject a known skew.
+_now_wall = _time.time
 
 
 def generate_secret() -> str:
@@ -84,6 +91,16 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         if not self._verify("GET", scope, key):
             return self._reject()
+        if scope == "debug" and key == "time":
+            # Virtual key: the server's wall clock, sampled at handling
+            # time — the reference point every rank's clock-offset
+            # estimate aligns against (debug/flight.py).
+            body = repr(_now_wall()).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         value = self.server.store_get(scope, key)  # type: ignore[attr-defined]
         if value is None:
             self.send_response(404)

@@ -26,6 +26,24 @@ except Exception:
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Hang forensics.  A suite that wedges (a deadlocked subprocess test, a
+# stuck collective) used to die as a bare `timeout -k` kill with no
+# evidence.  Arm faulthandler's watchdog just under the tier-1 budget
+# (the driver's verify runs under `timeout -k 10 870`, so default 850 s):
+# if the run is still going then, every thread's stack is dumped to
+# stderr — the run keeps going (exit=False); only the external timeout
+# kills it, now with a post-mortem attached.  ci/run_test_tiers.sh sets
+# HVD_TPU_CI_HANG_DUMP_S per tier; 0 disables.
+# ---------------------------------------------------------------------------
+
+import faulthandler  # noqa: E402
+
+_HANG_DUMP_S = int(os.environ.get("HVD_TPU_CI_HANG_DUMP_S", "850") or 0)
+if _HANG_DUMP_S > 0:
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=False)
+
 
 @pytest.fixture(autouse=True)
 def _fresh_runtime():

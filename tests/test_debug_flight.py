@@ -1,0 +1,464 @@
+"""Flight recorder + hang diagnosis + fleet merge (horovod_tpu/debug/).
+
+Covers the whole post-mortem loop the observability tentpole promises:
+ring-buffer wrap/threading semantics, the SIGUSR1 and HTTP dump
+triggers, the rendezvous-piggybacked clock-offset estimate, the merge
+tool's alignment goldens, and — the acceptance scenario — a forced
+2-rank hang (one rank never submits, as in test_stall.py) producing a
+``hang_report_*.json`` that names the stuck collective, the missing
+rank, and that rank's last flight events with attribution."""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def fresh_recorder():
+    """An isolated recorder (module-level singleton untouched)."""
+    from horovod_tpu.debug.flight import FlightRecorder
+    return FlightRecorder(capacity=64, enabled=True)
+
+
+# ---------------------------------------------------------------------------
+# Ring-buffer semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_wraps_at_capacity(fresh_recorder):
+    r = fresh_recorder
+    for i in range(200):
+        r.record("k", f"ev{i}", i=i)
+    assert len(r) == 64
+    snap = r.snapshot()
+    # Oldest events dropped; newest retained, oldest-first order.
+    assert [e["i"] for e in snap] == list(range(136, 200))
+    assert snap[-1]["name"] == "ev199"
+    # Sequence numbers keep counting across the wrap.
+    seqs = [e["seq"] for e in snap]
+    assert seqs == sorted(seqs) and seqs[-1] == 199
+
+
+def test_ring_concurrent_writers(fresh_recorder):
+    r = fresh_recorder
+    n_threads, per_thread = 8, 500
+
+    def writer(t):
+        for i in range(per_thread):
+            r.record("w", f"t{t}.{i}")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = r.snapshot()
+    assert len(snap) == 64
+    # Seq strictly increasing — no torn/duplicated slots under contention.
+    seqs = [e["seq"] for e in snap]
+    assert seqs == sorted(set(seqs))
+    assert max(seqs) == n_threads * per_thread - 1
+
+
+def test_disabled_recorder_is_noop(fresh_recorder):
+    r = fresh_recorder
+    r.enabled = False
+    r.record("k", "x")
+    assert len(r) == 0
+
+
+def test_snapshot_last_n(fresh_recorder):
+    r = fresh_recorder
+    for i in range(10):
+        r.record("k", str(i))
+    assert [e["name"] for e in r.snapshot(last=3)] == ["7", "8", "9"]
+
+
+# ---------------------------------------------------------------------------
+# Dump triggers: API, SIGUSR1, HTTP
+# ---------------------------------------------------------------------------
+
+def test_dump_api_and_sigusr1(tmp_path):
+    import horovod_tpu as hvd
+    from horovod_tpu.debug import flight
+    hvd.debug.record("test.marker", "dump-me", detail=42)
+    path = hvd.debug.dump(str(tmp_path / "flight.json"))
+    d = json.load(open(path))
+    assert d["version"] == 1
+    kinds = [(e["kind"], e["name"]) for e in d["events"]]
+    assert ("test.marker", "dump-me") in kinds
+    ev = [e for e in d["events"] if e["kind"] == "test.marker"][-1]
+    assert ev["detail"] == 42 and "t_wall" in ev and "t_mono" in ev
+
+    # SIGUSR1 → dump lands in HVD_TPU_FLIGHT_DIR.
+    assert hvd.debug.install_signal_handler()
+    old = os.environ.get("HVD_TPU_FLIGHT_DIR")
+    os.environ["HVD_TPU_FLIGHT_DIR"] = str(tmp_path / "sig")
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        files = glob.glob(str(tmp_path / "sig" / "flight_rank*.json"))
+        assert files, "SIGUSR1 produced no flight dump"
+        d2 = json.load(open(files[0]))
+        assert any(e["kind"] == "test.marker" for e in d2["events"])
+    finally:
+        if old is None:
+            os.environ.pop("HVD_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["HVD_TPU_FLIGHT_DIR"] = old
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+        flight._signal_installed = False
+
+
+def test_http_debug_endpoints():
+    import urllib.request
+    import horovod_tpu as hvd
+    from horovod_tpu.debug import http as dhttp
+    hvd.debug.record("test.http", "served")
+    srv = dhttp.DebugServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/debug/flight", timeout=5) as r:
+            d = json.loads(r.read().decode())
+        assert any(e["kind"] == "test.http" for e in d["events"])
+        with urllib.request.urlopen(f"{base}/debug/stacks", timeout=5) as r:
+            stacks = r.read().decode()
+        # faulthandler names this very function's frame in the dump.
+        assert "test_http_debug_endpoints" in stacks
+        assert "Thread" in stacks  # all-threads dump
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.stop()
+
+
+def test_debug_endpoints_require_signature_with_secret(monkeypatch):
+    """With a launch secret set, unsigned dump requests are rejected and
+    the watchdog's signed fetch still works (the rendezvous HMAC scheme,
+    reused)."""
+    import urllib.error
+    import urllib.request
+    from horovod_tpu.debug import http as dhttp
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_SECRET", "s3cret")
+    srv = dhttp.DebugServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        addr = f"127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{addr}/debug/flight",
+                                   timeout=5)
+        assert ei.value.code == 403
+        d = dhttp.fetch_flight_dump(addr, timeout=5)  # signs the request
+        assert d is not None and "events" in d
+        # Liveness stays open (same as the metrics /healthz contract).
+        with urllib.request.urlopen(f"http://{addr}/healthz",
+                                    timeout=5) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.stop()
+
+
+def test_metrics_server_mounts_debug_endpoints():
+    """One port serves both surfaces: the Prometheus endpoint answers
+    /debug/flight too (satellite of the PR 3 scaffold reuse)."""
+    import urllib.request
+    import horovod_tpu as hvd
+    from horovod_tpu.metrics.exporters import MetricsServer
+    hvd.debug.record("test.viametrics", "x")
+    srv = MetricsServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/debug/flight", timeout=5) as r:
+            d = json.loads(r.read().decode())
+        assert any(e["kind"] == "test.viametrics" for e in d["events"])
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset estimate (rendezvous piggyback)
+# ---------------------------------------------------------------------------
+
+def test_clock_offset_golden(monkeypatch):
+    """A rendezvous server whose clock is skewed +2.5 s must yield an
+    offset estimate of about -2.5 s (local behind server ⇒ local - server
+    < 0), within loopback RTT noise."""
+    from horovod_tpu.runner import rendezvous as rdv
+    from horovod_tpu.debug.flight import FlightRecorder, estimate_clock_offset
+    from horovod_tpu.debug import flight as flight_mod
+    skew = 2.5
+    monkeypatch.setattr(rdv, "_now_wall", lambda: time.time() + skew)
+    srv = rdv.RendezvousServer(host="127.0.0.1", port=0)
+    srv.start()
+    # Isolate the module singleton the estimator writes into.
+    monkeypatch.setattr(flight_mod, "_recorder", FlightRecorder(enabled=True))
+    try:
+        est = estimate_clock_offset(f"127.0.0.1:{srv.port}", samples=4)
+        assert est is not None
+        assert abs(est["offset_s"] - (-skew)) < 0.25, est
+        assert est["rtt_s"] < 1.0
+        assert flight_mod.recorder().clock["method"] == "rendezvous"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Merge tool goldens
+# ---------------------------------------------------------------------------
+
+def _synthetic_dumps():
+    d0 = {"version": 1, "rank": 0, "world": 2, "host": "h0", "pid": 10,
+          "clock": {"offset_s": 0.0},
+          "meta": {"native_init_wall": 1000.0},
+          "events": [
+              {"seq": 0, "t_mono": 1.0, "t_wall": 1000.0,
+               "kind": "native.attach", "name": None},
+              {"seq": 1, "t_mono": 2.0, "t_wall": 1001.0,
+               "kind": "collective.done", "name": "g",
+               "op": "allreduce", "dur_s": 0.25}]}
+    d1 = {"version": 1, "rank": 1, "world": 2, "host": "h1", "pid": 11,
+          "clock": {"offset_s": 2.0},  # rank 1's clock runs 2 s ahead
+          "meta": {},
+          "events": [
+              {"seq": 0, "t_mono": 1.0, "t_wall": 1002.5,
+               "kind": "collective.enqueue", "name": "g",
+               "op": "allreduce"}]}
+    return d0, d1
+
+
+def test_merge_alignment_golden():
+    from horovod_tpu.debug.merge import merge_dumps
+    trace = merge_dumps(list(_synthetic_dumps()))
+    evs = trace["traceEvents"]
+    assert sorted({e["pid"] for e in evs}) == [0, 1]
+    # One labeled process row per rank.
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e["name"] == "process_name"}
+    assert names == {0: "rank 0 (h0)", 1: "rank 1 (h1)"}
+    # Clock alignment golden: rank 1's event at wall 1002.5 with offset
+    # +2.0 aligns to 1000.5 → 500000 µs after the base (rank 0 @ 1000.0).
+    enq = next(e for e in evs if e.get("cat") == "collective.enqueue")
+    assert enq["pid"] == 1 and enq["ts"] == 500_000
+    # Completed collective renders as an X slice ending at its done
+    # timestamp: 1001.0 → ts 750000, dur 250000.
+    x = next(e for e in evs if e["ph"] == "X")
+    assert (x["pid"], x["ts"], x["dur"]) == (0, 750_000, 250_000)
+
+
+def test_merge_cli_with_timeline(tmp_path):
+    from horovod_tpu.debug.merge import main
+    d0, d1 = _synthetic_dumps()
+    p0, p1 = tmp_path / "f0.json", tmp_path / "f1.json"
+    p0.write_text(json.dumps(d0))
+    p1.write_text(json.dumps(d1))
+    # Native timeline, TRUNCATED mid-write (the process died): the
+    # loader must repair it.  ts are µs from the coordinator's t0, whose
+    # wall anchor (1000.0) rank 0's dump records.
+    tl = tmp_path / "tl.json"
+    tl.write_text(
+        '[\n{"name":"process_name","ph":"M","pid":0,"tid":0,'
+        '"args":{"name":"rank 0"}},\n'
+        '{"name":"g","cat":"NEGOTIATE","ph":"B","ts":100,"pid":0,'
+        '"tid":0},\n'
+        '{"name":"g","cat":"NEGOTIATE_READY","ph":"i","ts":200,"pid":1,'
+        '"tid":0,"s":"g","args":{"rank":1}},\n')
+    out = tmp_path / "merged.json"
+    assert main([str(p0), str(p1), "--timeline", str(tl),
+                 "-o", str(out)]) == 0
+    trace = json.load(open(out))
+    evs = trace["traceEvents"]
+    assert sorted({e["pid"] for e in evs}) == [0, 1]
+    # Timeline events anchored at rank 0's recorded start wall: µs pass
+    # through unchanged (anchor == base here).
+    neg = next(e for e in evs if e.get("cat") == "NEGOTIATE")
+    assert neg["ts"] == 100 and neg["tid"] == 0
+    # The per-rank NEGOTIATE_READY instant lands on rank 1's row.
+    ready = next(e for e in evs if e.get("cat") == "NEGOTIATE_READY")
+    assert ready["pid"] == 1
+    # Distinct thread lanes: native (0) vs flight (1) on the same pid.
+    assert {e["tid"] for e in evs if e["pid"] == 0 and e["ph"] != "M"} \
+        == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# Attribution goldens
+# ---------------------------------------------------------------------------
+
+def test_attribution_golden():
+    from horovod_tpu.debug.hang import attribute
+    assert attribute([]).startswith("compute-bound")
+    assert attribute([
+        {"kind": "collective.done", "name": "a"},
+        {"kind": "data.wait", "name": "loader"},
+    ]) == "input-bound"
+    assert attribute([
+        {"kind": "data.wait", "name": "loader"},
+        {"kind": "collective.done", "name": "a"},
+    ]).startswith("compute-bound")
+    assert attribute([
+        {"kind": "checkpoint.save.begin", "name": "/ckpt"},
+    ]) == "checkpoint-bound"
+    assert attribute([
+        {"kind": "checkpoint.save.begin", "name": "/ckpt"},
+        {"kind": "checkpoint.save.commit", "name": "/ckpt"},
+        {"kind": "collective.enqueue", "name": "grad"},
+    ]) == "blocked-in-collective"
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: forced 2-rank hang → hang report
+# ---------------------------------------------------------------------------
+
+HANG_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.native.controller import NativeController, NativeError
+    from horovod_tpu import debug
+
+    rank = int(sys.argv[1])
+    ctl = NativeController(rank, 2, "127.0.0.1:" + sys.argv[2])
+    debug.serve_and_publish(rank=rank)
+    debug.estimate_clock_offset()
+    if rank == 0:
+        wd = debug.start_stall_watchdog(
+            ctl, report_dir=os.environ["REPORT_DIR"], interval_s=0.3)
+    out = ctl.allreduce(np.ones(4, np.float32), op=1, name="warmup")
+    assert float(out[0]) == 2.0
+    if rank == 0:
+        try:
+            ctl.allreduce(np.ones(4, np.float32), op=1, name="never")
+            print("UNEXPECTED-SUCCESS")
+        except NativeError as e:
+            assert "stall" in str(e).lower() and "[1]" in str(e), str(e)
+        deadline = time.time() + 10
+        import glob
+        reports = []
+        while time.time() < deadline and not reports:
+            reports = glob.glob(os.path.join(os.environ["REPORT_DIR"],
+                                             "hang_report_*.json"))
+            time.sleep(0.2)
+        debug.stop_stall_watchdog()
+        print("REPORTS", ";".join(reports))
+    else:
+        # Simulate the missing rank stuck waiting on its input pipeline.
+        debug.record("data.wait", "train_loader", waited_s=2.0)
+        time.sleep(6.0)  # never submit "never"
+        print("SAT-OUT", rank)
+    ctl.shutdown()
+""")
+
+
+@pytest.mark.timeout(120)
+def test_forced_hang_produces_hang_report(tmp_path):
+    """One rank never submits (the test_stall.py idiom); the coordinator
+    escalates the stall warning into a hang report naming the stuck
+    collective, the missing rank, and that rank's last flight events."""
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    srv = RendezvousServer(host="127.0.0.1", port=0)
+    srv.start()
+    report_dir = tmp_path / "reports"
+    report_dir.mkdir()
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", HVD_TPU_CYCLE_TIME="1",
+               HVD_TPU_RENDEZVOUS_ADDR=f"127.0.0.1:{srv.port}",
+               HOROVOD_STALL_CHECK_TIME_SECONDS="1",
+               HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="3",
+               REPORT_DIR=str(report_dir))
+    script = HANG_WORKER.format(repo=REPO)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", script, str(r), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for r in range(2)]
+        outs = [p.communicate(timeout=90) for p in procs]
+    finally:
+        srv.stop()
+    assert "REPORTS" in outs[0][0], (outs[0][0], outs[0][1])
+    assert "SAT-OUT 1" in outs[1][0], (outs[1][0], outs[1][1])
+    reports = glob.glob(str(report_dir / "hang_report_*.json"))
+    assert reports, (outs[0][0], outs[0][1])
+    rep = json.load(open(reports[0]))
+    # Names the stuck collective...
+    stalled = rep["stalled"]
+    assert any(s["name"] == "never" for s in stalled), rep
+    assert any(s["type_name"] == "allreduce" for s in stalled)
+    # ...the missing rank...
+    assert rep["missing_ranks"] == [1]
+    assert [s["missing"] for s in stalled
+            if s["name"] == "never"] == [[1]]
+    # ...and the missing rank's last events, fetched over the wire,
+    # with an input-bound attribution (it recorded a data.wait).
+    r1 = rep["ranks"]["1"]
+    assert r1["missing"] and r1["reachable"]
+    assert r1["attribution"] == "input-bound"
+    kinds = [e["kind"] for e in r1["last_events"]]
+    assert "data.wait" in kinds and "native.attach" in kinds
+    # The healthy coordinator is reported too, not missing.
+    assert rep["ranks"]["0"]["missing"] is False
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation smoke: the single-process eager path records events
+# ---------------------------------------------------------------------------
+
+def test_eager_collectives_record_flight_events():
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    before = len(hvd.debug.snapshot())
+    hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="fl.smoke")
+    snap = hvd.debug.snapshot()
+    assert len(snap) > before
+    mine = [e for e in snap if e.get("name") == "fl.smoke"]
+    kinds = [e["kind"] for e in mine]
+    assert "collective.enqueue" in kinds and "collective.done" in kinds
+    done = [e for e in mine if e["kind"] == "collective.done"][-1]
+    assert done["op"] == "allreduce" and done["dur_s"] >= 0
+    hvd.shutdown()
+
+
+def test_prefetch_stall_records_flight_events():
+    from horovod_tpu.data.prefetch import PrefetchIterator
+    from horovod_tpu.core.exceptions import DataStallError
+    from horovod_tpu.debug import flight
+
+    release = threading.Event()
+
+    def slow_source():
+        yield 1
+        release.wait(30)  # stalls until the test releases it
+        yield 2
+
+    it = PrefetchIterator(iter(slow_source()), depth=1,
+                          stall_warning_s=0.5, stall_timeout_s=1.0,
+                          name="flstall")
+    assert next(it) == 1
+    with pytest.raises(DataStallError):
+        next(it)
+    release.set()  # wake the producer so close() can join it
+    it.close()
+    kinds = [e["kind"] for e in flight.snapshot()
+             if e.get("name") == "flstall"]
+    assert "data.stall_warning" in kinds
+    assert "data.stall_timeout" in kinds

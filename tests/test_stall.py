@@ -53,6 +53,9 @@ SHUTDOWN_WORKER = textwrap.dedent("""
             print("UNEXPECTED-SUCCESS")
         except NativeError as e:
             assert "stall" in str(e).lower(), str(e)
+            # The shutdown error must NAME the culprits, not just the
+            # tensor: the missing-rank list is the actionable half.
+            assert "[1]" in str(e), str(e)
             print("STALL-ERROR", rank)
     else:
         time.sleep(4.0)  # never submit; let the coordinator give up
@@ -79,9 +82,11 @@ def test_stall_warning_emitted_then_recovers():
     for p in procs:
         assert p.returncode == 0
     assert "DONE 0" in outs[0][0] and "DONE 1" in outs[1][0]
-    # Coordinator (rank 0) warned about the straggler, naming the tensor.
+    # Coordinator (rank 0) warned about the straggler, naming the tensor
+    # AND the missing-rank list (which host to go look at).
     assert "stall" in outs[0][1].lower(), outs[0][1]
     assert "late" in outs[0][1]
+    assert "[1]" in outs[0][1], outs[0][1]
 
 
 @pytest.mark.timeout(120)

@@ -47,10 +47,21 @@ def test_timeline_chrome_trace(tmp_path):
     for e in ready:
         assert e["ph"] == "i"
         assert "rank" in e.get("args", {})
+        # Per-rank pid: each rank's readiness renders on its OWN process
+        # row (one row per rank) instead of interleaving on the
+        # recorder's pid — what debug/merge.py and raw chrome://tracing
+        # loads rely on.
+        assert e["pid"] == e["args"]["rank"]
+    assert {e["pid"] for e in ready} == {0, 1}
     for i in range(3):
         ranks = {e["args"]["rank"] for e in ready
                  if e["name"] == f"tl.{i}"}
         assert ranks == {0, 1}, f"tensor tl.{i} ready ranks {ranks}"
+    # process_name metadata labels every rank's row.
+    meta = [e for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {m["pid"] for m in meta} == {0, 1}
+    assert {m["args"]["name"] for m in meta} == {"rank 0", "rank 1"}
 
 
 RUNTIME_WORKER = textwrap.dedent("""
