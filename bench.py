@@ -1315,6 +1315,120 @@ def bench_data():
     })
 
 
+def bench_compression():
+    """Quantized collective engine: steps/sec + wire-bytes/step for
+    {fp32, bf16, int8, int4} gradient allreduce on the transformer grad
+    pytree (BENCH_COMPRESSION_* shape knobs), on an N-device virtual CPU
+    mesh.  Wire bytes are the per-pass payload of the two-pass schedule
+    (exact: quantized payload + one fp32 scale per block); the headline
+    is the int8 reduction vs fp32 — the acceptance bar is >=3.5x
+    (``bar_x``).  steps/sec on a CPU mesh measures the (de)quantize
+    compute tax, not the bandwidth win — on TPU the op is ICI-bound,
+    which is the regime the wire-byte column prices.  Select with
+    `bench.py --bench compression`."""
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    n = int(os.environ.get("BENCH_SCALING_DEVICES", "4"))
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.ops.quantization import QuantSpec, default_block, \
+        wire_bytes
+
+    hvd.init()
+    from horovod_tpu.core.state import DATA_AXIS
+    devices = jax.devices()[:n]
+    mesh = jax.sharding.Mesh(np.array(devices), (DATA_AXIS,))
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=int(os.environ.get("BENCH_COMPRESSION_VOCAB", "2048")),
+        d_model=int(os.environ.get("BENCH_COMPRESSION_DMODEL", "128")),
+        n_heads=4, d_ff=512,
+        n_layers=int(os.environ.get("BENCH_COMPRESSION_LAYERS", "2")),
+        seq_len=64, dtype=jnp.float32)
+    par = tfm.ParallelConfig(dp=n, pp=1, mp=1)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, par)
+    # The grad pytree IS the param pytree shape-wise; rank-distinct
+    # values so the reduction does real work.
+    leaves = jax.tree_util.tree_leaves(params)
+    n_elems = sum(x.size for x in leaves)
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    block = default_block()
+
+    def wire_per_step(fmt):
+        """One pass's payload bytes per rank for the whole pytree (the
+        two-pass schedule moves this twice; fp32 psum moves the fp32
+        bytes under the same convention)."""
+        if fmt == "fp32":
+            return 4 * n_elems
+        if fmt == "bf16":
+            return 2 * n_elems
+        spec = QuantSpec(8 if fmt == "int8" else 4, block)
+        return sum(wire_bytes(x.size, spec) for x in leaves)
+
+    from horovod_tpu.ops.compression import Compression
+    comps = {"fp32": None, "bf16": Compression.bf16,
+             "int8": Compression.int8, "int4": Compression.int4}
+    rows = []
+    for fmt, comp in comps.items():
+        def step(g):
+            out = hvd.allreduce_gradients(g, op=hvd.Average,
+                                          compression=comp)
+            # Scalar probe keeps the host readback O(1) per step.
+            return sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(out))
+
+        f = jax.jit(shard_map(step, mesh=mesh, in_specs=P(),
+                              out_specs=P(), check_vma=False))
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.ones_like(p) * 0.5, params)
+        _host_sync(f(grads))  # compile + first exec
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _host_sync(f(grads))
+        dt = time.perf_counter() - t0
+        rows.append({
+            "format": fmt,
+            "steps_per_sec": round(iters / dt, 2),
+            "wire_bytes_per_step": wire_per_step(fmt),
+            "reduction_vs_fp32": round(
+                wire_per_step("fp32") / wire_per_step(fmt), 3),
+        })
+        sys.stderr.write(
+            f"  {fmt}: {rows[-1]['steps_per_sec']} steps/s, "
+            f"{rows[-1]['wire_bytes_per_step']} wire B/step "
+            f"({rows[-1]['reduction_vs_fp32']}x)\n")
+
+    by_fmt = {r["format"]: r for r in rows}
+    int8_x = by_fmt["int8"]["reduction_vs_fp32"]
+    _emit({
+        "metric": "compression_wire_bytes_reduction",
+        "value": int8_x,
+        "unit": "x fewer wire bytes/step (int8 vs fp32, transformer "
+                "grad pytree)",
+        # Baseline = the 3.5x acceptance bar for the int8 wire.
+        "vs_baseline": round(int8_x / 3.5, 3),
+        "bar_x": 3.5,
+        "within_bar": bool(int8_x >= 3.5),
+        "int4_reduction": by_fmt["int4"]["reduction_vs_fp32"],
+        "grad_elems": n_elems,
+        "quant_block": block,
+        "devices": n,
+        "rows": rows,
+        "platform": jax.devices()[0].platform,
+    })
+
+
 def bench_metrics_overhead():
     """Telemetry tax: steps/sec with hvd.metrics recording enabled vs
     disabled (HVD_TPU_METRICS_DISABLE semantics), at the production
@@ -1484,6 +1598,8 @@ def main():
         return bench_data()  # host-only; never touches the accelerator
     if mode == "metrics_overhead":
         return bench_metrics_overhead()  # host-only
+    if mode == "compression":
+        return bench_compression()  # CPU mesh; never touches the chip
     if mode == "flight_overhead":
         return bench_flight_overhead()  # host-only
     if mode == "eager":

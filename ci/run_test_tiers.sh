@@ -45,6 +45,7 @@ TIER_FAST=(
   test_launch_flags.py
   test_metrics.py
   test_optimizers.py test_parallel.py test_probe_rendezvous.py
+  test_quantization.py
   test_resnet.py test_response_cache.py test_timeline.py
   test_transformer.py test_utils_ops.py
 )

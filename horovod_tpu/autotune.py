@@ -153,9 +153,17 @@ class ParameterManager:
     """
 
     # log2(bytes): 1 MB .. 256 MB; cycle: 0.5 .. 25 ms; three relaxed
-    # booleans {hierarchical_allreduce, hierarchical_allgather, cache}.
+    # booleans {hierarchical_allreduce, hierarchical_allgather, cache};
+    # one relaxed trinary (wire compression, rounded into thirds).
     BOUNDS = [(20.0, 28.0), (0.5, 25.0),
-              (0.0, 1.0), (0.0, 1.0), (0.0, 1.0)]
+              (0.0, 1.0), (0.0, 1.0), (0.0, 1.0), (0.0, 1.0)]
+
+    # Wire-format categorical (quantized collective engine): tuned like
+    # the boolean toggles, as a relaxed [0,1] dimension of the same GP
+    # rounded into thirds at application.  int4 is deliberately absent —
+    # without error feedback (an optimizer-state concern the runtime
+    # cannot provide) it trades too much gradient fidelity to auto-pick.
+    COMPRESSION_CHOICES = ("none", "bf16", "int8")
 
     def __init__(self, apply_fn, max_samples: int = 20,
                  window_seconds: float = 2.0,
@@ -164,11 +172,13 @@ class ParameterManager:
                  gp_noise: float = 0.8,
                  initial_toggles: Tuple[bool, bool, bool] =
                  (False, False, True),
-                 tune_toggles: bool = True):
+                 tune_toggles: bool = True,
+                 initial_compression: str = "none",
+                 tune_compression: bool = False):
         """apply_fn(fusion_bytes: int, cycle_ms: float, hierarchical_
         allreduce: bool, hierarchical_allgather: bool, cache_enabled:
-        bool) applies parameters to the runtime (native SetParams +
-        SetTunedToggles).
+        bool, compression: str) applies parameters to the runtime
+        (native SetParams + SetTunedToggles + SetWireCompression).
 
         ``warmup_samples`` windows are discarded (not fed to the GP) to
         skip compile/cache-cold noise; ``steps_per_sample > 0`` closes a
@@ -180,19 +190,29 @@ class ParameterManager:
         its initial value and is never explored — flipping a toggle
         that cannot take effect (hierarchical with one node, cache with
         capacity 0) would burn sample budget re-measuring an identical
-        configuration."""
+        configuration.  ``initial_compression``/``tune_compression`` do
+        the same for the wire-format categorical (COMPRESSION_CHOICES);
+        an explicitly-configured format stays pinned."""
         self._apply = apply_fn
         init_toggles = tuple(bool(t) for t in initial_toggles)
         if isinstance(tune_toggles, (tuple, list)):
             tunable = tuple(bool(t) for t in tune_toggles)
         else:
             tunable = (bool(tune_toggles),) * 3
+        if initial_compression not in self.COMPRESSION_CHOICES:
+            # int4/fp16 (or a typo) cannot be represented in the tuned
+            # space: respect it by pinning, never by silently replacing.
+            tune_compression = False
+        self._initial_compression = initial_compression
+        self._tune_compression = bool(tune_compression)
         # Pin the GP's candidate dims for non-tunable toggles (toggle
         # bounds are [0,1], so normalized == raw value).
+        pinned = {2 + i: (1.0 if init_toggles[i] else 0.0)
+                  for i in range(3) if not tunable[i]}
+        if not self._tune_compression:
+            pinned[5] = self._compression_x(initial_compression)
         self._opt = BayesianOptimizer(
-            self.BOUNDS, seed=seed, noise=gp_noise,
-            pinned={2 + i: (1.0 if init_toggles[i] else 0.0)
-                    for i in range(3) if not tunable[i]})
+            self.BOUNDS, seed=seed, noise=gp_noise, pinned=pinned)
         self._max_samples = max_samples
         self._window = window_seconds
         self._warmup_left = max(0, warmup_samples)
@@ -205,13 +225,20 @@ class ParameterManager:
         self._initial_toggles = init_toggles
         self._tunable = tunable
         # Deterministic categorical bootstrap (the reference's grids try
-        # every categorical value; here: the configured triple, then each
-        # TUNABLE toggle flipped once).  Numeric dims stay GP-proposed.
-        if any(self._tunable):
-            t0 = self._initial_toggles
+        # every categorical value; here: the configured combo, then each
+        # TUNABLE toggle flipped once, then each non-initial wire format
+        # once).  Numeric dims stay GP-proposed.
+        if any(self._tunable) or self._tune_compression:
+            t0 = self._initial_toggles + (self._initial_compression,)
             self._toggle_plan = [t0] + [
                 tuple(not t0[j] if j == i else t0[j] for j in range(3))
+                + (self._initial_compression,)
                 for i in range(3) if self._tunable[i]]
+            if self._tune_compression:
+                self._toggle_plan += [
+                    self._initial_toggles + (c,)
+                    for c in self.COMPRESSION_CHOICES
+                    if c != self._initial_compression]
         else:
             self._toggle_plan = []
         # The plan holds the numeric dims FIXED across the toggle flips:
@@ -252,12 +279,27 @@ class ParameterManager:
     @property
     def current(self):
         """(fusion_bytes, cycle_ms, hier_allreduce, hier_allgather,
-        cache_enabled)"""
+        cache_enabled, compression)"""
         return self._current
 
     def _round_toggles(self, x) -> Tuple[bool, bool, bool]:
         return tuple(bool(x[2 + i] >= 0.5) if self._tunable[i]
                      else self._initial_toggles[i] for i in range(3))
+
+    @classmethod
+    def _compression_x(cls, comp: str) -> float:
+        """Normalized GP coordinate of a wire format: the center of its
+        third (so rounding is stable against GP jitter)."""
+        choices = cls.COMPRESSION_CHOICES
+        idx = choices.index(comp) if comp in choices else 0
+        return (idx + 0.5) / len(choices)
+
+    def _round_compression(self, x) -> str:
+        if not self._tune_compression:
+            return self._initial_compression
+        n = len(self.COMPRESSION_CHOICES)
+        idx = min(int(float(x[5]) * n), n - 1)
+        return self.COMPRESSION_CHOICES[idx]
 
     def _propose(self):
         if self._toggle_plan:
@@ -268,7 +310,8 @@ class ParameterManager:
         else:
             x = self._opt.suggest()
             self._current = ((int(2 ** x[0]), float(x[1]))
-                             + self._round_toggles(x))
+                             + self._round_toggles(x)
+                             + (self._round_compression(x),))
         self._apply(*self._current)
         self._record_applied()
 
@@ -298,8 +341,13 @@ class ParameterManager:
         self._window_start = now
 
     def _x_of_current(self) -> np.ndarray:
-        return np.array([math.log2(self._current[0]), self._current[1]]
-                        + [1.0 if t else 0.0 for t in self._current[2:]])
+        return np.array(
+            [math.log2(self._current[0]), self._current[1]]
+            + [1.0 if t else 0.0 for t in self._current[2:5]]
+            # De-normalize the compression coordinate back into its raw
+            # [0,1] bound (observe() re-normalizes; toggle bounds are
+            # [0,1] so this is the identity for them too).
+            + [self._compression_x(self._current[5])])
 
     def _observe(self, score: float):
         if self._warmup_left > 0:
@@ -317,7 +365,8 @@ class ParameterManager:
         if self._samples >= self._max_samples:
             best_x, best_y = self._opt.best()
             self._current = ((int(2 ** best_x[0]), float(best_x[1]))
-                             + tuple(self._round_toggles(best_x)))
+                             + tuple(self._round_toggles(best_x))
+                             + (self._round_compression(best_x),))
             self._apply(*self._current)
             self._record_applied()
             self._frozen = True
@@ -333,6 +382,7 @@ class ParameterManager:
             with open(self._log_file, "a") as f:
                 f.write(f"{tag},{self._current[0]},{self._current[1]:.3f},"
                         f"{int(self._current[2])},{int(self._current[3])},"
-                        f"{int(self._current[4])},{score:.1f}\n")
+                        f"{int(self._current[4])},{self._current[5]},"
+                        f"{score:.1f}\n")
         except OSError:
             pass

@@ -220,7 +220,8 @@ def init(mesh=None,
                                    world=global_state.size)
         _debug.flight.record("init", None, rank=global_state.rank,
                              size=global_state.size,
-                             round=global_state.elastic_round)
+                             round=global_state.elastic_round,
+                             wire=global_state.config.compression)
         _debug.install_signal_handler()
         _rdv = _os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
         if _rdv:

@@ -39,6 +39,9 @@ COMPILE_CACHE_DIR = "COMPILE_CACHE_DIR"        # TPU-only: persistent XLA cache
 DATA_PREFETCH = "DATA_PREFETCH"                # background prefetch on/off
 DATA_QUEUE_DEPTH = "DATA_QUEUE_DEPTH"          # prefetch queue depth
 DATA_STALL_TIMEOUT_SECONDS = "DATA_STALL_TIMEOUT_SECONDS"  # 0 = warn only
+# Quantized collective engine (horovod_tpu/ops/quantization.py).
+COMPRESSION = "COMPRESSION"                    # none|fp16|bf16|int8|int4
+QUANT_BLOCK = "QUANT_BLOCK"                    # elements per absmax scale
 # Metrics subsystem (horovod_tpu/metrics/).
 METRICS_SYNC_STEPS = "METRICS_SYNC_STEPS"      # cross-rank cadence; 0 = off
 METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
@@ -126,6 +129,12 @@ class Config:
     data_prefetch: bool = True
     data_queue_depth: int = 2
     data_stall_timeout_seconds: float = 0.0
+    # Wire compression: the default format for the eager plane (every
+    # allreduce/reducescatter without an explicit ``compression=``) and
+    # the negotiated device plane's response-stream stamp.  Quantized
+    # formats scale per ``quant_block`` elements (ops/quantization.py).
+    compression: str = "none"
+    quant_block: int = 256
     # Metrics: registry always records locally; cross-rank aggregation
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
@@ -176,6 +185,18 @@ class Config:
             1, get_int(DATA_QUEUE_DEPTH, cfg.data_queue_depth))
         cfg.data_stall_timeout_seconds = get_float(
             DATA_STALL_TIMEOUT_SECONDS, cfg.data_stall_timeout_seconds)
+        comp = (get_env(COMPRESSION, cfg.compression) or "none")
+        comp = comp.strip().lower()
+        # A typo'd knob must not kill (or silently de-compress) a fleet:
+        # normalize unknown names to none — by_name() does the same for
+        # call-site strings — and keep the block even (int4 packs pairs).
+        # (Name set mirrors ops/compression._BY_NAME; kept literal here
+        # so config parsing never imports the jax-backed ops layer.)
+        if comp not in ("none", "fp16", "bf16", "int8", "int4"):
+            comp = "none"
+        cfg.compression = comp
+        cfg.quant_block = max(2, get_int(QUANT_BLOCK, cfg.quant_block))
+        cfg.quant_block -= cfg.quant_block % 2
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)

@@ -126,6 +126,8 @@ def load_library():
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
     lib.hvd_native_set_tuned_toggles.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_set_wire_compression.argtypes = [ctypes.c_int]
+    lib.hvd_native_wire_compression.restype = ctypes.c_int
     lib.hvd_native_set_topology.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
@@ -213,6 +215,13 @@ class NativeController:
         self._lib.hvd_native_set_topology(
             local_size, 1 if cfg.hierarchical_allreduce else 0,
             1 if cfg.hierarchical_allgather else 0)
+        # Seed the eager wire format from HVD_TPU_COMPRESSION.  Only the
+        # coordinator's call takes effect (Runtime::SetWireCompression is
+        # a no-op elsewhere); every rank adopts the choice from the
+        # response stream, so a mixed-env fleet stays consistent.
+        from ..ops.compression import WIRE_CODES
+        self._lib.hvd_native_set_wire_compression(
+            WIRE_CODES.get(cfg.compression, 0))
         self._counters = {}
         # Negotiated device plane: HBM-resident tensors enqueued with
         # *_device keep their payload on the accelerator; the registered
@@ -258,14 +267,36 @@ class NativeController:
                 # Per-toggle: hierarchical variants are dead with a
                 # single node; the cache cannot be enabled at capacity 0.
                 tune_toggles=(local_size > 1, local_size > 1,
-                              cfg.cache_capacity > 0))
+                              cfg.cache_capacity > 0),
+                initial_compression=cfg.compression,
+                # The wire-format categorical only changes anything on
+                # the negotiated device plane: skip it when that plane
+                # is switched off (same can't-take-effect gating as the
+                # hierarchical/cache toggles), and respect — never
+                # explore — an explicitly-pinned HVD_TPU_COMPRESSION.
+                tune_compression=(
+                    _config.get_env(_config.COMPRESSION) is None and
+                    os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE",
+                                   "1") != "0"))
 
     def _apply_tuned(self, fusion, cycle, hier_allreduce, hier_allgather,
-                     cache_enabled):
+                     cache_enabled, compression="none"):
+        from ..ops.compression import WIRE_CODES
         self._lib.hvd_native_set_params(int(fusion), float(cycle))
         self._lib.hvd_native_set_tuned_toggles(
             1 if hier_allreduce else 0, 1 if hier_allgather else 0,
             1 if cache_enabled else 0)
+        # Coordinator-stamped per round (ResponseList::wire_compression):
+        # workers adopt the flip at the round boundary, never mid-batch.
+        self._lib.hvd_native_set_wire_compression(
+            WIRE_CODES.get(compression, 0))
+
+    def wire_compression(self) -> str:
+        """The response-stream-adopted eager wire format ("none" until
+        the first round after the coordinator stamped one)."""
+        from ..ops.compression import WIRE_NAMES
+        return WIRE_NAMES.get(
+            int(self._lib.hvd_native_wire_compression()), "none")
 
     @classmethod
     def from_env(cls) -> "NativeController":

@@ -240,6 +240,19 @@ void hvd_native_set_tuned_toggles(int hierarchical_allreduce,
                                  cache_enabled != 0);
 }
 
+// Eager wire compression (quantized collective engine): rank 0's
+// config/tuner picks the device-plane wire format; the coordinator
+// stamps it per round (ResponseList::wire_compression) so every rank
+// builds the same staged-buffer program mid-flip.  The getter returns
+// the stream-adopted value (0 none, 1 bf16, 2 int8, 3 int4, 4 fp16).
+void hvd_native_set_wire_compression(int code) {
+  Runtime::Get().SetWireCompression(code);
+}
+
+int hvd_native_wire_compression() {
+  return Runtime::Get().WireCompression();
+}
+
 void hvd_native_counters(int64_t* bytes, double* seconds) {
   Runtime::Get().ReadCounters(bytes, seconds);
 }

@@ -183,6 +183,7 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
   const bool hier_ag = hier_allgather_.load();
   const bool cache_on = cache_on_.load();
   rl.cache_on = cache_on;
+  rl.wire_compression = wire_compression_.load();
 
   // Absorb flags + requests.
   for (int r = 0; r < size; ++r) {

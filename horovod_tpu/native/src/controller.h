@@ -61,6 +61,12 @@ class Controller {
     cache_on_.store(cache_on);
   }
 
+  // Eager wire-compression choice (quantized collective engine): set by
+  // rank 0's config/tuner, stamped into every round's ResponseList
+  // (ResponseList::wire_compression) so the device-plane executor picks
+  // the same staged wire format on every rank mid-flip.
+  void SetWireCompression(int code) { wire_compression_.store(code); }
+
   // Coordinator-side timeline: per-rank NEGOTIATE ready instants are
   // recorded as each rank's report arrives (reference timeline.cc:496-541).
   void set_timeline(Timeline* t) { timeline_ = t; }
@@ -98,6 +104,7 @@ class Controller {
   std::atomic<bool> hier_allreduce_{false};
   std::atomic<bool> hier_allgather_{false};
   std::atomic<bool> cache_on_{true};
+  std::atomic<int> wire_compression_{0};
   // Missing (non-joined, not-yet-reported) ranks for one pending tensor.
   std::vector<int32_t> MissingRanks(const PendingTensor& pt) const;
 

@@ -303,6 +303,7 @@ void Runtime::BackgroundLoop() {
     }
     // 4. Execute responses in coordinator order (identical on all ranks).
     coord_cache_on_.store(responses.cache_on);
+    coord_wire_compression_.store(responses.wire_compression);
     for (const auto& resp : responses.responses) ExecuteResponse(resp);
     worker_cache_.Touch(responses.valid_cache_bits);
 
@@ -806,6 +807,13 @@ void Runtime::SetTunedToggles(bool hierarchical_allreduce,
   if (controller_)
     controller_->SetAlgoToggles(hierarchical_allreduce,
                                 hierarchical_allgather, cache_enabled);
+}
+
+void Runtime::SetWireCompression(int code) {
+  // Coordinator-only effect: workers (and rank 0's own executor) adopt
+  // the choice from the response stream, so setting it here on a
+  // non-coordinator rank is a deliberate no-op.
+  if (controller_) controller_->SetWireCompression(code);
 }
 
 void Runtime::SetParams(int64_t fusion_threshold, double cycle_time_ms) {

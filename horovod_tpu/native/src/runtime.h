@@ -94,6 +94,12 @@ class Runtime {
   // of local_size consecutive ranks; ICI-intra / DCN-inter analog).
   void SetTopology(int local_size, bool hierarchical_allreduce,
                    bool hierarchical_allgather);
+  // Eager wire compression (quantized collective engine): forwarded to
+  // the coordinator, which stamps it into every round's ResponseList;
+  // WireCompression() returns the stream-adopted value — NEVER the
+  // locally-set one — so a rank 0 flip cannot race peers mid-round.
+  void SetWireCompression(int code);
+  int WireCompression() const { return coord_wire_compression_.load(); }
   // Categorical autotune toggles (reference parameter_manager.h:91-93):
   // forwarded to the coordinator, which stamps each Response's algorithm
   // choice and distributes the cache toggle — execution consults the
@@ -180,6 +186,9 @@ class Runtime {
   // Coordinator's distributed cache toggle (ResponseList::cache_on),
   // adopted each round: gates this worker's bit announcements.
   std::atomic<bool> coord_cache_on_{true};
+  // Coordinator's wire-compression stamp, adopted each round before the
+  // round's responses execute (ResponseList::wire_compression).
+  std::atomic<int> coord_wire_compression_{0};
   std::atomic<DeviceExecutorFn> device_executor_{nullptr};
   std::atomic<int64_t> last_fused_names_{0};
   std::chrono::steady_clock::time_point counter_start_;

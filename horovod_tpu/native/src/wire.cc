@@ -97,6 +97,7 @@ void SerializeResponseList(const ResponseList& rl, Writer& w) {
   w.u8(rl.barrier_release ? 1 : 0);
   w.i32(rl.last_joined_rank);
   w.u8(rl.cache_on ? 1 : 0);
+  w.i32(rl.wire_compression);
 }
 
 ResponseList DeserializeResponseList(Reader& r) {
@@ -111,6 +112,7 @@ ResponseList DeserializeResponseList(Reader& r) {
   rl.barrier_release = r.u8() != 0;
   rl.last_joined_rank = r.i32();
   rl.cache_on = r.u8() != 0;
+  rl.wire_compression = r.i32();
   return rl;
 }
 

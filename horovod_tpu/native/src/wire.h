@@ -80,6 +80,12 @@ struct ResponseList {
   // the coordinator turned caching off; outstanding bits from the
   // transition window still resolve (or self-heal via resend_bits).
   bool cache_on = true;
+  // Coordinator's current eager wire-compression choice (quantized
+  // collective engine; 0 none, 1 bf16, 2 int8, 3 int4, 4 fp16).  Stamped per
+  // round like cache_on: workers adopt it BEFORE executing the round's
+  // responses, so the device-plane executor on every rank builds the
+  // same staged-buffer program even when the tuner flips mid-run.
+  int32_t wire_compression = 0;
 };
 
 // --- serialization ---------------------------------------------------------

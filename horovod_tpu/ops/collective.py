@@ -66,25 +66,61 @@ def _collective_metrics(kind: str):
                           "Eager collective wall time (enqueue to "
                           "result)", buckets=DEFAULT_TIME_BUCKETS,
                           kind=kind),
+            reg.counter("hvd_wire_bytes_raw_total",
+                        "Pre-compression payload bytes offered to the "
+                        "wire", kind=kind),
+            reg.counter("hvd_wire_bytes_sent_total",
+                        "Payload bytes after the selected wire format",
+                        kind=kind),
+            reg.gauge("hvd_wire_compression_ratio",
+                      "raw/sent wire-byte ratio of the most recent op",
+                      kind=kind),
         )
         _coll_metrics[kind] = rec
     return rec
 
 
+def _wire_sent_bytes(tensor, comp) -> Optional[int]:
+    """Bytes the EAGER transport actually moves for ``tensor`` (None
+    when unknown).  Cast compressors genuinely shrink the payload before
+    transport; quantized formats only value-emulate on the eager host
+    paths — their byte savings live on the negotiated device plane,
+    whose executor prices the real staged wire under
+    ``kind="device_plane"`` — so they count raw here."""
+    nbytes = getattr(tensor, "nbytes", None)
+    if nbytes is None:
+        return None
+    if comp is None or not hasattr(tensor, "dtype"):
+        return nbytes
+    import jax.numpy as jnp
+    if not jnp.issubdtype(tensor.dtype, jnp.floating):
+        return nbytes
+    if getattr(comp, "wire_dtype", None) is not None:
+        return int(getattr(tensor, "size", 0)) * \
+            jnp.dtype(comp.wire_dtype).itemsize
+    return nbytes
+
+
 @contextlib.contextmanager
-def _op_range(kind: str, name, tensor):
+def _op_range(kind: str, name, tensor, comp=None):
     """Profiler span + metrics around an eager collective (NVTX-range
     analog, utils/profiler.py); payload size mirrors the reference's
     grouped-bytes annotation (operations.cc:1018-1033).  The same span
-    feeds ``hvd_collective_{ops,bytes}_total`` and the latency histogram
-    in the ``hvd.metrics`` registry."""
+    feeds ``hvd_collective_{ops,bytes}_total``, the latency histogram
+    and the wire-byte raw/sent counters in the ``hvd.metrics``
+    registry; ``comp`` (a Compressor class) annotates the chosen wire
+    format on the flight event and prices the sent bytes."""
     from ..utils.profiler import op_range
     nbytes = getattr(tensor, "nbytes", None)
-    ops, bts, lat = _collective_metrics(kind)
+    ops, bts, lat, raw_c, sent_c, ratio_g = _collective_metrics(kind)
     # Flight recorder: the enqueue event is what a hang report quotes —
     # an op stuck inside the yield never reaches the done event, so the
     # dangling enqueue IS the evidence of where the rank blocked.
-    _flight.record("collective.enqueue", name, op=kind, bytes=nbytes)
+    if comp is not None:
+        _flight.record("collective.enqueue", name, op=kind, bytes=nbytes,
+                       wire=comp.wire)
+    else:
+        _flight.record("collective.enqueue", name, op=kind, bytes=nbytes)
     t0 = time.perf_counter()
     try:
         with op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes):
@@ -93,6 +129,11 @@ def _op_range(kind: str, name, tensor):
         ops.inc()
         if nbytes:
             bts.inc(float(nbytes))
+            sent = _wire_sent_bytes(tensor, comp)
+            raw_c.inc(float(nbytes))
+            if sent:
+                sent_c.inc(float(sent))
+                ratio_g.set(nbytes / sent)
         dt = time.perf_counter() - t0
         lat.observe(dt)
         _flight.record("collective.done", name, op=kind, dur_s=dt)
@@ -115,6 +156,85 @@ def _default_axis(axis_name: Optional[str]) -> str:
 def _axis_size(axis_name: str) -> int:
     from ..compat import axis_size
     return axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# wire compression (quantized collective engine, ops/quantization.py)
+# ---------------------------------------------------------------------------
+
+def _resolve_compression(compression):
+    """Normalize a ``compression=`` argument (Compressor class, name
+    string, or None) to a real compressor class or None.  A None
+    argument falls back to the session default (the HVD_TPU_COMPRESSION
+    knob captured by ``init()``), so the eager plane honors the
+    configured wire format without every call site threading it."""
+    from .compression import NoneCompressor, by_name
+    if compression is None:
+        cfg = global_state.config
+        name = getattr(cfg, "compression", "none") if cfg else "none"
+        if name in ("", "none"):
+            return None
+        compression = by_name(name)
+    if isinstance(compression, str):
+        compression = by_name(compression)
+    if compression is None or compression is NoneCompressor or \
+            getattr(compression, "wire", "none") == "none":
+        return None
+    return compression
+
+
+def _compressible(tensor, op: int) -> bool:
+    """A lossy wire only composes with Sum/Average over floats."""
+    import jax.numpy as jnp
+    return op in (Sum, Average) and hasattr(tensor, "dtype") and \
+        jnp.issubdtype(tensor.dtype, jnp.floating)
+
+
+def _check_compressible(tensor, op: int, explicit: bool) -> bool:
+    """Gate the compressed path.  An explicitly-requested compressor on
+    an incompatible op/dtype raises (silent fp32 fallback would misstate
+    the wire); the session-default knob degrades silently — it must not
+    break integer broadcasts or Min/Max reductions that share the API."""
+    ok = _compressible(tensor, op)
+    if not ok and explicit:
+        raise ValueError(
+            "compression requires a floating tensor and op Sum/Average "
+            f"(got dtype {getattr(tensor, 'dtype', None)}, op {int(op)})")
+    return ok
+
+
+def _eager_wire_emulate(comp, tensor):
+    """Eager-path value semantics for a quantized wire: round the local
+    contribution to the wire grid (Q = quantize∘dequantize) so results
+    match the compiled two-pass schedule's first pass.  The *byte*
+    compression on the eager planes lives in the negotiated device-plane
+    executor (response-stream wire format); host TCP rings still move
+    the original dtype."""
+    from .quantization import qdq_host
+    return qdq_host(tensor, comp.spec())
+
+
+def _eager_rs_wire_emulate(comp, tensor):
+    """Reducescatter variant of the wire emulation: the compiled
+    schedule (``compressed_reducescatter``) quantizes each destination
+    chunk as its own row — blocks never straddle chunk boundaries — so
+    value parity requires chunk-local Q here too, not one flat Q over
+    the whole tensor."""
+    world = communicator_size()
+    rows = getattr(tensor, "shape", (0,))[0] if \
+        getattr(tensor, "ndim", 0) else 0
+    if world <= 1 or rows == 0 or rows % world:
+        # Degenerate/invalid dims: plain emulation; eager.reducescatter
+        # raises the dim error with its own message.
+        return _eager_wire_emulate(comp, tensor)
+    chunk = rows // world
+    parts = [_eager_wire_emulate(comp, tensor[i * chunk: (i + 1) * chunk])
+             for i in range(world)]
+    if _eager._is_device_array(tensor):
+        import jax.numpy as jnp
+        return jnp.concatenate(parts, axis=0)
+    import numpy as np_
+    return np_.concatenate(parts, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -174,6 +294,23 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
 
 
 @functools.lru_cache(maxsize=256)
+def _eager_op_fn_f32acc(op: int, prescale_factor: float,
+                        postscale_factor: float):
+    """Stack reducer for cast-compressed eager payloads: upcast the wire
+    dtype to fp32 before accumulating, cast back after — the same
+    accumulation contract as the compiled two-pass schedule.  Cached for
+    the same reducer-identity reason as ``_eager_op_fn``."""
+    base = _eager_op_fn(op, prescale_factor, postscale_factor)
+
+    def fn(stack):
+        import jax.numpy as jnp
+        if not jnp.issubdtype(stack.dtype, jnp.floating):
+            return base(stack)
+        return base(stack.astype(jnp.float32)).astype(stack.dtype)
+    return fn
+
+
+@functools.lru_cache(maxsize=256)
 def _eager_op_fn(op: int, prescale_factor: float, postscale_factor: float):
     """Build a stack-reducer callable((P, ...)) -> (...) for the eager path.
     Cached so repeat calls return the same callable — the eager device
@@ -225,17 +362,65 @@ def allreduce(tensor,
               axis_name: Optional[str] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
-              name: Optional[str] = None):
+              name: Optional[str] = None,
+              compression=None):
     """Allreduce a tensor across the communicator.
 
     Inside jit/shard_map: reduces over mesh axis ``axis_name`` (default
     "data").  Eagerly: reduces across processes.  Prescale/postscale mirror
     the reference's fused scale kernels (nccl_operations.cc:153-172).
+
+    ``compression`` (``hvd.Compression.{fp16,bf16,int8,int4}``, a name
+    string, or None for the HVD_TPU_COMPRESSION session default) selects
+    the wire format.  Compiled path: the op routes through the two-pass
+    schedule in ``ops.quantization`` — both wire passes move compressed
+    bytes, accumulation is always fp32.  Eager path: quantized formats
+    round contributions and results to the wire grid (byte compression
+    happens on the negotiated device plane via the response-stream wire
+    format); cast formats compress the payload and reduce with fp32
+    accumulation in the jitted regimes (the native host rings reduce in
+    the wire dtype — see docs/compression.md).
     """
+    explicit = compression is not None
     if _is_tracer(tensor):
+        # The session-default knob is eager-plane scope ONLY: a compiled
+        # gradient reduction must opt in explicitly (DistributedOptimizer
+        # (compression=…)), because lossy quantization without the
+        # optimizer's error-feedback residual silently degrades
+        # convergence — the env var must not do that behind a jit.
+        comp = _resolve_compression(compression) if explicit else None
+        if comp is not None and _check_compressible(tensor, op, explicit):
+            from . import quantization as Q
+            spec = comp.spec()
+            return Q.compressed_allreduce(
+                tensor, _default_axis(axis_name), op, spec=spec,
+                wire_dtype=None if spec is not None else comp.wire_dtype,
+                prescale=prescale_factor, postscale=postscale_factor)
         return _compiled_allreduce(tensor, op, _default_axis(axis_name),
                                    prescale_factor, postscale_factor)
-    with _op_range("allreduce", name, tensor):
+    comp = _resolve_compression(compression)
+    if comp is not None and not _check_compressible(tensor, op, explicit):
+        comp = None
+    with _op_range("allreduce", name, tensor, comp=comp):
+        if comp is not None and comp.bits is not None:
+            # fp32 accumulation even when the tensor dtype is bf16/fp16:
+            # the emulated wire values must sum the way the compiled
+            # two-pass schedule sums them.
+            x = _eager_wire_emulate(comp, tensor)
+            out = _eager.allreduce(
+                x, op_fn=_eager_op_fn_f32acc(op, prescale_factor,
+                                             postscale_factor),
+                name=name, op_code=int(op), prescale=prescale_factor,
+                postscale=postscale_factor)
+            return _eager_wire_emulate(comp, out)
+        if comp is not None:
+            cx, ctx = comp.compress(tensor)
+            out = _eager.allreduce(
+                cx, op_fn=_eager_op_fn_f32acc(op, prescale_factor,
+                                              postscale_factor),
+                name=name, op_code=int(op), prescale=prescale_factor,
+                postscale=postscale_factor)
+            return comp.decompress(out, ctx)
         return _eager.allreduce(
             tensor, op_fn=_eager_op_fn(op, prescale_factor, postscale_factor),
             name=name, op_code=int(op), prescale=prescale_factor,
@@ -247,16 +432,32 @@ def grouped_allreduce(tensors: Sequence,
                       axis_name: Optional[str] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      name: Optional[str] = None) -> List:
+                      name: Optional[str] = None,
+                      compression=None) -> List:
     """Allreduce a group atomically (reference: EnqueueTensorAllreduces with a
     shared group id, operations.cc:1041-1048; GroupTable group_table.h:30-59).
     On the compiled path XLA fuses the group into combined collectives; on
     the native eager path all members enqueue together so the runtime's
-    fusion buffer batches them into shared ring launches."""
+    fusion buffer batches them into shared ring launches.
+
+    ``compression`` applies per member on the compiled and direct eager
+    paths; on the negotiated controller planes the wire format comes
+    from the coordinator's response-stream stamp instead (the fused
+    Response is one payload — per-member formats cannot compose with
+    fusion), so the argument only rounds members to the wire grid there.
+    """
     tensors = list(tensors)
+    comp = _resolve_compression(compression)
     first = tensors[0] if tensors else None
     ctl = global_state.controller
     if first is not None and not _is_tracer(first) and ctl is not None:
+        if comp is not None and comp.bits is not None:
+            # Round quantized-wire members to the wire grid before the
+            # negotiated enqueue, mirroring the single-op eager path;
+            # the byte compression itself is the response-stream wire
+            # format's job (one format per fused Response).
+            tensors = [_eager_wire_emulate(comp, t)
+                       if _compressible(t, op) else t for t in tensors]
         from .eager import _ctl as _ctl_call, _is_device_array, \
             _negotiated_device_ready
         if all(_is_device_array(t) for t in tensors) and \
@@ -296,7 +497,8 @@ def grouped_allreduce(tensors: Sequence,
         allreduce(t, op=op, axis_name=axis_name,
                   prescale_factor=prescale_factor,
                   postscale_factor=postscale_factor,
-                  name=None if name is None else f"{name}.{i}")
+                  name=None if name is None else f"{name}.{i}",
+                  compression=compression)
         for i, t in enumerate(tensors)
     ]
 
@@ -368,11 +570,28 @@ def alltoall(tensor, splits: Optional[Sequence[int]] = None,
 
 def reducescatter(tensor, op: int = Average,
                   axis_name: Optional[str] = None,
-                  name: Optional[str] = None):
-    """Reduce then scatter equal dim-0 chunks (rank i gets chunk i)."""
+                  name: Optional[str] = None,
+                  compression=None):
+    """Reduce then scatter equal dim-0 chunks (rank i gets chunk i).
+
+    ``compression`` routes the compiled path through the one-pass
+    quantized/cast reduce-scatter in ``ops.quantization`` (compressed
+    wire, fp32 accumulation, full-precision output shard — ZeRO's
+    gradient sharding rides this).  The eager path rounds the input to
+    the wire grid for quantized formats (value parity with compiled).
+    """
+    explicit = compression is not None
     if _is_tracer(tensor):
-        from jax import lax
+        # Session default is eager-scope only — see allreduce.
+        comp = _resolve_compression(compression) if explicit else None
         ax = _default_axis(axis_name)
+        if comp is not None and _check_compressible(tensor, op, explicit):
+            from . import quantization as Q
+            spec = comp.spec()
+            return Q.compressed_reducescatter(
+                tensor, ax, op, spec=spec,
+                wire_dtype=None if spec is not None else comp.wire_dtype)
+        from jax import lax
         out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
         if op == Average:
             out = out / _axis_size(ax)
@@ -380,11 +599,29 @@ def reducescatter(tensor, op: int = Average,
             raise ValueError("compiled reducescatter supports Sum/Average")
         return out
     from . import eager
+    comp = _resolve_compression(compression)
+    if comp is not None and not _check_compressible(tensor, op, explicit):
+        comp = None
     code = Sum if op == Sum else Average
-    fn = _eager_op_fn(code, 1.0, 1.0)
-    with _op_range("reducescatter", name, tensor):
-        return eager.reducescatter(tensor, op_fn=fn, name=name,
-                                   op_code=int(code))
+    with _op_range("reducescatter", name, tensor, comp=comp):
+        if comp is not None and comp.bits is not None:
+            # One-pass schedule: quantize contributions, fp32-accumulate;
+            # the output shard is NOT requantized — emulate accordingly,
+            # with chunk-local block boundaries matching the compiled
+            # schedule.
+            x = _eager_rs_wire_emulate(comp, tensor)
+            return eager.reducescatter(
+                x, op_fn=_eager_op_fn_f32acc(code, 1.0, 1.0), name=name,
+                op_code=int(code))
+        if comp is not None:
+            cx, ctx = comp.compress(tensor)
+            out = eager.reducescatter(
+                cx, op_fn=_eager_op_fn_f32acc(code, 1.0, 1.0), name=name,
+                op_code=int(code))
+            return comp.decompress(out, ctx)
+        return eager.reducescatter(tensor,
+                                   op_fn=_eager_op_fn(code, 1.0, 1.0),
+                                   name=name, op_code=int(code))
 
 
 # ---------------------------------------------------------------------------

@@ -221,15 +221,26 @@ def _negotiated_executor(ctl):
     _m_staged = _mreg.counter(
         "hvd_device_plane_bytes_total",
         "Payload bytes executed on the negotiated device plane")
+    _m_wire_raw = _mreg.counter(
+        "hvd_wire_bytes_raw_total",
+        "Pre-compression payload bytes offered to the wire",
+        kind="device_plane")
+    _m_wire_sent = _mreg.counter(
+        "hvd_wire_bytes_sent_total",
+        "Payload bytes after the selected wire format",
+        kind="device_plane")
 
     def _build(rtype, sizes, present, shapes, np_dtype, op, root,
-               prescale, postscale, mesh):
+               prescale, postscale, comp, mesh):
         """Compile the per-signature programs; returns run(*present_args)
-        -> tuple of outputs for the present names, in names order."""
+        -> tuple of outputs for the present names, in names order.
+        ``comp`` is the coordinator-stamped wire format ("none"/"bf16"/
+        "fp16"/"int8"/"int4") — already gated by ``impl`` to fused
+        allreduces over floats with Sum/Average."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as PS
-        from .collective import _eager_op_fn
+        from .collective import _eager_op_fn_f32acc
         dtype = jnp.dtype(np_dtype)
         P = ctl.size()
         me = ctl.rank()
@@ -248,26 +259,103 @@ def _negotiated_executor(ctl):
             offs = [0]
             for sz in sizes:
                 offs.append(offs[-1] + sz)
-            base = (_eager_op_fn(op, prescale, postscale)
+            # f32acc: float stacks (including bf16/fp16 payloads a cast
+            # compressor produced) accumulate in fp32 and cast back —
+            # the wire dtype is never the accumulation dtype, matching
+            # the compiled two-pass schedule.  Integer stacks reduce
+            # exactly as before.
+            base = (_eager_op_fn_f32acc(op, prescale, postscale)
                     if rtype == 0 else _take_fn(root))
             pres_idx = [i for i in range(len(sizes)) if present[i]]
 
-            def pack_fn(*args):
+            def _fused(args, fused_dtype):
                 # Missing names are joined-rank zero proxies (reference
                 # GetTensorEntriesFromResponse, tensor_queue.cc); the
                 # fused layout is names order, as on the host plane.
                 it = iter(args)
-                parts = [jnp.ravel(next(it)) if present[i]
-                         else jnp.zeros((sizes[i],), dtype=dtype)
+                parts = [jnp.ravel(next(it)).astype(fused_dtype)
+                         if present[i]
+                         else jnp.zeros((sizes[i],), dtype=fused_dtype)
                          for i in range(len(sizes))]
-                fused = (parts[0] if len(parts) == 1
-                         else jnp.concatenate(parts))
-                return fused[None]
+                return parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts)
 
-            def split_fn(out):
-                return tuple(
-                    out[offs[i]: offs[i] + sizes[i]].reshape(shapes[j])
-                    for j, i in enumerate(pres_idx))
+            if comp != "none":
+                # Compressed wire: the staged buffer — the only array the
+                # sharded→replicated program moves between processes —
+                # holds the wire format, not fp32; the reduction runs on
+                # dequantized fp32 after the gather.  One program per
+                # (signature, wire) key: a coordinator flip recompiles
+                # rather than reusing a stale layout.
+                from .quantization import (QuantSpec, default_block,
+                                           unpack_int4, quantize)
+                L = offs[-1]
+                if comp in ("bf16", "fp16"):
+                    wire_dt = jnp.bfloat16 if comp == "bf16" \
+                        else jnp.float16
+
+                    def pack_fn(*args):
+                        return _fused(args, jnp.float32).astype(
+                            wire_dt)[None]
+
+                    def reduce_fn(stack):
+                        return _reduce_f32(stack.astype(jnp.float32))
+                else:
+                    spec = QuantSpec(bits=8 if comp == "int8" else 4,
+                                     block=default_block())
+                    nb = -(-max(L, 1) // spec.block)
+                    packed_w = spec.block if spec.bits == 8 \
+                        else spec.block // 2
+
+                    def pack_fn(*args):
+                        q, scales = quantize(_fused(args, jnp.float32),
+                                             spec)
+                        qb = jax.lax.bitcast_convert_type(
+                            q, jnp.uint8).reshape(-1)
+                        sb = jax.lax.bitcast_convert_type(
+                            scales, jnp.uint8).reshape(-1)
+                        return jnp.concatenate([qb, sb])[None]
+
+                    def reduce_fn(stack):
+                        qb = stack[:, : nb * packed_w].reshape(
+                            P, nb, packed_w)
+                        q = jax.lax.bitcast_convert_type(qb, jnp.int8)
+                        if spec.bits == 4:
+                            q = unpack_int4(q)
+                        sb = stack[:, nb * packed_w:].reshape(P, nb, 4)
+                        scales = jax.lax.bitcast_convert_type(
+                            sb, jnp.float32)
+                        deq = q.astype(jnp.float32) * scales[..., None]
+                        return _reduce_f32(
+                            deq.reshape(P, -1)[:, :max(L, 1)])
+
+                def _reduce_f32(contrib):
+                    # fp32 accumulation always; zero proxies count as
+                    # members, matching the host plane's stack mean.
+                    if prescale != 1.0:
+                        contrib = contrib * prescale
+                    acc = contrib.sum(axis=0)
+                    if op == 0:  # Average
+                        acc = acc / P
+                    if postscale != 1.0:
+                        acc = acc * postscale
+                    return acc
+
+                def split_fn(out):
+                    return tuple(
+                        out[offs[i]: offs[i] + sizes[i]]
+                        .reshape(shapes[j]).astype(dtype)
+                        for j, i in enumerate(pres_idx))
+
+                base = reduce_fn
+            else:
+                def pack_fn(*args):
+                    return _fused(args, dtype)[None]
+
+                def split_fn(out):
+                    return tuple(
+                        out[offs[i]: offs[i] + sizes[i]].reshape(shapes[j])
+                        for j, i in enumerate(pres_idx))
 
             pack_jit = jax.jit(pack_fn)
             coll_jit = _jitted_global(base)
@@ -401,13 +489,33 @@ def _negotiated_executor(ctl):
     def impl(rtype, names, sizes, np_dtype, op, root, prescale, postscale,
              inputs):
         import jax
+        # Wire format for this Response: the coordinator's per-round
+        # stamp (ResponseList::wire_compression) — identical on every
+        # rank for the same Response, so the per-signature programs line
+        # up even when the tuner flips it mid-run.  A lossy wire only
+        # composes with fused float allreduces under Sum/Average; the
+        # gate below depends only on Response data, so it is itself
+        # rank-consistent.
+        comp = "none"
+        try:
+            comp = ctl.wire_compression()
+        except Exception:  # noqa: BLE001 — controllers without the
+            pass           # stamp (e.g. test doubles)
+        # Float check via jnp: ml_dtypes' bfloat16 — THE TPU gradient
+        # dtype — registers as numpy kind 'V', so np.issubdtype would
+        # silently exclude it from compression.
+        import jax.numpy as jnp
+        if rtype != 0 or int(op) not in (0, 1) or \
+                not jnp.issubdtype(jnp.dtype(np_dtype), jnp.floating) or \
+                not sizes or sum(int(s) for s in sizes) == 0:
+            comp = "none"
         # Flight recorder: one event per negotiated Response, on the
         # background thread — if the SPMD collective below never returns
         # (a peer died inside XLA, where no stall inspector can see),
         # this dangling negotiate.execute event names the fused batch
         # that hung.
         _flight.record("negotiate.execute", names[0] if names else None,
-                       rtype=rtype, n=len(names))
+                       rtype=rtype, n=len(names), wire=comp)
         mesh = _cached_process_mesh()
         if getattr(ctl, "_device_exec_mesh", None) is not mesh:
             # Elastic world rebuild: the cached programs bake in the old
@@ -427,12 +535,12 @@ def _negotiated_executor(ctl):
         # is exactly what the cache amortizes.
         key = (rtype, tuple(sizes), present, shapes,
                str(np.dtype(np_dtype)), int(op), int(root),
-               float(prescale), float(postscale))
+               float(prescale), float(postscale), comp)
         run = cache.get(key)
         if run is None:
             run = _build(rtype, sizes, present, shapes, np_dtype,
                          int(op), int(root), float(prescale),
-                         float(postscale), mesh)
+                         float(postscale), comp, mesh)
             cache[key] = run
             while len(cache) > cache_cap:
                 cache.popitem(last=False)
@@ -443,8 +551,21 @@ def _negotiated_executor(ctl):
             _m_hits.inc()
         _m_fused.observe(len(names))
         if rtype in (0, 2):
-            _m_staged.inc(float(sum(sizes)) *
-                          np.dtype(np_dtype).itemsize)
+            raw = float(sum(sizes)) * np.dtype(np_dtype).itemsize
+            _m_staged.inc(raw)
+            _m_wire_raw.inc(raw)
+            if comp == "none":
+                sent = raw
+            else:
+                from .quantization import QuantSpec, wire_bytes
+                n_el = sum(sizes)
+                if comp in ("bf16", "fp16"):
+                    sent = float(n_el * 2)
+                else:
+                    from .quantization import default_block
+                    sent = float(wire_bytes(n_el, QuantSpec(
+                        8 if comp == "int8" else 4, default_block())))
+            _m_wire_sent.inc(sent)
         outs = run(*(inputs[nm] for nm in pres_names))
         if rtype in (0, 2):
             return dict(zip(pres_names, outs))

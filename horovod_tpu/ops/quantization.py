@@ -1,0 +1,318 @@
+"""Block-scaled quantization kernels + two-pass quantized collectives.
+
+The wire formats of the quantized collective engine (EQuARX,
+arXiv:2506.17615): per-block absmax-scaled int8 (and int4 packed two per
+int8), expressed as pure ``jnp`` — jit/shard_map traceable, no host
+callbacks — so XLA fuses the (de)quantize into the collective's
+producer/consumer exactly as it fuses the plain dtype casts in
+``ops/compression.py``.
+
+Accumulation contract: the wire dtype is NEVER the accumulation dtype.
+The cast compressors' historical ``compress → psum → decompress`` shape
+let psum accumulate in bf16/fp16, losing mantissa as the world grows
+(N partial sums, each rounded to 8/11 mantissa bits).  Every schedule in
+this module reduces in fp32 and touches the wire dtype only for
+transport:
+
+two-pass quantized allreduce (the EQuARX schedule)::
+
+    quantize ──all_to_all──▶ dequantize + fp32 accumulate
+                                  │ requantize
+                                  ▼
+              output ◀──all_gather── quantized reduced shard
+
+Both passes move the quantized payload (~4x fewer bytes than fp32 for
+int8, ~8x for int4, plus one fp32 scale per ``block`` elements); the
+reduction itself happens on dequantized fp32 shards.  The first pass
+alone IS a quantized reducescatter — ZeRO's gradient sharding reuses it
+directly.  The cast (bf16/fp16) variant follows the same schedule with a
+plain dtype cast instead of quantize, which fixes the fp32-accumulation
+gap at the same wire cost as the old psum path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+DEFAULT_BLOCK = 256
+
+
+class QuantSpec(NamedTuple):
+    """Static description of a quantized wire format (hashable — rides
+    jit static args and the eager executor's program-cache key)."""
+    bits: int                 # 8 or 4 (int4 packs two values per int8)
+    block: int = DEFAULT_BLOCK  # elements per absmax scale
+
+
+def default_block() -> int:
+    """The session quant block: the Config parsed at init() (already
+    normalized — even, >= 2), falling back to the env knob before init.
+    Single source: the normalization lives in core/config.py."""
+    from ..core.state import global_state
+    cfg = getattr(global_state, "config", None)
+    if cfg is not None:
+        return cfg.quant_block
+    from ..core.config import Config
+    return Config.from_env().quant_block
+
+
+def _qmax(bits: int) -> int:
+    # Symmetric range: int4 uses [-7, 7] so negation round-trips and the
+    # packed nibble 0x8 (= -8) never appears.
+    return 127 if bits == 8 else 7
+
+
+def wire_bytes(n: int, spec: QuantSpec) -> int:
+    """Bytes on the wire for n fp32 elements under ``spec`` (payload +
+    one fp32 scale per block, padding ignored)."""
+    payload = n if spec.bits == 8 else (n + 1) // 2
+    return payload + 4 * math.ceil(n / spec.block)
+
+
+def pack_int4(q):
+    """(…, block) int8 in [-7, 7] → (…, block/2) int8, two's-complement
+    nibbles packed little-end-first."""
+    import jax
+    import jax.numpy as jnp
+    u = jax.lax.bitcast_convert_type(q, jnp.uint8) & 0xF
+    lo = u[..., 0::2]
+    hi = u[..., 1::2]
+    return jax.lax.bitcast_convert_type(lo | (hi << 4), jnp.int8)
+
+
+def unpack_int4(p):
+    """Inverse of :func:`pack_int4`: (…, block/2) int8 → (…, block) int8."""
+    import jax
+    import jax.numpy as jnp
+    u = jax.lax.bitcast_convert_type(p, jnp.uint8)
+    lo = (u & 0xF).astype(jnp.int32)
+    hi = (u >> 4).astype(jnp.int32)
+    nib = jnp.stack([lo, hi], axis=-1).reshape(p.shape[:-1] + (-1,))
+    return jnp.where(nib >= 8, nib - 16, nib).astype(jnp.int8)
+
+
+def quantize(x, spec: QuantSpec):
+    """Flatten + pad ``x`` and quantize per absmax block.
+
+    Returns ``(q, scales)``: ``q`` int8 of shape (nblocks, block) — or
+    (nblocks, block/2) for int4 — and fp32 ``scales`` of shape
+    (nblocks,).  All-zero blocks get scale 1.0 (quantize to zeros, no
+    0/0).  Shape/length bookkeeping is the caller's (static under jit).
+    """
+    import jax.numpy as jnp
+    qmax = _qmax(spec.bits)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = (-flat.size) % spec.block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, spec.block)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scales = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]), -qmax, qmax)
+    q = q.astype(jnp.int8)
+    if spec.bits == 4:
+        q = pack_int4(q)
+    return q, scales
+
+
+def dequantize(q, scales, spec: QuantSpec, n: int, shape=None, dtype=None):
+    """Blocks → flat fp32 of the first ``n`` elements (then optional
+    reshape/cast).  ``n`` must be the pre-pad flat length."""
+    import jax.numpy as jnp
+    if spec.bits == 4:
+        q = unpack_int4(q)
+    x = q.astype(jnp.float32) * scales[..., None]
+    x = x.reshape(-1)[:n]
+    if shape is not None:
+        x = x.reshape(shape)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return x
+
+
+def qdq(x, spec: QuantSpec):
+    """Quantize → dequantize round trip (same shape/dtype): the local
+    quantization operator Q.  Error-feedback residuals are x - Q(x)."""
+    q, s = quantize(x, spec)
+    return dequantize(q, s, spec, x.size, x.shape, x.dtype)
+
+
+def qdq_np(x, spec: QuantSpec):
+    """Numpy Q = quantize∘dequantize — value-identical to :func:`qdq`
+    (packing skipped; it is value-neutral).  For eager host arrays,
+    where pulling numpy data through jnp would wake the accelerator
+    backend."""
+    import numpy as np
+    qmax = _qmax(spec.bits)
+    arr = np.asarray(x)
+    flat = np.ravel(arr).astype(np.float32)
+    n = flat.size
+    pad = (-n) % spec.block
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, spec.block)
+    absmax = np.max(np.abs(blocks), axis=-1)
+    scales = np.where(absmax > 0, absmax / qmax, 1.0).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -qmax, qmax)
+    out = (q * scales[:, None]).reshape(-1)[:n]
+    return out.reshape(arr.shape).astype(arr.dtype)
+
+
+def qdq_host(x, spec: QuantSpec):
+    """Eager-path Q on a concrete tensor: jnp for device-resident
+    jax.Arrays (stays in HBM, keeps device-plane eligibility), numpy for
+    host arrays (never initializes the accelerator backend)."""
+    try:
+        import jax
+        if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+            return qdq(x, spec)
+    except Exception:
+        pass
+    return qdq_np(x, spec)
+
+
+# ---------------------------------------------------------------------------
+# compiled-path schedules (inside jit/shard_map over a named mesh axis)
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis_name: str) -> int:
+    from ..compat import axis_size
+    return axis_size(axis_name)
+
+
+def _rows_to_wire(rows, spec: Optional[QuantSpec], wire_dtype):
+    """(world, s) fp32 → wire representation: (payload, scales|None)."""
+    if spec is None:
+        return rows.astype(wire_dtype), None
+    q, scales = quantize(rows, spec)          # rows are block-aligned
+    return q.reshape(rows.shape[0], -1), scales.reshape(rows.shape[0], -1)
+
+
+def _wire_to_f32(payload, scales, spec: Optional[QuantSpec], elems: int):
+    """(world, …) wire → (world, elems) fp32 contributions."""
+    import jax.numpy as jnp
+    if spec is None:
+        return payload.astype(jnp.float32)
+    world = payload.shape[0]
+    packed = spec.block if spec.bits == 8 else spec.block // 2
+    return dequantize(payload.reshape(-1, packed), scales.reshape(-1),
+                      spec, world * elems).reshape(world, elems)
+
+
+def _reduced_shard(x, axis_name, op, spec, wire_dtype, prescale):
+    """First pass of the two-pass schedule: quantize (or cast) the local
+    tensor, all_to_all destination shards, dequantize + fp32-accumulate.
+
+    Returns ``(acc, n, world)``: this rank's reduced fp32 shard of the
+    flattened-and-padded input (length padded to world × block), the true
+    flat length, and the axis size."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import collective as C
+
+    world = _axis_size(axis_name)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    if prescale != 1.0:
+        flat = flat * prescale
+    n = flat.size
+    align = world * (spec.block if spec is not None else 1)
+    pad = (-n) % align
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    rows = flat.reshape(world, -1)            # row d = destination rank d
+    payload, scales = _rows_to_wire(rows, spec, wire_dtype)
+    payload = lax.all_to_all(payload, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)
+    if scales is not None:
+        scales = lax.all_to_all(scales, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+    contrib = _wire_to_f32(payload, scales, spec, rows.shape[1])
+    acc = contrib.sum(axis=0)                 # fp32 accumulation — always
+    if op == C.Average:
+        acc = acc / world
+    return acc, n, world
+
+
+def compressed_allreduce(x, axis_name: str, op: int,
+                         spec: Optional[QuantSpec] = None,
+                         wire_dtype=None,
+                         prescale: float = 1.0, postscale: float = 1.0):
+    """Two-pass compressed allreduce over mesh axis ``axis_name``.
+
+    ``spec`` selects a quantized wire; ``wire_dtype`` (bf16/fp16) selects
+    a cast wire — exactly one must be given.  Supports Sum/Average (the
+    only ops a lossy wire composes with).  Output dtype == input dtype.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import collective as C
+
+    if (spec is None) == (wire_dtype is None):
+        raise ValueError("exactly one of spec/wire_dtype must be set")
+    if op not in (C.Sum, C.Average):
+        raise ValueError(
+            "compressed allreduce supports Sum/Average only (a lossy "
+            f"wire does not compose with op {int(op)})")
+    acc, n, world = _reduced_shard(x, axis_name, op, spec, wire_dtype,
+                                   prescale)
+    # Pass 2: requantize (or recast) the reduced shard and gather.
+    if spec is None:
+        gathered = lax.all_gather(acc.astype(wire_dtype), axis_name,
+                                  tiled=True)
+        out = gathered.astype(jnp.float32)[:n]
+    else:
+        q2, s2 = quantize(acc, spec)
+        q2 = lax.all_gather(q2, axis_name, tiled=True)
+        s2 = lax.all_gather(s2, axis_name, tiled=True)
+        out = dequantize(q2, s2, spec, world * acc.size)[:n]
+    if postscale != 1.0:
+        out = out * postscale
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def compressed_reducescatter(x, axis_name: str, op: int,
+                             spec: Optional[QuantSpec] = None,
+                             wire_dtype=None):
+    """Compressed reduce-scatter: dim-0 chunk ``i`` of the reduction goes
+    to rank ``i`` — the first pass of the two-pass allreduce, with the
+    destination rows being the reducescatter chunks themselves.
+
+    Same contract as ``ops.collective.reducescatter``: dim 0 must divide
+    by the axis size; accumulation is fp32; out dtype == in dtype.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import collective as C
+
+    if (spec is None) == (wire_dtype is None):
+        raise ValueError("exactly one of spec/wire_dtype must be set")
+    if op not in (C.Sum, C.Average):
+        raise ValueError("compressed reducescatter supports Sum/Average")
+    world = _axis_size(axis_name)
+    rows = x.shape[0]
+    if rows % world:
+        raise ValueError(
+            f"reducescatter dim0 {rows} not divisible by {world}")
+    chunk = rows // world
+    tail = int(x.size // rows) if rows else 0
+    elems = chunk * tail
+    flat = x.astype(jnp.float32).reshape(world, elems)
+    if spec is not None:
+        pad = (-elems) % spec.block
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    payload, scales = _rows_to_wire(flat, spec, wire_dtype)
+    payload = lax.all_to_all(payload, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)
+    if scales is not None:
+        scales = lax.all_to_all(scales, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+    contrib = _wire_to_f32(payload, scales, spec, flat.shape[1])
+    acc = contrib.sum(axis=0)[:elems]         # fp32 accumulation
+    if op == C.Average:
+        acc = acc / world
+    return acc.reshape((chunk,) + x.shape[1:]).astype(x.dtype)

@@ -41,6 +41,17 @@ def _allreduce_tree(tree, op, axis_name, compression,
     def _one(x):
         if not isinstance(x, (jax.Array, np.ndarray)) and not hasattr(x, "dtype"):
             return x
+        if getattr(comp, "wire", "none") != "none" and \
+                C._compressible(x, op):
+            # Route the wire format INSIDE the collective: the two-pass
+            # schedule moves compressed bytes on both passes but always
+            # accumulates in fp32.  The historical compress→psum→
+            # decompress shape let psum accumulate in the wire dtype —
+            # bf16 partial sums lose mantissa exactly as the world grows.
+            return C.allreduce(x, op=op, axis_name=axis_name,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor,
+                               compression=comp)
         cx, ctx = comp.compress(x)
         red = C.allreduce(cx, op=op, axis_name=axis_name,
                           prescale_factor=prescale_factor,
@@ -62,6 +73,13 @@ class _AggState(NamedTuple):
     counter: jax.Array        # steps since last sync
     acc: Any                  # accumulated gradients
     inner: Any                # inner optimizer state
+    # Error-feedback residual for quantized wires (None otherwise): the
+    # quantization error of this rank's last communicated gradient,
+    # carried into the next step instead of lost — required for
+    # convergence parity with fp32 (1-bit-Adam/EF-SGD lineage).  Rides
+    # the optimizer state, so checkpoints carry it automatically
+    # (save_zero_state(extra=…) for ZeRO jobs — docs/compression.md).
+    residual: Any = None
 
 
 def DistributedOptimizer(optimizer,
@@ -86,20 +104,46 @@ def DistributedOptimizer(optimizer,
     if bpps < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
+    # Error feedback pairs with LOSSY-quantized wires on a reduced
+    # gradient: the residual is this rank's local quantization error
+    # (g - Q(g), the first-pass loss of the two-pass schedule), added
+    # back before the next communicate so the error is delayed, not
+    # dropped.  Cast wires round-trip through fp32 accumulation and
+    # need no residual; Adasum reduces deltas, not gradients.
+    quant_spec = None
+    if getattr(compression, "bits", None) is not None and \
+            op in (C.Average, C.Sum):
+        quant_spec = compression.spec()
+
     def init_fn(params):
         inner = optimizer.init(params)
+        residual = None
+        if quant_spec is not None:
+            residual = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
         if bpps == 1:
             return _AggState(counter=jnp.zeros((), jnp.int32),
-                             acc=None, inner=inner)
+                             acc=None, inner=inner, residual=residual)
         acc = jax.tree_util.tree_map(jnp.zeros_like, params)
         return _AggState(counter=jnp.zeros((), jnp.int32),
-                         acc=acc, inner=inner)
+                         acc=acc, inner=inner, residual=residual)
 
     def _communicate(grads):
         if op == C.Adasum:
             return grads  # Adasum reduces the delta after the inner update.
         return _allreduce_tree(grads, op, axis_name, compression,
                                prescale_factor, postscale_factor)
+
+    def _with_feedback(grads, residual):
+        """(grads + residual, new residual): EF-corrected communicate
+        input and the quantization error it will leave behind."""
+        from .ops.quantization import qdq
+        fed = jax.tree_util.tree_map(
+            lambda g, r: g + r.astype(g.dtype), grads, residual)
+        new_residual = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32)
+                       - qdq(g.astype(jnp.float32), quant_spec)), fed)
+        return fed, new_residual
 
     def _apply(grads, state, params):
         grads = _communicate(grads)
@@ -111,9 +155,12 @@ def DistributedOptimizer(optimizer,
 
     def update_fn(grads, state: _AggState, params=None):
         if bpps == 1:
+            residual = state.residual
+            if quant_spec is not None:
+                grads, residual = _with_feedback(grads, state.residual)
             updates, inner = _apply(grads, state, params)
             return updates, _AggState(counter=state.counter, acc=None,
-                                      inner=inner)
+                                      inner=inner, residual=residual)
 
         # Local gradient aggregation: accumulate bpps backward passes, then
         # communicate once (reference gradient_aggregation.py:16).
@@ -122,23 +169,27 @@ def DistributedOptimizer(optimizer,
         do_sync = counter >= bpps
 
         def sync_branch(operand):
-            acc_, inner_ = operand
+            acc_, inner_, residual_ = operand
             scale = 1.0 / bpps if average_aggregated_gradients else 1.0
             scaled = jax.tree_util.tree_map(lambda a: a * scale, acc_)
+            if quant_spec is not None:
+                scaled, residual_ = _with_feedback(scaled, residual_)
             updates, inner2 = _apply(scaled, state._replace(inner=inner_),
                                      params)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_)
-            return updates, zeroed, inner2
+            return updates, zeroed, inner2, residual_
 
         def skip_branch(operand):
-            acc_, inner_ = operand
+            acc_, inner_, residual_ = operand
             updates = jax.tree_util.tree_map(jnp.zeros_like, acc_)
-            return updates, acc_, inner_
+            return updates, acc_, inner_, residual_
 
-        updates, acc, inner = jax.lax.cond(
-            do_sync, sync_branch, skip_branch, (acc, state.inner))
+        updates, acc, inner, residual = jax.lax.cond(
+            do_sync, sync_branch, skip_branch,
+            (acc, state.inner, state.residual))
         counter = jnp.where(do_sync, 0, counter)
-        return updates, _AggState(counter=counter, acc=acc, inner=inner)
+        return updates, _AggState(counter=counter, acc=acc, inner=inner,
+                                  residual=residual)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -163,7 +214,8 @@ class ZeroGradientTransformation(NamedTuple):
 
 
 def ZeroShardedOptimizer(optimizer, op: int = C.Average,
-                         axis_name: Optional[str] = None):
+                         axis_name: Optional[str] = None,
+                         compression=None):
     """ZeRO-1 optimizer-state sharding over the data-parallel axis — a
     TPU-native capability beyond the reference (Horovod replicates
     optimizer state on every rank; here each dp rank owns 1/N of it,
@@ -182,6 +234,15 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     must be elementwise (sgd, momentum, adam, adamw, rmsprop, ...);
     cross-parameter reductions (e.g. global-norm clipping) would only
     see the local shard.
+
+    ``compression`` (``hvd.Compression.{bf16,int8,int4}``) routes the
+    gradient reduce-scatter through the quantized/cast one-pass schedule
+    (``ops.quantization.compressed_reducescatter``): contributions move
+    compressed, accumulation is fp32, and the optimizer sees a
+    full-precision gradient shard.  The all_gather of update shards
+    stays full-precision — updates feed ``optax.apply_updates`` directly
+    and, unlike gradients, have no error-feedback channel to absorb
+    quantization loss.
     """
     import optax
     from jax import lax
@@ -218,8 +279,10 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
         idx = lax.axis_index(ax)
 
         g_shards = jax.tree_util.tree_map(
-            lambda g: C.reducescatter(_pad_flat(g, world), op=op,
-                                      axis_name=ax), grads)
+            lambda g: C.reducescatter(
+                _pad_flat(g, world), op=op, axis_name=ax,
+                compression=(compression if C._compressible(g, op)
+                             else None)), grads)
         p_shards = None if params is None else jax.tree_util.tree_map(
             lambda p: _my_shard(p, world, idx), params)
         upd_shards, inner = optimizer.update(g_shards, state.inner,

@@ -53,10 +53,11 @@ def test_parameter_manager_applies_and_freezes():
     for _ in range(6):
         pm.record_bytes(1000)
     assert pm.frozen
-    fusion, cycle, har, hag, cache = pm.current
+    fusion, cycle, har, hag, cache, comp = pm.current
     assert 2 ** 20 <= fusion <= 2 ** 28
     assert 0.5 <= cycle <= 25.0
     assert all(isinstance(t, bool) for t in (har, hag, cache))
+    assert comp == "none"  # not tuned unless tune_compression=True
     # Final best re-applied.
     assert applied[-1] == pm.current
 
@@ -72,12 +73,13 @@ def test_parameter_manager_logs(tmp_path):
     assert len(lines) == 3  # 2 samples + final
     assert lines[-1].startswith("final,")
     # Each line records the categorical choices: tag, fusion, cycle,
-    # har, hag, cache, score.
+    # har, hag, cache, compression, score.
     for ln in lines:
         cols = ln.split(",")
-        assert len(cols) == 7, cols
+        assert len(cols) == 8, cols
         assert cols[3] in ("0", "1") and cols[4] in ("0", "1") \
             and cols[5] in ("0", "1"), cols
+        assert cols[6] in ("none", "bf16", "int8"), cols
 
 
 def test_parameter_manager_bootstrap_tries_both_toggle_values():
@@ -85,7 +87,7 @@ def test_parameter_manager_bootstrap_tries_both_toggle_values():
     categorical grids) must try each toggle's flipped value before EI
     takes over."""
     seen = []
-    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:]),
+    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:5]),
                           max_samples=8, window_seconds=0.0,
                           warmup_samples=0,
                           initial_toggles=(True, False, True))
@@ -102,7 +104,7 @@ def test_parameter_manager_pinned_toggle_never_flips():
     cache at capacity 0) is pinned: never flipped by the plan, never
     proposed by the GP."""
     seen = []
-    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:]),
+    pm = ParameterManager(apply_fn=lambda *p: seen.append(p[2:5]),
                           max_samples=10, window_seconds=0.0,
                           warmup_samples=0, seed=5,
                           initial_toggles=(True, False, True),
@@ -273,7 +275,7 @@ def test_autotune_disables_hierarchical_on_single_host(tmp_path):
     # the hierarchical-allreduce toggle were actually sampled.
     lines = [ln.split(",") for ln in
              open(log_file).read().strip().splitlines()]
-    assert all(len(ln) == 7 for ln in lines), lines
+    assert all(len(ln) == 8 for ln in lines), lines
     sampled_har = {ln[3] for ln in lines if ln[0] == "sample"}
     assert sampled_har == {"0", "1"}, lines
     assert lines[-1][0] == "final" and lines[-1][3] == "0", lines
