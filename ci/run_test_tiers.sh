@@ -46,6 +46,7 @@ TIER_FAST=(
   test_metrics.py
   test_optimizers.py test_parallel.py test_probe_rendezvous.py
   test_quantization.py
+  test_recovery.py
   test_resnet.py test_response_cache.py test_timeline.py
   test_transformer.py test_utils_ops.py
 )

@@ -46,6 +46,7 @@ from . import data
 from . import debug
 from . import elastic
 from . import metrics
+from . import recovery
 
 __all__ = [
     "__version__",
@@ -68,4 +69,5 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "mesh_lib", "checkpoint", "data", "debug", "elastic", "metrics",
+    "recovery",
 ]

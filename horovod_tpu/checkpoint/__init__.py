@@ -18,14 +18,15 @@ from .manifest import (
     LeafSpec, Manifest, shard_filename, step_dirname,
 )
 from .engine import (
-    commit, gc_steps, is_committed, latest_step, list_steps,
+    commit, gc_steps, is_committed, latest_step, list_steps, open_step,
     read_manifest, read_shard, restore_leaves, save_leaves, step_dir,
-    write_shard, RestoredStep,
+    write_shard, LazyStep, RestoredStep,
 )
 from .reshard import pad_flat, reassemble, reshard, shard_of
 from .zero import (
-    has_zero_leaves, is_zero_state,
-    restore_zero_state, save_zero_state, zero_init, zero_state_specs,
+    extract_zero_state, fingerprint_extra, has_zero_leaves,
+    is_zero_state, rebuild_restored, restore_zero_state, save_extracted,
+    save_zero_state, zero_init, zero_state_specs, ExtractedState,
 )
 from .data_state import (
     DATA_ITERS_KEY, restore_data_state, save_data_state,
@@ -35,11 +36,12 @@ __all__ = [
     "FORMAT_VERSION", "MANIFEST_NAME", "REPLICATED", "SHARDED",
     "LeafSpec", "Manifest", "shard_filename", "step_dirname",
     "commit", "gc_steps", "is_committed", "latest_step", "list_steps",
-    "read_manifest", "read_shard", "restore_leaves", "save_leaves",
-    "step_dir", "write_shard", "RestoredStep",
+    "open_step", "read_manifest", "read_shard", "restore_leaves",
+    "save_leaves", "step_dir", "write_shard", "LazyStep", "RestoredStep",
     "pad_flat", "reassemble", "reshard", "shard_of",
-    "has_zero_leaves", "is_zero_state",
-    "restore_zero_state", "save_zero_state", "zero_init",
-    "zero_state_specs",
+    "extract_zero_state", "fingerprint_extra", "has_zero_leaves",
+    "is_zero_state", "rebuild_restored", "restore_zero_state",
+    "save_extracted", "save_zero_state", "zero_init",
+    "zero_state_specs", "ExtractedState",
     "DATA_ITERS_KEY", "restore_data_state", "save_data_state",
 ]

@@ -222,7 +222,7 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
                 rank_values: Dict[int, List[Optional[np.ndarray]]],
                 world_size: int, *, committer: bool = True,
                 extra: Optional[dict] = None,
-                barrier=None) -> M.Manifest:
+                barrier=None, pre_commit=None) -> M.Manifest:
     """Write shard files for the ranks this process owns, then commit.
 
     ``rank_values[r]`` is the list of per-leaf host arrays for rank *r*
@@ -231,7 +231,9 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
     it).  Multi-controller callers pass only their own rank(s) and
     ``committer=rank 0``; ``barrier`` (when given) runs between the shard
     writes and the manifest commit so the committer cannot outrun a slow
-    writer.
+    writer.  ``pre_commit`` (when given) runs after the writes/barrier
+    and before the manifest — the chaos layer's commit-window crash
+    hook, placed exactly where a real crash would tear the step.
     """
     t0 = time.perf_counter()
     for rank, values in sorted(rank_values.items()):
@@ -243,6 +245,8 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
         write_shard(root, step, rank, world_size, arrays)
     if barrier is not None:
         barrier()
+    if pre_commit is not None:
+        pre_commit()
     manifest = M.Manifest(step=step, world_size=world_size, leaves=specs,
                           extra=extra or {})
     if committer:
@@ -271,7 +275,56 @@ def restore_leaves(root: str, step: int,
     return RestoredStep(manifest, shards, new_world_size)
 
 
-class RestoredStep:
+class _StepReader:
+    """Shared reshard-on-read logic for an opened committed step.
+
+    One copy of the replicated/same-world/resharded branching serves
+    every reader — the eager :class:`RestoredStep`, the streaming
+    :class:`LazyStep`, and the recovery tier's in-memory reassembly all
+    go through it, which is what makes their outputs bit-identical *by
+    construction*.  Subclasses supply only how bytes are fetched:
+    ``_one_shard(spec, rank)`` and ``_replicated_value(spec)``."""
+
+    manifest: M.Manifest
+    new_world_size: int
+
+    def _one_shard(self, spec: M.LeafSpec, rank: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def _replicated_value(self, spec: M.LeafSpec) -> np.ndarray:
+        raise NotImplementedError
+
+    def _leaf_shards(self, spec: M.LeafSpec) -> List[np.ndarray]:
+        return [self._one_shard(spec, r)
+                for r in range(self.manifest.world_size)]
+
+    def full_value(self, spec: M.LeafSpec) -> np.ndarray:
+        """The logical (unsharded, unpadded) value of a leaf."""
+        if spec.kind == M.REPLICATED:
+            return self._replicated_value(spec).reshape(spec.shape)
+        flat = R.reassemble(self._leaf_shards(spec), spec.true_size)
+        return flat.reshape(spec.shape)
+
+    def shard_value(self, spec: M.LeafSpec, rank: int) -> np.ndarray:
+        """Leaf value for rank ``rank`` of the NEW world (resharded)."""
+        if spec.kind == M.REPLICATED:
+            return self._replicated_value(spec).reshape(spec.shape)
+        if self.new_world_size == self.manifest.world_size:
+            return self._one_shard(spec, rank).reshape(-1)
+        return R.reshard(self._leaf_shards(spec), spec.true_size,
+                         self.new_world_size)[rank]
+
+    def padded_full(self, spec: M.LeafSpec) -> np.ndarray:
+        """The flat value padded for the NEW world size — the global
+        buffer a ``shard_map`` with ``P(axis)`` in-specs slices into
+        per-rank shards."""
+        if spec.kind == M.REPLICATED:
+            return self._replicated_value(spec).reshape(spec.shape)
+        flat = R.reassemble(self._leaf_shards(spec), spec.true_size)
+        return R.pad_flat(flat, self.new_world_size)
+
+
+class RestoredStep(_StepReader):
     """A committed step opened for restore, with reshard-on-read."""
 
     def __init__(self, manifest: M.Manifest,
@@ -281,29 +334,62 @@ class RestoredStep:
         self._shards = shards
         self.new_world_size = int(new_world_size)
 
-    def full_value(self, spec: M.LeafSpec) -> np.ndarray:
-        """The logical (unsharded, unpadded) value of a leaf."""
-        if spec.kind == M.REPLICATED:
-            return self._shards[0][spec.key].reshape(spec.shape)
-        flat = R.reassemble([s[spec.key] for s in self._shards],
-                            spec.true_size)
-        return flat.reshape(spec.shape)
+    def _one_shard(self, spec: M.LeafSpec, rank: int) -> np.ndarray:
+        return self._shards[rank][spec.key]
 
-    def shard_value(self, spec: M.LeafSpec, rank: int) -> np.ndarray:
-        """Leaf value for rank ``rank`` of the NEW world (resharded)."""
-        if spec.kind == M.REPLICATED:
-            return self._shards[0][spec.key].reshape(spec.shape)
-        if self.new_world_size == self.manifest.world_size:
-            return self._shards[rank][spec.key].reshape(-1)
-        return R.reshard([s[spec.key] for s in self._shards],
-                         spec.true_size, self.new_world_size)[rank]
+    def _replicated_value(self, spec: M.LeafSpec) -> np.ndarray:
+        return self._shards[0][spec.key]
 
-    def padded_full(self, spec: M.LeafSpec) -> np.ndarray:
-        """The flat value padded for the NEW world size — the global
-        buffer a ``shard_map`` with ``P(axis)`` in-specs slices into
-        per-rank shards."""
-        if spec.kind == M.REPLICATED:
-            return self._shards[0][spec.key].reshape(spec.shape)
-        flat = R.reassemble([s[spec.key] for s in self._shards],
-                            spec.true_size)
-        return R.pad_flat(flat, self.new_world_size)
+
+def open_step(root: str, step: int, new_world_size: int) -> "LazyStep":
+    """Open a committed step for STREAMING restore: shard files stay on
+    disk as lazily-indexed ``.npz`` handles and each leaf's arrays are
+    read only when that leaf is rebuilt — the restore machinery's
+    transient memory is O(largest leaf x old world) instead of O(total
+    state).  Same read surface (and bit-identical values) as
+    :func:`restore_leaves`; close the handle (context manager) when the
+    rebuild is done."""
+    if not is_committed(root, step):
+        raise FileNotFoundError(
+            f"step {step} in {root} is not a committed checkpoint "
+            "(torn write or wrong directory)")
+    manifest = read_manifest(root, step)
+    d = step_dir(root, step)
+    handles = [np.load(os.path.join(d, f))
+               for f in manifest.shard_filenames()]
+    _metrics()[3].inc()
+    return LazyStep(manifest, handles, new_world_size)
+
+
+class LazyStep(_StepReader):
+    """A committed step opened for per-leaf streaming reads: shard
+    bytes are fetched (and metered) from the lazily-indexed ``.npz``
+    handles only when the shared read logic asks for them."""
+
+    def __init__(self, manifest: M.Manifest, handles: List,
+                 new_world_size: int):
+        self.manifest = manifest
+        self._handles = handles
+        self.new_world_size = int(new_world_size)
+
+    def _one_shard(self, spec: M.LeafSpec, rank: int) -> np.ndarray:
+        a = self._handles[rank][spec.key]  # decompresses ONE zip member
+        _metrics()[1].inc(int(a.nbytes))
+        return a
+
+    def _replicated_value(self, spec: M.LeafSpec) -> np.ndarray:
+        return self._one_shard(spec, 0)
+
+    def close(self) -> None:
+        for h in self._handles:
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._handles = []
+
+    def __enter__(self) -> "LazyStep":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -27,7 +27,7 @@ the convention fails loudly at save time rather than corrupting state.
 from __future__ import annotations
 
 import math
-from typing import Any, List, Optional
+from typing import Any, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -332,6 +332,133 @@ def _check_run_fingerprint(root: str, fp: str, direction: str) -> None:
         f"HVD_TPU_CKPT_ALLOW_FOREIGN=1 to override.")
 
 
+class ExtractedState(NamedTuple):
+    """One commit's host-side payload: the leaf specs plus every locally
+    addressable rank's per-leaf arrays — the bytes the disk shards AND
+    the peer-replica tier both encode, extracted exactly once."""
+
+    specs: List[M.LeafSpec]
+    rank_values: dict             # {rank: [per-leaf host arrays]}
+    world: int
+    fingerprint: str              # world-size-invariant leaf-spec sha256
+    mesh_shape: dict              # {axis: size} of the extracting mesh
+
+
+def extract_zero_state(state, mesh=None,
+                       axis_name: Optional[str] = None) -> ExtractedState:
+    """Pull the per-rank host values out of a live pytree containing
+    ZeRO state — the extraction half of :func:`save_zero_state`, shared
+    with ``horovod_tpu.recovery``'s commit-time replication so disk
+    shards and peer replicas are the same bytes by construction."""
+    if mesh is None:
+        from ..core import basics
+        mesh = basics.mesh()
+    ax = _default_axis(axis_name)
+    world = _axis_world(mesh, ax)
+    plans, groups, _ = _plan_tree(state, world)
+
+    # Flight bracket: the device→host reads below block when a device
+    # computation is wedged — a rank hanging HERE must attribute as
+    # checkpoint-bound in a hang report, exactly like one stuck inside
+    # the shard writes (debug/hang.attribute pairs checkpoint.*.begin
+    # with any later checkpoint.* completion).
+    _flight.record("checkpoint.extract.begin", None, world=world)
+    try:
+        leaves = _ordered_leaves(state)
+        assert len(leaves) == len(plans)
+        owned = _owned_ranks(mesh, ax)
+        rank_values = {r: [None] * len(plans) for r in sorted(owned)}
+        for i, (leaf, plan) in enumerate(zip(leaves, plans)):
+            vals, _ = _leaf_rank_values(leaf, plan, world, mesh, ax)
+            for r, v in vals.items():
+                if r in rank_values:
+                    rank_values[r][i] = v
+    finally:
+        # Fires on failure too: a lingering begin would mis-attribute
+        # every later hang on this rank as checkpoint-bound.
+        _flight.record("checkpoint.extract.done", None, world=world)
+    # Every owned rank must hold a host value for every leaf, or the
+    # shard file would silently omit a key and the gap would surface
+    # only as a restore-time KeyError — after good steps may have been
+    # GC'd.  Fail loudly at save time instead.
+    for r, vals in rank_values.items():
+        missing = [plans[i].spec.path
+                   for i, v in enumerate(vals) if v is None]
+        if missing:
+            raise ValueError(
+                f"rank {r}: no host value recovered for leaves "
+                f"{missing}; was the state threaded with "
+                "zero_state_specs so every local shard is addressable?")
+    specs = [p.spec for p in plans]
+    return ExtractedState(
+        specs=specs, rank_values=rank_values, world=world,
+        fingerprint=M.spec_fingerprint(specs),
+        mesh_shape={str(a): int(mesh.shape[a]) for a in mesh.axis_names})
+
+
+def fingerprint_extra(ext: ExtractedState,
+                      extra: Optional[dict] = None) -> dict:
+    """``extra`` with the run fingerprint stamped — the manifest payload
+    both the disk commit and the replica entries carry."""
+    extra = dict(extra or {})
+    extra[M.RUN_FINGERPRINT_KEY] = {
+        "leaf_spec_sha256": ext.fingerprint,
+        "mesh_shape": dict(ext.mesh_shape),
+        "world_size": ext.world,
+    }
+    return extra
+
+
+def save_extracted(root: str, ext: ExtractedState, step: int,
+                   keep: Optional[int] = None,
+                   extra: Optional[dict] = None) -> M.Manifest:
+    """Write one committed step from an already-extracted payload — the
+    durable half of :func:`save_zero_state`, also what the async
+    committer flushes from its background thread (extraction must
+    happen at the commit point; the disk write need not)."""
+    # Flight recorder: a rank that stops submitting collectives while
+    # inside this call (shard writes, the commit barrier) attributes as
+    # checkpoint-bound in a hang report — the begin event with no commit
+    # after it is the signal.
+    _flight.record("checkpoint.save.begin", root, step=int(step))
+    # Run fingerprint: refuse to interleave a DIFFERENT run's steps into
+    # this directory (same fingerprint check as restore — a foreign
+    # save would poison `latest` resolution for both runs).
+    _check_run_fingerprint(root, ext.fingerprint, direction="save")
+    extra = fingerprint_extra(ext, extra)
+
+    from ..core.state import global_state
+    barrier = None
+    committer = True
+    if global_state.initialized and global_state.process_count > 1:
+        from ..ops import collective as C
+        barrier = C.barrier
+        committer = global_state.process_rank == 0
+    # Chaos drill hook: a scheduled commit-window crash lands between
+    # the shard writes and the manifest — the torn-step window the
+    # engine's manifest-last protocol (and the replica tier's seal)
+    # exists for.
+    from ..recovery.chaos import chaos as _chaos
+
+    def _pre_commit():
+        _chaos().maybe_crash("pre_manifest", int(step))
+
+    manifest = E.save_leaves(
+        root, step, ext.specs, ext.rank_values, ext.world,
+        committer=committer, extra=extra, barrier=barrier,
+        pre_commit=_pre_commit)
+    if keep is not None and committer:
+        E.gc_steps(root, keep=keep)
+    if barrier is not None:
+        # Post-commit barrier: when save_zero_state returns on ANY
+        # process, the manifest is durably on disk — callers (e.g. the
+        # elastic commit loop) can key decisions off `latest_step`
+        # without racing the committer's manifest write.
+        barrier()
+    _flight.record("checkpoint.save.commit", root, step=int(step))
+    return manifest
+
+
 def save_zero_state(root: str, state, step: int, mesh=None,
                     axis_name: Optional[str] = None,
                     keep: Optional[int] = None,
@@ -345,113 +472,28 @@ def save_zero_state(root: str, state, step: int, mesh=None,
     from the manifest, and only process 0 commits — the engine's
     write-shards-then-commit protocol.
     """
-    import jax
-    if mesh is None:
-        from ..core import basics
-        mesh = basics.mesh()
-    # Flight recorder: a rank that stops submitting collectives while
-    # inside this call (shard writes, the commit barrier) attributes as
-    # checkpoint-bound in a hang report — the begin event with no commit
-    # after it is the signal.
-    _flight.record("checkpoint.save.begin", root, step=int(step))
-    ax = _default_axis(axis_name)
-    world = _axis_world(mesh, ax)
-    plans, groups, _ = _plan_tree(state, world)
-
-    leaves = _ordered_leaves(state)
-    assert len(leaves) == len(plans)
-    owned = _owned_ranks(mesh, ax)
-    rank_values = {r: [None] * len(plans) for r in sorted(owned)}
-    for i, (leaf, plan) in enumerate(zip(leaves, plans)):
-        vals, _ = _leaf_rank_values(leaf, plan, world, mesh, ax)
-        for r, v in vals.items():
-            if r in rank_values:
-                rank_values[r][i] = v
-    # Every owned rank must hold a host value for every leaf, or the
-    # shard file would silently omit a key and the gap would surface
-    # only as a restore-time KeyError — after good steps may have been
-    # GC'd.  Fail loudly at save time instead.
-    for r, vals in rank_values.items():
-        missing = [plans[i].spec.path
-                   for i, v in enumerate(vals) if v is None]
-        if missing:
-            raise ValueError(
-                f"rank {r}: no host value recovered for leaves "
-                f"{missing}; was the state threaded with "
-                "zero_state_specs so every local shard is addressable?")
-
-    # Run fingerprint: refuse to interleave a DIFFERENT run's steps into
-    # this directory (same fingerprint check as restore — a foreign
-    # save would poison `latest` resolution for both runs).
-    specs = [p.spec for p in plans]
-    fp = M.spec_fingerprint(specs)
-    _check_run_fingerprint(root, fp, direction="save")
-    extra = dict(extra or {})
-    extra[M.RUN_FINGERPRINT_KEY] = {
-        "leaf_spec_sha256": fp,
-        "mesh_shape": {str(a): int(mesh.shape[a])
-                       for a in mesh.axis_names},
-        "world_size": world,
-    }
-
-    from ..core.state import global_state
-    barrier = None
-    committer = True
-    if global_state.initialized and global_state.process_count > 1:
-        from ..ops import collective as C
-        barrier = C.barrier
-        committer = global_state.process_rank == 0
-    manifest = E.save_leaves(
-        root, step, specs, rank_values, world,
-        committer=committer, extra=extra, barrier=barrier)
-    if keep is not None and committer:
-        E.gc_steps(root, keep=keep)
-    if barrier is not None:
-        # Post-commit barrier: when save_zero_state returns on ANY
-        # process, the manifest is durably on disk — callers (e.g. the
-        # elastic commit loop) can key decisions off `latest_step`
-        # without racing the committer's manifest write.
-        barrier()
-    _flight.record("checkpoint.save.commit", root, step=int(step))
-    return manifest
+    ext = extract_zero_state(state, mesh=mesh, axis_name=axis_name)
+    return save_extracted(root, ext, step, keep=keep, extra=extra)
 
 
-def restore_zero_state(root: str, like, mesh=None,
-                       axis_name: Optional[str] = None,
-                       step: Optional[int] = None):
-    """Restore the newest committed step (or ``step``) into the structure
-    of ``like``, resharded for the current world size.
-
-    ``like`` supplies the pytree structure only (e.g. the pre-failure
-    state object, or a fresh ``zero_init``); vector moment leaves come
-    back as full padded flat buffers for THIS world — thread them with
-    ``zero_state_specs`` and every rank sees exactly its shard, even
-    when the checkpoint was written by a different number of ranks.
-    """
-    import jax
+def rebuild_restored(restored, like, source: str = "the checkpoint"):
+    """Rebuild ``like``'s pytree from an opened step — anything exposing
+    ``manifest``, ``full_value(spec)`` and ``padded_full(spec)``:
+    ``engine.RestoredStep`` (disk, eager), ``engine.LazyStep`` (disk,
+    streaming) or the recovery tier's in-memory reassembly.  One rebuild
+    path means a peer restore is bit-identical to the disk restore of
+    the same step by construction."""
     import jax.numpy as jnp
-    if mesh is None:
-        from ..core import basics
-        mesh = basics.mesh()
-    ax = _default_axis(axis_name)
-    world = _axis_world(mesh, ax)
-    if step is None:
-        step = E.latest_step(root)
-        if step is None:
-            raise FileNotFoundError(
-                f"no committed checkpoint step under {root}")
-    _flight.record("checkpoint.restore.begin", root, step=int(step))
-    restored = E.restore_leaves(root, step, world)
-    # Cross-run guard: the checkpoint's stamped fingerprint must match
-    # the restore target's structure (world-size-invariant, so elastic
-    # N→M restores of the same run always pass).
+    # Cross-run guard: the stamped fingerprint must match the restore
+    # target's structure (world-size-invariant, so elastic N→M restores
+    # of the same run always pass).
     target_plans, _, _ = _plan_tree(like, restored.manifest.world_size,
                                     validate=False)
     target_fp = M.spec_fingerprint([p.spec for p in target_plans])
     saved_fp = _recorded_fingerprint(restored.manifest)
     if saved_fp != target_fp and not _foreign_allowed():
         raise ValueError(
-            f"step {step} under {root} was written by a different run: "
+            f"{source} was written by a different run: "
             f"checkpoint leaf-spec fingerprint {saved_fp[:12]}... != "
             f"restore target's {target_fp[:12]}... (different model/"
             f"optimizer structure, dtypes or sizes).  Refusing the "
@@ -467,7 +509,51 @@ def restore_zero_state(root: str, like, mesh=None,
             new_leaves.append(restored.full_value(spec))
         else:
             new_leaves.append(jnp.asarray(restored.padded_full(spec)))
-    out = _rebuild(groups, outer_def, new_leaves)
+    return _rebuild(groups, outer_def, new_leaves)
+
+
+def restore_zero_state(root: str, like, mesh=None,
+                       axis_name: Optional[str] = None,
+                       step: Optional[int] = None,
+                       streaming: Optional[bool] = None):
+    """Restore the newest committed step (or ``step``) into the structure
+    of ``like``, resharded for the current world size.
+
+    ``like`` supplies the pytree structure only (e.g. the pre-failure
+    state object, or a fresh ``zero_init``); vector moment leaves come
+    back as full padded flat buffers for THIS world — thread them with
+    ``zero_state_specs`` and every rank sees exactly its shard, even
+    when the checkpoint was written by a different number of ranks.
+
+    ``streaming`` (default ``HVD_TPU_CKPT_STREAMING``, off) reads the
+    shard files one LEAF at a time instead of loading every shard up
+    front: the restore machinery's transient memory drops from O(total
+    state) to O(largest leaf x old world) — the path for states that
+    would not fit in host RAM twice.  Bit-identical output either way;
+    see docs/checkpointing.md.
+    """
+    if mesh is None:
+        from ..core import basics
+        mesh = basics.mesh()
+    ax = _default_axis(axis_name)
+    world = _axis_world(mesh, ax)
+    if streaming is None:
+        from ..core.config import Config, get_bool
+        streaming = get_bool("CKPT_STREAMING", Config.ckpt_streaming)
+    if step is None:
+        step = E.latest_step(root)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint step under {root}")
+    _flight.record("checkpoint.restore.begin", root, step=int(step),
+                   streaming=bool(streaming))
+    source = f"step {step} under {root}"
+    if streaming:
+        with E.open_step(root, step, world) as restored:
+            out = rebuild_restored(restored, like, source=source)
+    else:
+        restored = E.restore_leaves(root, step, world)
+        out = rebuild_restored(restored, like, source=source)
     _flight.record("checkpoint.restore.done", root, step=int(step))
     return out
 

@@ -245,6 +245,24 @@ def init(mesh=None,
                     report_dir=global_state.config.flight_dir,
                     rdv_addr=_rdv)
 
+    # --- peer-to-peer hot recovery ----------------------------------------
+    # Multi-process jobs with a rendezvous KV publish the replica
+    # endpoint so buddies can push committed shards across processes
+    # (horovod_tpu/recovery/transport.py).  Single-controller jobs need
+    # none of this — every rank's store is this process's store.  Like
+    # the debug endpoint, serving is idempotent across elastic rounds
+    # and a bind failure degrades (the peer tier falls back to disk).
+    if global_state.config.recovery and global_state.controller is not None:
+        _rdv = _os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+        if _rdv:
+            try:
+                from .. import recovery as _recovery
+                _recovery.transport.serve_and_publish(
+                    rank=global_state.controller.rank(), rdv_addr=_rdv)
+            except OSError as e:
+                log.warning("recovery: cannot serve replica endpoint "
+                            "(%s); peer tier degraded to disk", e)
+
     global_state.elastic_enabled = global_state.config.elastic
     global_state.initialized = True
     log.debug(

@@ -55,6 +55,18 @@ FLIGHT_DIR = "FLIGHT_DIR"                      # dumps + hang reports
 FLIGHT_PORT = "FLIGHT_PORT"                    # debug endpoint; 0 = ephemeral
 FLIGHT_LAST_EVENTS = "FLIGHT_LAST_EVENTS"      # events quoted per rank
 FLIGHT_ESCALATE = "FLIGHT_ESCALATE"            # stall -> hang report
+# Peer-to-peer hot recovery (horovod_tpu/recovery/).
+RECOVERY = "RECOVERY"                          # buddy replication + peer restore
+RECOVERY_STRIDE = "RECOVERY_STRIDE"            # buddy ring shift; 0 = local size
+ASYNC_COMMIT = "ASYNC_COMMIT"                  # background disk committer
+CKPT_STREAMING = "CKPT_STREAMING"              # per-leaf streaming restore
+# Deterministic fault injection (horovod_tpu/recovery/chaos.py).  The
+# chaos layer is inert unless at least one CHAOS_* knob is set.
+CHAOS_SEED = "CHAOS_SEED"                      # schedule seed
+CHAOS_KILL_STEPS = "CHAOS_KILL_STEPS"          # "rank@step,..." kill schedule
+CHAOS_COMMIT_CRASH = "CHAOS_COMMIT_CRASH"      # "<point>[@step]" crash point
+CHAOS_SLOW_PEER_MS = "CHAOS_SLOW_PEER_MS"      # peer-serving latency injection
+CHAOS_TORN_RANKS = "CHAOS_TORN_RANKS"          # corrupt these ranks' replicas
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -148,6 +160,17 @@ class Config:
     flight_port: int = 0
     flight_last_events: int = 20
     flight_escalate: bool = True
+    # Peer-to-peer hot recovery: buddy replication of committed ZeRO
+    # shards + peer-first elastic restore (disk stays the correlated-
+    # failure fallback).  Async commit overlaps the disk write with the
+    # next training steps (single-controller only — the commit barrier
+    # of a multi-controller save is a collective that cannot run on a
+    # background thread).  Streaming restore reads one leaf at a time
+    # so restore's transient memory is O(largest leaf), not O(state).
+    recovery: bool = True
+    recovery_stride: int = 0   # 0 = auto: the local world size
+    async_commit: bool = False
+    ckpt_streaming: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -208,6 +231,11 @@ class Config:
         cfg.flight_last_events = max(
             1, get_int(FLIGHT_LAST_EVENTS, cfg.flight_last_events))
         cfg.flight_escalate = get_bool(FLIGHT_ESCALATE, cfg.flight_escalate)
+        cfg.recovery = get_bool(RECOVERY, cfg.recovery)
+        cfg.recovery_stride = max(
+            0, get_int(RECOVERY_STRIDE, cfg.recovery_stride))
+        cfg.async_commit = get_bool(ASYNC_COMMIT, cfg.async_commit)
+        cfg.ckpt_streaming = get_bool(CKPT_STREAMING, cfg.ckpt_streaming)
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

@@ -108,7 +108,22 @@ def build_hang_report(stalled: List[dict],
             s.get("type"), str(s.get("type")))) for s in stalled],
         "missing_ranks": missing_union,
         "ranks": ranks,
+        # The last recovery decision on THIS process (path peer/disk/
+        # none, bytes, latency): a hang right after an elastic reset
+        # reads differently when the report shows hot recovery already
+        # succeeded — or that it fell back to disk and is still
+        # restoring.  None when no restore has run.
+        "recovery": _last_recovery(),
     }
+
+
+def _last_recovery() -> Optional[dict]:
+    try:
+        from ..recovery import last_report
+        report = last_report()
+        return None if report is None else report.to_dict()
+    except Exception:  # noqa: BLE001 — diagnosis best-effort
+        return None
 
 
 class StallWatchdog:

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import os
-import socket
 import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -41,14 +40,8 @@ def request_authorized(headers, key: str) -> bool:
     pass, like the KV server's unsigned mode.  Shared by the standalone
     debug endpoint AND the metrics-port mount, so setting the secret
     protects every copy of these paths."""
-    import hmac
-    from ..runner.rendezvous import _SIG_HEADER, _env_secret, _signature
-    secret = _env_secret()
-    if not secret:
-        return True
-    provided = headers.get(_SIG_HEADER, "")
-    return hmac.compare_digest(
-        provided, _signature(secret, "GET", "debug", key))
+    from ..runner.rendezvous import request_authorized as _authorized
+    return _authorized(headers, "GET", "debug", key)
 
 
 def render_stacks_text() -> bytes:
@@ -147,13 +140,8 @@ def stop_serving() -> None:
 
 
 def _my_host() -> str:
-    host = os.environ.get("HVD_TPU_FLIGHT_HOST")
-    if host:
-        return host
-    try:
-        return socket.gethostbyname(socket.gethostname())
-    except OSError:
-        return "127.0.0.1"
+    from ..runner.rendezvous import advertised_host
+    return advertised_host()
 
 
 def flight_addr_key(rank: int) -> str:
@@ -183,12 +171,9 @@ def fetch_flight_dump(addr: str, timeout: float = 3.0) -> Optional[dict]:
     """GET one rank's ``/debug/flight`` (signed with the launch secret
     when one is set); None when unreachable/invalid."""
     import urllib.request
-    from ..runner.rendezvous import _SIG_HEADER, _env_secret, _signature
+    from ..runner.rendezvous import sign_request
     req = urllib.request.Request(f"http://{addr}/debug/flight")
-    secret = _env_secret()
-    if secret:
-        req.add_header(_SIG_HEADER,
-                       _signature(secret, "GET", "debug", "flight"))
+    sign_request(req, "GET", "debug", "flight")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read().decode("utf-8"))

@@ -193,6 +193,46 @@ def _is_data_iterator(x) -> bool:
 _DATA_DIR_KEY = "data_iters"
 
 
+class _AsyncCommitter:
+    """One-deep background disk flush: ``submit`` hands the previous
+    step's ``save_extracted`` to a daemon thread and returns; ``wait``
+    joins it and re-raises its failure.  The NEXT ``commit()`` waits
+    first (the satellite's "commit barrier only at the next commit"), so
+    disk durability leaves the hot path but a flush can never overlap
+    the next step's writes to the same directory."""
+
+    def __init__(self):
+        self._thread: Optional["threading.Thread"] = None
+        self._exc: Optional[BaseException] = None
+
+    def submit(self, fn: Callable[[], Any]) -> None:
+        import threading
+        self.wait()
+
+        def _run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                self._exc = e
+
+        self._thread = threading.Thread(
+            target=_run, name="hvd-tpu-async-commit", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        exc, self._exc = self._exc, None
+        if exc is not None:
+            raise exc
+
+    @property
+    def pending(self) -> bool:
+        return self._thread is not None
+
+
 class TpuState(ObjectState):
     """Elastic state for JAX training: params/opt_state pytrees snapshotted
     to host memory on commit, broadcast from rank 0 on sync (the analog of
@@ -221,10 +261,49 @@ class TpuState(ObjectState):
     broadcasts the committed position — then each loader reshards its
     remaining epoch to the new world (``load_state_dict`` re-resolves
     topology).  A mid-epoch restore resumes with no duplicated and no
-    dropped samples; see docs/data.md."""
+    dropped samples; see docs/data.md.
+
+    Peer-to-peer hot recovery (``peer_recovery``, default
+    ``HVD_TPU_RECOVERY`` = on): ``commit()`` also places each rank's
+    committed shard (data-iterator state riding along, as on disk) in
+    the in-memory replica tier — its own copy locally, a buddy copy
+    with ``recovery.replica_holder(rank)`` — and ``sync()`` tries to
+    reassemble the state from fleet memory BEFORE reading the disk
+    manifest, so an elastic resize after a single-rank loss restores in
+    peer-exchange time with disk as the correlated-failure fallback.
+    Works with no ``checkpoint_dir`` at all (disk-free restarts), at
+    the durability of the surviving processes' memory.  ``sync()``
+    records which path won (``peer`` / ``disk`` / ``none``) in
+    ``hvd.metrics``, the flight recorder, and hang reports.
+
+    Async snapshot commit (``async_commit``, default
+    ``HVD_TPU_ASYNC_COMMIT`` = off; single-controller only — a
+    multi-controller save barriers on a collective that cannot run on a
+    background thread): ``commit()`` extracts the host payload, places
+    replicas, and hands the disk write to a background committer; the
+    commit barrier moves to the NEXT ``commit()``/``sync()``, so both
+    durability tiers leave the hot path.  See docs/recovery.md."""
 
     def __init__(self, params=None, opt_state=None, checkpoint_dir=None,
-                 checkpoint_keep: int = 3, checkpoint_mesh=None, **kwargs):
+                 checkpoint_keep: int = 3, checkpoint_mesh=None,
+                 peer_recovery: Optional[bool] = None,
+                 async_commit: Optional[bool] = None, **kwargs):
+        # Knob defaults single-sourced from core.config.Config (the
+        # PR 4 flight-knob convention), env override per state object.
+        from ..core.config import Config, get_bool
+        self._peer_explicit = peer_recovery is not None
+        self._peer_recovery = (get_bool("RECOVERY", Config.recovery)
+                               if peer_recovery is None
+                               else bool(peer_recovery))
+        self._async_commit = (get_bool("ASYNC_COMMIT",
+                                       Config.async_commit)
+                              if async_commit is None
+                              else bool(async_commit))
+        self._committer = _AsyncCommitter()
+        self._extract_disabled = set()
+        # (key, step) pairs whose async flush died before the replica
+        # seal: pruned from _ckpt_committed_step at the next barrier.
+        self._ckpt_failed = set()
         self._tree_keys = []
         self._data_keys = [k for k, v in kwargs.items()
                            if _is_data_iterator(v)]
@@ -276,13 +355,67 @@ class TpuState(ObjectState):
         # save_zero_state's post-commit barrier making the manifest
         # durable before any process moves on.
         if key not in self._ckpt_next_step:
-            from ..checkpoint import latest_step
-            latest = latest_step(self._zero_dir(key))
+            latest = None
+            if self._checkpoint_dir is not None:
+                from ..checkpoint import latest_step
+                latest = latest_step(self._zero_dir(key))
             self._ckpt_next_step[key] = 0 if latest is None else latest + 1
         return self._ckpt_next_step[key]
 
+    def _async_effective(self) -> bool:
+        if not self._async_commit:
+            return False
+        if global_state.initialized and global_state.process_count > 1:
+            # Multi-controller save_extracted barriers on a collective;
+            # running it on a background thread would interleave with
+            # training collectives.  Degrade to the synchronous write.
+            return False
+        return True
+
+    def _extract_for_commit(self, key: str):
+        """Extracted host payload for one ZeRO tree, or None when the
+        state is not globally threaded AND nothing requires it (peer
+        replication enabled only by default, no checkpoint_dir) — then
+        the tier degrades exactly like the pre-recovery behavior of a
+        dir-less TpuState.  The failure is latched per key: one warning
+        and one failed extraction attempt, not one per commit."""
+        if key in self._extract_disabled:
+            return None
+        from ..checkpoint import extract_zero_state
+        try:
+            return extract_zero_state(getattr(self, key),
+                                      mesh=self._mesh())
+        except ValueError:
+            if self._checkpoint_dir is not None or self._peer_explicit:
+                raise
+            self._extract_disabled.add(key)
+            log.warning(
+                "TpuState.%s: cannot extract ZeRO shards for peer "
+                "replication (state not threaded with zero_state_specs);"
+                " the in-memory recovery tier is disabled for it", key)
+            return None
+
+    def _prune_failed_steps(self):
+        """Drop committed-step records whose async flush failed before
+        any tier held the step — a pinned ghost step would force sync's
+        peer AND disk lookups to miss and silently restore one step
+        behind the params."""
+        while self._ckpt_failed:
+            k, s = self._ckpt_failed.pop()
+            if self._ckpt_committed_step.get(k) == s:
+                self._ckpt_committed_step.pop(k)
+
+    def _seal_replicas(self, saved_steps: Dict[str, int], exts: dict):
+        if not self._peer_recovery:
+            return
+        from .. import recovery
+        for k, step in saved_steps.items():
+            if k in exts:
+                recovery.seal_commit(k, step, ext=exts[k])
+
     def commit(self):
         saved_steps = {}
+        exts = {}
         # Iterator state is captured ONCE here and stamped into every
         # manifest this commit writes: the committed step atomically
         # pairs optimizer moments with the input position, so a restore
@@ -293,25 +426,105 @@ class TpuState(ObjectState):
         if data_states:
             from ..checkpoint import DATA_ITERS_KEY
             extra = {DATA_ITERS_KEY: data_states}
-        if self._checkpoint_dir is not None:
-            from ..checkpoint import save_zero_state
-            for k in self._tree_keys:
-                tree = getattr(self, k)
-                if _has_zero_sharded(tree):
-                    step = self._next_ckpt_step(k)
-                    save_zero_state(self._zero_dir(k), tree, step=step,
-                                    mesh=self._mesh(),
-                                    keep=self._checkpoint_keep,
-                                    extra=extra)
-                    self._ckpt_next_step[k] = step + 1
-                    saved_steps[k] = step
-            if data_states and not saved_steps:
-                # No ZeRO tree to ride: iterator state gets its own
-                # (tiny) engine step — same durability protocol.
-                step = self._next_ckpt_step(_DATA_DIR_KEY)
-                self._commit_data_step(step, data_states)
-                self._ckpt_next_step[_DATA_DIR_KEY] = step + 1
-                saved_steps[_DATA_DIR_KEY] = step
+        zero_keys = [k for k in self._tree_keys
+                     if _has_zero_sharded(getattr(self, k))]
+        if zero_keys and (self._checkpoint_dir is not None
+                          or self._peer_recovery):
+            from ..checkpoint import save_extracted
+            from ..recovery.chaos import chaos
+            # Async commit barrier: the previous step's background
+            # flush must land (and surface its failure) before this
+            # step writes the same directories.
+            try:
+                self._committer.wait()
+            finally:
+                self._prune_failed_steps()
+            use_async = self._async_effective()
+            for k in zero_keys:
+                ext = self._extract_for_commit(k)
+                if ext is None:
+                    continue
+                step = self._next_ckpt_step(k)
+                root = (None if self._checkpoint_dir is None
+                        else self._zero_dir(k))
+                keep = self._checkpoint_keep
+
+                def _flush(k=k, ext=ext, step=step, root=root,
+                           sealing=use_async):
+                    """Replication + disk write + (async mode) seal —
+                    the whole durability tail of one commit.  Runs
+                    inline in sync mode, on the committer thread in
+                    async mode, so BOTH tiers leave the hot path.  An
+                    async failure BEFORE the seal marks the step failed
+                    (``_ckpt_failed``) so the committed-step record —
+                    already updated by the time the background failure
+                    lands — cannot pin a step that exists in no tier."""
+                    try:
+                        if self._peer_recovery:
+                            from .. import recovery
+                            recovery.replicate(k, step, ext, extra=extra)
+                        # Chaos drill: the commit window where the
+                        # replica is placed (unsealed) but the step is
+                        # not yet committed anywhere.  In async mode
+                        # the scheduled crash surfaces at the next
+                        # commit barrier.
+                        chaos().maybe_crash("after_replicate", step)
+                        if root is None and self._peer_recovery and \
+                                global_state.initialized and \
+                                global_state.process_count > 1:
+                            # Disk-free multi-controller: the disk
+                            # path's pre-commit barrier is what kept
+                            # one rank from sealing step N+1
+                            # (overwriting its only sealed copy of N)
+                            # while a slower rank had not yet
+                            # replicated N+1 — without it a kill in
+                            # that skew window would leave NO fully
+                            # covered step.  Replication needs the
+                            # same barrier.
+                            from ..ops import collective as C
+                            C.barrier()
+                        if sealing and self._peer_recovery:
+                            # Async mode: seal BEFORE the disk write,
+                            # not after — the replica tier's commit
+                            # record must not depend on the disk flush
+                            # succeeding, or a disk failure would void
+                            # an already-successful replication and
+                            # sync() would pair step-N params with
+                            # step-(N-1) moments.
+                            from .. import recovery
+                            recovery.seal_commit(k, step, ext=ext)
+                    except BaseException:
+                        if sealing:
+                            # Failed before the seal: the step exists
+                            # in NO tier, and the committed-step record
+                            # (updated on the main thread) must not pin
+                            # it — pruned at the next barrier.
+                            self._ckpt_failed.add((k, step))
+                        raise
+                    if root is not None:
+                        save_extracted(root, ext, step, keep=keep,
+                                       extra=extra)
+
+                if use_async:
+                    # The seal rides the background flush: the replica
+                    # tier's commit record lands when the flush does —
+                    # a crash before it restores the previous sealed
+                    # step, the exact durability the disk tier offers
+                    # for an unflushed manifest.
+                    self._committer.submit(_flush)
+                else:
+                    _flush()
+                    exts[k] = ext  # sealed after super().commit()
+                self._ckpt_next_step[k] = step + 1
+                saved_steps[k] = step
+        if self._checkpoint_dir is not None and data_states \
+                and not saved_steps:
+            # No ZeRO tree to ride: iterator state gets its own
+            # (tiny) engine step — same durability protocol.
+            step = self._next_ckpt_step(_DATA_DIR_KEY)
+            self._commit_data_step(step, data_states)
+            self._ckpt_next_step[_DATA_DIR_KEY] = step + 1
+            saved_steps[_DATA_DIR_KEY] = step
         try:
             super().commit()
         except HostsUpdatedInterrupt:
@@ -319,9 +532,12 @@ class TpuState(ObjectState):
             # step IS fully committed (disk AND snapshot); the interrupt
             # only re-runs rendezvous.  Record it, or the next sync()
             # would pair current params with one-step-old moments.
+            # Replica entries seal here too: they carry the same commit.
             self._ckpt_committed_step.update(saved_steps)
+            self._seal_replicas(saved_steps, exts)
             raise
         self._ckpt_committed_step.update(saved_steps)
+        self._seal_replicas(saved_steps, exts)
 
     def _read_data_iters_from_disk(self, chosen: dict):
         """The committed iterator-state payload: from the chosen (or
@@ -383,11 +599,46 @@ class TpuState(ObjectState):
             getattr(self, k).load_state_dict(
                 copy.deepcopy(self._saved_data[k]))
 
+    def _record_recovery_path(self, path: str, key: str,
+                              step: Optional[int], reason: str):
+        """Fold a non-peer restore decision into the same observability
+        surface peer restores use (metrics + flight + last_report), so
+        hang reports can attribute EVERY recovery, not just the hot
+        ones."""
+        import time
+        from .. import recovery
+        from ..metrics.registry import registry
+        registry().counter("hvd_recovery_restores_total",
+                           "Recovery restore decisions by path",
+                           path=path).inc()
+        recovery.record_report(recovery.RecoveryReport(
+            path=path, key=key, step=step, reason=reason,
+            wall=time.time()))
+        _flight.record("recovery.restore.done", key, path=path,
+                       step=step)
+
     def sync(self, root: Optional[int] = None):
         from ..optimizers import broadcast_parameters
         _flight.record("elastic.sync", None, root=root)
         if root is None:
             root = self.elect_sync_root()
+        # A pending async flush must land before this sync trusts disk
+        # state.  Its failure degrades (the replica tier seals before
+        # the disk write, so it usually still covers the step; a
+        # pre-seal failure is pruned from the committed record) rather
+        # than killing the round — but it can mean this sync restores
+        # the PREVIOUS committed moments under newer live params, so
+        # say so loudly.
+        try:
+            self._committer.wait()
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal here
+            log.warning(
+                "async checkpoint flush failed (%r); the disk tier may "
+                "lag the replica tier this round, and if the failure "
+                "preceded the replica seal this sync restores the "
+                "previous committed step", e)
+        finally:
+            self._prune_failed_steps()
         # Membership changed: drop cached commit-step counters so every
         # member (survivor or fresh) re-seeds from the same committed
         # disk state — a survivor's counter may be ahead of disk if the
@@ -405,13 +656,45 @@ class TpuState(ObjectState):
             from ..optimizers import broadcast_object
             chosen = broadcast_object(chosen, root_rank=root)
             self._ckpt_committed_step = dict(chosen)
+        if self._checkpoint_dir is None:
+            # Disk-free mode has no disk `latest` to re-seed the cleared
+            # step counters from: seed from the agreed committed record,
+            # so fresh members and survivors keep committing at the
+            # SAME, still-monotonic steps.  Restarting at 0 would both
+            # desync mixed rounds and leave a superseded world's
+            # higher-step replicas unprunable (seal's stale-world sweep
+            # compares steps) — resident forever and able to outvote
+            # the live run in a newest-covered-step election.
+            for k, s in chosen.items():
+                self._ckpt_next_step[k] = int(s) + 1
+        peer_extra = None
         for k in self._tree_keys:
             tree = getattr(self, k)
             if _has_zero_sharded(tree):
                 # Rank-distinct shards cannot ride the broadcast — rank
-                # 0's slice would overwrite every other rank's.  Restore
-                # the newest committed engine step instead, resharding
-                # the flat moment buffers when the elastic world resized.
+                # 0's slice would overwrite every other rank's.  First
+                # choice: reassemble the committed step from the
+                # fleet's replica memory (peer restore — seconds, no
+                # disk round-trip); the gather is a collective, and its
+                # input is identical on every member, so the peer-vs-
+                # disk decision is fleet-consistent by construction.
+                if self._peer_recovery:
+                    from .. import recovery
+                    try:
+                        new_tree, pextra, _rep = recovery.peer_restore(
+                            k, tree, mesh=self._mesh(),
+                            step=chosen.get(k))
+                        setattr(self, k, new_tree)
+                        if peer_extra is None and pextra:
+                            peer_extra = pextra
+                        continue
+                    except recovery.PeerRestoreUnavailable as e:
+                        log.info("recovery: peer restore unavailable "
+                                 "for %s (%s); falling back to the "
+                                 "disk manifest", k, e)
+                # Disk fallback: restore the newest committed engine
+                # step, resharding the flat moment buffers when the
+                # elastic world resized.
                 if self._checkpoint_dir is not None:
                     from ..checkpoint import (is_committed, latest_step,
                                               restore_zero_state)
@@ -425,13 +708,30 @@ class TpuState(ObjectState):
                         setattr(self, k, restore_zero_state(
                             self._zero_dir(k), tree, mesh=self._mesh(),
                             step=step))
+                        if self._peer_recovery:
+                            self._record_recovery_path(
+                                "disk", k, step,
+                                "peer coverage unavailable; disk "
+                                "manifest restored")
                         continue
+                    if self._peer_recovery:
+                        self._record_recovery_path(
+                            "none", k, None,
+                            "no peer coverage and no committed disk "
+                            "step (pre-first-commit or lost state)")
                 else:
+                    if self._peer_recovery:
+                        self._record_recovery_path(
+                            "none", k, None,
+                            "no peer coverage and no checkpoint_dir "
+                            "(disk-free mode, pre-first-commit or "
+                            "fleet memory lost)")
                     log.warning(
-                        "TpuState.%s holds ZeRO-sharded leaves but no "
-                        "checkpoint_dir was given; skipping sync for "
-                        "them — a world resize will NOT restore these "
-                        "moments (see docs/checkpointing.md)", k)
+                        "TpuState.%s holds ZeRO-sharded leaves and "
+                        "neither the peer tier nor a checkpoint_dir "
+                        "can restore them; skipping sync for them — a "
+                        "world resize will NOT restore these moments "
+                        "(see docs/recovery.md)", k)
                 # No committed step (or no dir): the ZeRO leaves stay
                 # local (identical init state before the first commit),
                 # but replicated leaves living alongside them — e.g. a
@@ -457,7 +757,15 @@ class TpuState(ObjectState):
         # re-seats each loader in the CURRENT topology: the remaining
         # epoch reshards N→M with no duplicated and no dropped samples.
         if self._data_keys:
-            disk = self._read_data_iters_from_disk(chosen)
+            disk = None
+            if peer_extra:
+                # A peer restore carries the SAME committed extra the
+                # disk manifest would — the atomic moments+input pairing
+                # survives the disk-free path.
+                from ..checkpoint import DATA_ITERS_KEY
+                disk = peer_extra.get(DATA_ITERS_KEY)
+            if not disk:
+                disk = self._read_data_iters_from_disk(chosen)
             if disk:
                 for k, v in disk.items():
                     if k in self._data_keys:
@@ -539,9 +847,9 @@ def run(func: Callable) -> Callable:
                 sync_gauge.set(_time.perf_counter() - t0)
                 try:
                     return func(state, *args, **kwargs)
-                except HorovodInternalError:
-                    log.warning("collective failure; restoring last "
-                                "committed state and re-initializing")
+                except HorovodInternalError as e:
+                    log.warning("collective failure (%s); restoring last "
+                                "committed state and re-initializing", e)
                     _flight.record("elastic.restore", None, cause="failure")
                     _elastic_counter(
                         "hvd_elastic_resets_total",

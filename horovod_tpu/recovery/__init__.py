@@ -1,0 +1,51 @@
+"""Peer-to-peer hot recovery — the in-memory tier between a failure and
+the disk manifest.
+
+At production scale MTBF makes restart latency a first-order throughput
+term: the classic elastic design (arXiv:1802.05799) restores every
+resize from the last committed step on disk.  But with per-rank ZeRO
+shards already partitioned across the fleet (arXiv:2004.13336), the
+surviving ranks collectively hold everything a replacement needs.  This
+package keeps it that way on purpose:
+
+* :mod:`buddy` — the pairwise ring: rank *r*'s committed shard is
+  replicated into ``replica_holder(r)``'s memory, stride-shifted so
+  buddies land on different hosts;
+* :mod:`store` — the per-process replica memory (sealed/pending
+  two-phase entries, checksummed payloads);
+* :mod:`peer` — commit-time replication and the restore-time peer
+  reassembly ``TpuState.sync`` tries before touching disk;
+* :mod:`transport` — the rendezvous-published HTTP replica endpoints
+  buddy pushes ride between processes;
+* :mod:`chaos` — deterministic fault injection (seeded kills,
+  commit-window crashes, slow peers, torn replication) so the recovery
+  paths are *drilled*, not assumed.
+
+Decision visibility: every restore records a :class:`RecoveryReport`
+(path peer/disk/none, bytes, latency) into ``hvd.metrics``, the flight
+recorder, and — via ``debug/hang.py`` — hang reports.
+
+See ``docs/recovery.md`` for the failure matrix and knob table.
+"""
+
+from .buddy import buddy_map, replica_held, replica_holder, uncovered_ranks
+from .chaos import Chaos, ChaosCrash, ChaosKill, chaos, reset_chaos
+from .peer import (
+    PeerRestoreUnavailable, RecoveryReport, last_report, peer_restore,
+    record_report, replicate, seal_commit,
+)
+from .store import (
+    ReplicaEntry, ReplicaStore, entry_from_bytes, entry_to_bytes,
+    payload_checksum, reset_store, store, verify_entry,
+)
+from . import transport
+
+__all__ = [
+    "buddy_map", "replica_held", "replica_holder", "uncovered_ranks",
+    "Chaos", "ChaosCrash", "ChaosKill", "chaos", "reset_chaos",
+    "PeerRestoreUnavailable", "RecoveryReport", "last_report",
+    "peer_restore", "record_report", "replicate", "seal_commit",
+    "ReplicaEntry", "ReplicaStore", "entry_from_bytes", "entry_to_bytes",
+    "payload_checksum", "reset_store", "store", "verify_entry",
+    "transport",
+]

@@ -1,0 +1,75 @@
+"""Buddy topology for pairwise shard replication.
+
+The assignment is a ring shift: rank *r*'s committed shard is replicated
+into the memory of ``replica_holder(r) = (r + 1) % world`` (its "buddy").
+A ring — rather than disjoint pairs — works for every world size
+including odd ones, spreads the replication traffic evenly (each rank
+sends one shard and receives one), and gives the failure matrix a clean
+shape:
+
+* a **single rank** dies → its shard survives in its buddy's memory and
+  the whole old world is still collectively reconstructible;
+* two **adjacent** ranks die (*r* and ``(r+1) % world`` — a "buddy
+  pair", e.g. both slots of one preempted host when ranks are placed
+  contiguously) → rank *r*'s shard is gone from memory and recovery
+  falls back to the disk manifest;
+* two **non-adjacent** ranks die → both shards survive (each buddy is
+  still alive) and the peer path still covers the full old world.
+
+Placement caveat the docs spell out: contiguous rank placement puts a
+host's ranks next to each other on the ring, so a whole-host loss kills
+buddy pairs.  ``replica_holder(r, world, stride=local_size)`` shifts by
+the local world size instead, pushing every buddy onto a *different*
+host — then only a correlated two-HOST loss forces the disk fallback.
+
+Everything here is pure integer arithmetic — golden-tested, shared by
+the commit-time replicator and the restore-time coverage check.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def replica_holder(rank: int, world: int, stride: int = 1) -> Optional[int]:
+    """The rank that holds ``rank``'s replica (its buddy), or None when
+    the world is too small to replicate (world 1, or a stride that maps
+    every rank onto itself)."""
+    if world <= 1:
+        return None
+    stride = max(1, int(stride)) % world
+    if stride == 0:
+        stride = 1
+    holder = (int(rank) + stride) % world
+    return None if holder == int(rank) else holder
+
+
+def replica_held(rank: int, world: int, stride: int = 1) -> Optional[int]:
+    """The rank whose replica ``rank`` holds — the inverse of
+    :func:`replica_holder`."""
+    if world <= 1:
+        return None
+    stride = max(1, int(stride)) % world
+    if stride == 0:
+        stride = 1
+    held = (int(rank) - stride) % world
+    return None if held == int(rank) else held
+
+
+def buddy_map(world: int, stride: int = 1) -> Dict[int, Optional[int]]:
+    """{rank: replica_holder(rank)} for the whole world."""
+    return {r: replica_holder(r, world, stride) for r in range(world)}
+
+
+def uncovered_ranks(dead: List[int], world: int,
+                    stride: int = 1) -> List[int]:
+    """Old-world ranks whose shard survives in NO live memory after the
+    ranks in ``dead`` die: the rank itself is dead AND so is its buddy.
+    Empty list == the peer path can still reconstruct the full state."""
+    gone = set(int(d) for d in dead)
+    out = []
+    for r in sorted(gone):
+        holder = replica_holder(r, world, stride)
+        if holder is None or holder in gone:
+            out.append(r)
+    return out

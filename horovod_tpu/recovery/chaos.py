@@ -1,0 +1,183 @@
+"""Deterministic chaos layer: seeded fault injection for recovery drills.
+
+Real fleets lose ranks at random; CI must lose them *reproducibly*.
+Every injection here is a pure function of the knobs — no wall clock, no
+``random`` module state — so every incarnation of every rank (including
+respawns after a kill) derives the identical schedule, and a failing
+drill replays bit-for-bit from its seed.
+
+Knobs (all ``HVD_TPU_CHAOS_*``; the layer is inert unless at least one
+is set):
+
+* ``CHAOS_SEED`` — the schedule seed.  :meth:`Chaos.kill_epoch` draws a
+  deterministic kill step from it, so soak tests get a *seeded* schedule
+  rather than a hardcoded one.
+* ``CHAOS_KILL_STEPS`` — explicit ``"rank@step[,rank@step...]"`` kill
+  schedule consumed by :meth:`Chaos.maybe_kill` (training loops call it
+  once per step; the marked rank hard-exits mid-step).
+* ``CHAOS_COMMIT_CRASH`` — ``"<point>[@step]"``: crash inside the commit
+  window at a named point (``after_replicate`` — replica sent, disk not
+  yet committed; ``pre_manifest`` — shards written, manifest not).
+  Process-local one-shot: it fires once and disarms, so a respawned
+  worker that replays the same step does not crash-loop (cross-respawn
+  one-shotness is the caller's marker file, as in the churn soak).
+* ``CHAOS_SLOW_PEER_MS`` — injected latency in the peer replica
+  serving/push path (slow-peer drills).
+* ``CHAOS_TORN_RANKS`` — comma list of ranks whose replica payloads are
+  corrupted *after* checksumming (torn replication: the buddy's copy no
+  longer matches what the owner committed; restore must detect and
+  refuse it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Dict, Optional, Set, Tuple
+
+
+class ChaosKill(SystemExit):
+    """A scheduled rank kill.  SystemExit subclass so an uninjected
+    training loop dies (driver sees a worker failure — the drill) while
+    tests can still catch it precisely."""
+
+
+class ChaosCrash(RuntimeError):
+    """A scheduled commit-window crash."""
+
+
+def _cfg(name: str, default: Optional[str] = None) -> Optional[str]:
+    from ..core.config import get_env
+    return get_env(name, default)
+
+
+def _parse_kills(spec: str) -> Dict[int, Set[int]]:
+    """``"rank@step,..."`` → {rank: {steps}}.  Malformed entries are
+    ignored (a typo'd drill knob must not take down a real job)."""
+    out: Dict[int, Set[int]] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "@" not in part:
+            continue
+        r, _, s = part.partition("@")
+        try:
+            out.setdefault(int(r), set()).add(int(s))
+        except ValueError:
+            continue
+    return out
+
+
+def _parse_crash(spec: str) -> Tuple[str, Optional[int]]:
+    spec = (spec or "").strip()
+    if not spec:
+        return "", None
+    point, _, step = spec.partition("@")
+    try:
+        return point, int(step) if step else None
+    except ValueError:
+        return point, None
+
+
+class Chaos:
+    """One parsed injection schedule.  Construct directly in tests;
+    production code goes through the env-backed :func:`chaos`."""
+
+    def __init__(self, seed: int = 0, kill_steps: str = "",
+                 commit_crash: str = "", slow_peer_ms: float = 0.0,
+                 torn_ranks: str = ""):
+        self.seed = int(seed)
+        self.kills = _parse_kills(kill_steps)
+        self.crash_point, self.crash_step = _parse_crash(commit_crash)
+        self.slow_peer_ms = float(slow_peer_ms)
+        self.torn_ranks = {int(x) for x in torn_ranks.split(",")
+                           if x.strip().lstrip("-").isdigit()}
+        self._crash_armed = True
+
+    @classmethod
+    def from_env(cls) -> "Chaos":
+        from ..core.config import get_float, get_int
+        return cls(seed=get_int("CHAOS_SEED", 0),
+                   kill_steps=_cfg("CHAOS_KILL_STEPS", "") or "",
+                   commit_crash=_cfg("CHAOS_COMMIT_CRASH", "") or "",
+                   slow_peer_ms=get_float("CHAOS_SLOW_PEER_MS", 0.0),
+                   torn_ranks=_cfg("CHAOS_TORN_RANKS", "") or "")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.kills or self.crash_point or self.torn_ranks
+                    or self.slow_peer_ms > 0 or self.seed)
+
+    # -- seeded draws ------------------------------------------------------
+
+    def draw(self, key: str, lo: int, hi: int) -> int:
+        """Deterministic integer in ``[lo, hi)`` from ``(seed, key)`` —
+        the schedule primitive.  sha256, not ``random``: identical on
+        every platform and every incarnation."""
+        if hi <= lo:
+            return int(lo)
+        h = hashlib.sha256(f"{self.seed}:{key}".encode()).digest()
+        return lo + int.from_bytes(h[:8], "big") % (hi - lo)
+
+    def kill_epoch(self, key: str, lo: int, hi: int) -> int:
+        """A seeded kill step for the entity named ``key`` (a slot id, a
+        rank) within a window — the churn soak's schedule source."""
+        return self.draw(f"kill:{key}", lo, hi)
+
+    # -- kill schedule -----------------------------------------------------
+
+    def should_kill(self, rank: int, step: int) -> bool:
+        return int(step) in self.kills.get(int(rank), ())
+
+    def maybe_kill(self, rank: int, step: int, hard: bool = False):
+        """Raise :class:`ChaosKill` (or ``os._exit(1)`` when ``hard`` —
+        a crash no exception handler can absorb, the real-preemption
+        shape) when the schedule marks this (rank, step)."""
+        if not self.should_kill(rank, step):
+            return
+        if hard:
+            import os
+            os._exit(1)
+        raise ChaosKill(f"chaos: scheduled kill of rank {rank} at "
+                        f"step {step}")
+
+    # -- commit-window crashes ---------------------------------------------
+
+    def should_crash(self, point: str, step: Optional[int] = None) -> bool:
+        if not self._crash_armed or self.crash_point != point:
+            return False
+        return self.crash_step is None or step is None \
+            or int(step) == self.crash_step
+
+    def maybe_crash(self, point: str, step: Optional[int] = None):
+        if self.should_crash(point, step):
+            self._crash_armed = False
+            raise ChaosCrash(f"chaos: scheduled crash at commit point "
+                             f"{point!r} (step {step})")
+
+    # -- replication-path injections ---------------------------------------
+
+    def torn(self, rank: int) -> bool:
+        """True when ``rank``'s replica payload should be corrupted en
+        route to its buddy (torn-replication drill)."""
+        return int(rank) in self.torn_ranks
+
+    def slow_peer(self) -> None:
+        if self.slow_peer_ms > 0:
+            time.sleep(self.slow_peer_ms / 1e3)
+
+
+_chaos: Optional[Chaos] = None
+
+
+def chaos() -> Chaos:
+    """The process-wide schedule, parsed from env on first use."""
+    global _chaos
+    if _chaos is None:
+        _chaos = Chaos.from_env()
+    return _chaos
+
+
+def reset_chaos() -> None:
+    """Drop the cached schedule (tests that mutate CHAOS_* env)."""
+    global _chaos
+    _chaos = None

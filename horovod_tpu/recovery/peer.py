@@ -1,0 +1,348 @@
+"""Commit-time replication and restore-time peer reassembly.
+
+Replication (:func:`replicate`, called from ``TpuState.commit``): the
+host values the checkpoint engine extracted for the disk shards — the
+exact bytes ``write_shard`` would encode — are placed twice: the owner's
+copy into the local :mod:`store`, the buddy copy into its holder's
+(same-process store in single-controller jobs; an HTTP push to the
+holder's rendezvous-published replica endpoint otherwise).  Entries seal
+(:func:`seal_commit`) only once the owner's commit fully lands, so the
+peer tier inherits the engine's manifest-last invariant.
+
+Peer restore (:func:`peer_restore`, tried by ``TpuState.sync`` before
+the disk manifest): every member of the NEW world contributes its sealed
+entries over one ``allgather_object`` — the same collective plane the
+job already speaks, so a restore moves bytes over the fast wire, not the
+filesystem.  The merged view must cover every rank of the old world at
+one (step, world, fingerprint) with a valid checksum; anything less
+(buddy pair died together, torn replication, empty stores after a full
+relaunch) raises :class:`PeerRestoreUnavailable` and the caller falls
+back to disk.  Reassembly reuses the checkpoint engine verbatim — an
+in-memory :class:`~..checkpoint.engine.RestoredStep` over the gathered
+shards, resharded N→M by the same arithmetic — so a peer restore is
+bit-identical to restoring the same step from the disk manifest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..debug import flight as _flight
+from ..utils import logging as log
+from . import buddy as B
+# Direct-name imports: the package exports a `store()` accessor that
+# shadows the submodule attribute, so `from . import store` could bind
+# the function depending on import order.
+from .store import ReplicaEntry, payload_checksum, verify_entry
+from .store import store as _rstore
+
+
+class PeerRestoreUnavailable(Exception):
+    """The in-memory tier cannot cover the requested state; fall back
+    to the disk manifest (or fresh init)."""
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What the last restore decision did — surfaced in ``hvd.metrics``,
+    flight events and hang reports so an operator can attribute a
+    recovery to its path after the fact."""
+
+    path: str                 # "peer" | "disk" | "none"
+    key: str = ""
+    step: Optional[int] = None
+    world_from: Optional[int] = None
+    world_to: Optional[int] = None
+    bytes_moved: int = 0
+    seconds: float = 0.0
+    reason: str = ""          # why this path (e.g. the peer-miss cause)
+    wall: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_report_lock = threading.Lock()
+_last_report: Optional[RecoveryReport] = None
+
+
+def record_report(report: RecoveryReport) -> RecoveryReport:
+    global _last_report
+    with _report_lock:
+        _last_report = report
+    return report
+
+
+def last_report() -> Optional[RecoveryReport]:
+    with _report_lock:
+        return _last_report
+
+
+def _registry():
+    from ..metrics.registry import registry
+    return registry()
+
+
+def _stride() -> int:
+    """Buddy ring stride: configured, else the local world size so a
+    rank's replica lands on a DIFFERENT host (a whole-host preemption
+    then kills no buddy pair) — 1 when topology is unknown."""
+    from ..core.config import Config, get_int
+    from ..core.state import global_state
+    s = get_int("RECOVERY_STRIDE", Config.recovery_stride)
+    if s > 0:
+        return s
+    return max(1, int(global_state.local_size or 1))
+
+
+# ---------------------------------------------------------------------------
+# Commit-time replication
+# ---------------------------------------------------------------------------
+
+def replicate(key: str, step: int, ext, extra: Optional[dict] = None,
+              stride: Optional[int] = None, push: bool = True) -> int:
+    """Place one commit's payloads (an ``ExtractedState`` from
+    ``checkpoint.zero.extract_zero_state``) into the replica tier:
+    own copies locally, buddy copies with their holders.  Returns the
+    bytes replicated.  Entries are PENDING until :func:`seal_commit`."""
+    from ..checkpoint import manifest as M
+    from ..checkpoint.zero import fingerprint_extra
+
+    stride = _stride() if stride is None else int(stride)
+    manifest = M.Manifest(step=int(step), world_size=ext.world,
+                          leaves=ext.specs,
+                          extra=fingerprint_extra(ext, extra))
+    mjson = manifest.to_json()
+    st = _rstore()
+    reg = _registry()
+    total = 0
+    remote_pushed = 0
+    for rank, values in sorted(ext.rank_values.items()):
+        arrays = {spec.key: v for spec, v in zip(ext.specs, values)
+                  if v is not None}
+        entry = ReplicaEntry(
+            key=key, rank=int(rank), step=int(step), world=ext.world,
+            fingerprint=ext.fingerprint, manifest_json=mjson,
+            arrays=arrays, checksum=payload_checksum(arrays))
+        st.put_own(entry)
+        total += entry.nbytes()
+        holder = B.replica_holder(rank, ext.world, stride)
+        if holder is None:
+            continue
+        if holder in ext.rank_values:
+            # The holder's store IS this process's store (always true in
+            # single-controller jobs, where every rank is addressable).
+            st.put_held(entry)
+        elif push:
+            from . import transport as T
+            addr = T.lookup_addr(holder)
+            if addr is not None and T.push_replica(addr, entry):
+                remote_pushed += 1
+            else:
+                reg.counter("hvd_recovery_push_failures_total",
+                            "Replica pushes that never reached the "
+                            "buddy").inc()
+                log.warning(
+                    "recovery: replica push rank %d -> holder %d failed"
+                    " (peer tier degraded for this rank at step %d)",
+                    rank, holder, step)
+    reg.counter("hvd_recovery_replications_total",
+                "Commit-time replica placements").inc()
+    reg.counter("hvd_recovery_replica_bytes_total",
+                "Bytes placed in the replica tier").inc(total)
+    reg.gauge("hvd_recovery_store_bytes",
+              "Resident bytes in the local replica store").set(
+        st.total_bytes())
+    _flight.record("recovery.replicate", key, step=int(step),
+                   world=ext.world, bytes=total, stride=stride,
+                   remote_pushed=remote_pushed)
+    return total
+
+
+def seal_commit(key: str, step: int, ext=None,
+                stride: Optional[int] = None, push: bool = True) -> None:
+    """Two-phase marker: the owner's commit fully landed — promote the
+    pending entries (local store + any remote holders)."""
+    _rstore().seal(key, int(step))
+    if ext is None or not push:
+        return
+    stride = _stride() if stride is None else int(stride)
+    from . import transport as T
+    for rank in sorted(ext.rank_values):
+        holder = B.replica_holder(rank, ext.world, stride)
+        if holder is None or holder in ext.rank_values:
+            continue
+        addr = T.lookup_addr(holder)
+        if addr is not None:
+            T.push_seal(addr, key, int(step))
+
+
+# ---------------------------------------------------------------------------
+# Restore-time peer reassembly
+# ---------------------------------------------------------------------------
+
+def _gather_entries(key: str) -> List[ReplicaEntry]:
+    """Every member's sealed contribution, merged, over the CURRENT
+    world (degrades to the local store's view in single-process jobs).
+
+    Two-phase to keep the wire at ~1x the state: owner payloads first
+    (every member needs every shard to rebuild the full buffers
+    regardless), then buddy copies ONLY for (step, world, rank)
+    positions no surviving owner covered — in the common single-rank-
+    loss case that second gather moves one shard, not a duplicate of
+    the whole state.  Both gathers run unconditionally on every member
+    and filter on the (identical) phase-one result, so the fleet stays
+    collective-consistent.  Owner copies never transit a transfer, so
+    preferring them also minimizes torn-copy exposure."""
+    from ..optimizers import allgather_object
+    own_local = _rstore().contribution(key, role="own")
+    gathered = allgather_object(own_local, name="recovery.peer.gather")
+    own = [e for contrib in gathered for e in contrib]
+    covered = {(e.step, e.world, e.fingerprint, e.rank) for e in own}
+    held_local = [e for e in _rstore().contribution(key, role="held")
+                  if (e.step, e.world, e.fingerprint, e.rank)
+                  not in covered]
+    gathered_held = allgather_object(held_local,
+                                     name="recovery.peer.gather_held")
+    return own + [e for contrib in gathered_held for e in contrib]
+
+
+def _coverage(entries: List[ReplicaEntry], reg) -> Tuple[
+        Dict[Tuple[int, int, str], Dict[int, ReplicaEntry]], int]:
+    """Group valid entries by (step, world, fingerprint); first copy per
+    rank wins (owner copies sort first in each contribution).  Returns
+    the groups and the number of torn copies detected."""
+    groups: Dict[Tuple[int, int, str], Dict[int, ReplicaEntry]] = {}
+    torn = 0
+    for e in entries:
+        if not verify_entry(e):
+            torn += 1
+            reg.counter("hvd_recovery_torn_replicas_total",
+                        "Replica copies failing checksum verification"
+                        ).inc()
+            log.warning(
+                "recovery: torn replica detected (key=%s rank=%d "
+                "step=%d) — copy excluded from coverage", e.key, e.rank,
+                e.step)
+            continue
+        g = groups.setdefault((e.step, e.world, e.fingerprint), {})
+        g.setdefault(e.rank, e)
+    return groups, torn
+
+
+def peer_restore(key: str, like, mesh=None,
+                 axis_name: Optional[str] = None,
+                 step: Optional[int] = None):
+    """Rebuild ``like``'s state for the CURRENT world from the fleet's
+    replica memory.  ``step`` pins the commit to restore (the elastic
+    sync path passes its agreed committed step); None takes the newest
+    fully covered one.  Returns ``(state, manifest_extra, report)`` or
+    raises :class:`PeerRestoreUnavailable` with the coverage reason.
+
+    Collective: every member of the current world must call this (the
+    gather runs on the collective plane), and with the same ``step`` —
+    the elastic sync path guarantees both.
+    """
+    from ..checkpoint import engine as E
+    from ..checkpoint import zero as Z
+
+    reg = _registry()
+    t0 = time.perf_counter()
+    _flight.record("recovery.restore.begin", key,
+                   step=step if step is None else int(step))
+    entries = _gather_entries(key)
+
+    if mesh is None:
+        from ..core import basics
+        mesh = basics.mesh()
+    ax = Z._default_axis(axis_name)
+    world_new = Z._axis_world(mesh, ax)
+
+    # Replicas of a DIFFERENT run (another structure sharing this
+    # process's store) are a miss, not an error: filter on the restore
+    # target's world-size-invariant fingerprint before voting, the same
+    # cross-run guard the disk engine applies — with the same
+    # HVD_TPU_CKPT_ALLOW_FOREIGN escape hatch.
+    from ..checkpoint import manifest as M
+    target_plans, _, _ = Z._plan_tree(like, max(1, world_new),
+                                      validate=False)
+    target_fp = M.spec_fingerprint([p.spec for p in target_plans])
+    foreign = 0
+    if not Z._foreign_allowed():
+        matched = [e for e in entries if e.fingerprint == target_fp]
+        foreign = len(entries) - len(matched)
+        entries = matched
+    groups, torn = _coverage(entries, reg)
+
+    covered = {g: ranks for g, ranks in groups.items()
+               if set(ranks) >= set(range(g[1]))}
+    chosen = None
+    if step is not None:
+        for g in covered:
+            if g[0] == int(step):
+                chosen = g
+                break
+    elif covered:
+        chosen = max(covered, key=lambda g: g[0])
+    if chosen is None:
+        if not entries:
+            reason = "no sealed replicas in fleet memory (fresh " \
+                     "relaunch or replication disabled)"
+            if foreign:
+                reason += f"; {foreign} foreign-run entries ignored"
+        else:
+            newest = max(groups, key=lambda g: g[0], default=None)
+            want = int(step) if step is not None else \
+                (newest[0] if newest else -1)
+            missing = []
+            for g, ranks in groups.items():
+                if g[0] == want:
+                    missing = sorted(set(range(g[1])) - set(ranks))
+                    break
+            reason = (f"coverage gap at step {want}: missing old-world "
+                      f"ranks {missing} (buddy pair lost together)"
+                      if missing else
+                      f"no replica group covers step {want}")
+            if torn:
+                reason += f"; {torn} torn cop{'y' if torn == 1 else 'ies'}" \
+                          " excluded"
+        reg.counter("hvd_recovery_restores_total",
+                    "Recovery restore decisions by path",
+                    path="peer_miss").inc()
+        _flight.record("recovery.restore.miss", key, reason=reason)
+        raise PeerRestoreUnavailable(reason)
+
+    ranks = covered[chosen]
+    step_c, world_old, _fp = chosen
+    manifest = _manifest_of(ranks[0])
+    shards = [ranks[r].arrays for r in range(world_old)]
+    restored = E.RestoredStep(manifest, shards, world_new)
+    state = Z.rebuild_restored(restored, like)
+    bytes_moved = sum(ranks[r].nbytes() for r in range(world_old))
+    dt = time.perf_counter() - t0
+    report = record_report(RecoveryReport(
+        path="peer", key=key, step=step_c, world_from=world_old,
+        world_to=world_new, bytes_moved=bytes_moved, seconds=dt,
+        reason="full coverage in fleet memory", wall=time.time()))
+    reg.counter("hvd_recovery_restores_total",
+                "Recovery restore decisions by path", path="peer").inc()
+    reg.counter("hvd_recovery_restore_bytes_total",
+                "Bytes reassembled from the replica tier").inc(
+        bytes_moved)
+    reg.gauge("hvd_recovery_restore_seconds",
+              "Duration of the last recovery restore").set(dt)
+    _flight.record("recovery.restore.done", key, path="peer",
+                   step=step_c, world_from=world_old,
+                   world_to=world_new, bytes=bytes_moved)
+    log.info("recovery: peer-restored %s step %d (world %d -> %d, "
+             "%.1f MB in %.3f s)", key, step_c, world_old, world_new,
+             bytes_moved / 1e6, dt)
+    return state, dict(manifest.extra), report
+
+
+def _manifest_of(entry: ReplicaEntry):
+    from ..checkpoint import manifest as M
+    return M.Manifest.from_json(entry.manifest_json)

@@ -1,0 +1,310 @@
+"""In-memory replica store: the RAM tier committed shards live in.
+
+Every rank keeps two kinds of entries, one per ``(tree key, old rank)``:
+
+* **own** — the bytes this rank itself committed (its shard of each
+  ZeRO tree plus the manifest that describes them), kept so a surviving
+  rank can serve *itself* during a peer restore without touching disk;
+* **held** — the buddy copy: the same payload for the rank whose
+  replica this rank holds (``buddy.replica_held``), received at commit
+  time over the replication path.
+
+Entries carry the full commit identity (step, old world size, run
+fingerprint, manifest JSON) plus a content checksum computed by the
+*owner* before the payload leaves its process — a buddy copy that was
+torn in flight (chaos drill: ``HVD_TPU_CHAOS_TORN_RANKS``) fails
+verification at restore time and is treated as absent, never silently
+restored.
+
+Two-phase commit marker: entries are stored **unsealed** when the
+payload arrives and **sealed** only after the owner's full commit
+completed (disk manifest + in-memory snapshot).  The peer-restore
+coverage check only counts sealed entries, so a rank that died *inside*
+its commit window cannot contribute a half-committed step — the exact
+invariant the disk engine's manifest-last protocol provides, replayed
+in memory.
+
+Arrays are held decoded (numpy views of the extracted host values, the
+same bytes ``write_shard`` would encode), so a peer restore is pure
+memory traffic — no npz decode, no file IO.  The wire form
+(:func:`entry_to_bytes`) is npz + a JSON header, used by the HTTP
+transport between processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import buddy as B
+from .chaos import chaos
+
+
+@dataclasses.dataclass
+class ReplicaEntry:
+    """One rank's committed payload for one tree key."""
+
+    key: str                  # tree key ("opt_state", "params", ...)
+    rank: int                 # old-world rank whose shard this is
+    step: int
+    world: int                # world size at commit
+    fingerprint: str          # run fingerprint (leaf-spec sha256)
+    manifest_json: str        # the step's manifest (specs + extra)
+    arrays: Dict[str, np.ndarray]
+    checksum: str
+    sealed: bool = False
+
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+
+def payload_checksum(arrays: Dict[str, np.ndarray]) -> str:
+    """Content hash of a payload: key order, dtype, shape and bytes per
+    array.  Stamped by the owner before the payload leaves its process;
+    verified before any restore uses a copy."""
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        h.update(f"{k}|{a.dtype}|{a.shape}\n".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def verify_entry(entry: ReplicaEntry) -> bool:
+    return payload_checksum(entry.arrays) == entry.checksum
+
+
+def entry_to_bytes(entry: ReplicaEntry) -> bytes:
+    """Wire form: JSON header line + npz payload (the transport and the
+    allgather both move this)."""
+    head = json.dumps({
+        "key": entry.key, "rank": entry.rank, "step": entry.step,
+        "world": entry.world, "fingerprint": entry.fingerprint,
+        "manifest_json": entry.manifest_json,
+        "checksum": entry.checksum, "sealed": entry.sealed,
+    }).encode()
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in entry.arrays.items()})
+    return len(head).to_bytes(8, "big") + head + buf.getvalue()
+
+
+def entry_from_bytes(data: bytes) -> ReplicaEntry:
+    n = int.from_bytes(data[:8], "big")
+    meta = json.loads(data[8:8 + n].decode())
+    with np.load(io.BytesIO(data[8 + n:])) as z:
+        arrays = {k: z[k] for k in z.files}
+    return ReplicaEntry(arrays=arrays, **meta)
+
+
+class _Slot:
+    """One ``(key, rank)`` position: the last sealed (restorable) entry
+    plus at most one pending (committed-but-not-yet-sealed) entry.  The
+    previous sealed entry survives until the NEXT one seals, so a crash
+    inside the commit window never costs the peer tier its last good
+    step."""
+
+    __slots__ = ("sealed", "pending")
+
+    def __init__(self):
+        self.sealed: Optional[ReplicaEntry] = None
+        self.pending: Optional[ReplicaEntry] = None
+
+
+class ReplicaStore:
+    """Process-local replica memory.  In multi-controller jobs each
+    process stores its own ranks' entries plus the buddy copies pushed
+    to it; in single-controller jobs (one process is every rank) it
+    holds the whole fleet's — which is exactly what lets the fast tests
+    drill rank death by dropping entries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tables: Dict[str, Dict[Tuple[str, int], _Slot]] = {
+            "own": {}, "held": {}}
+
+    def _slot(self, role: str, key: str, rank: int) -> _Slot:
+        table = self._tables[role]
+        k = (key, int(rank))
+        if k not in table:
+            table[k] = _Slot()
+        return table[k]
+
+    # -- writes ------------------------------------------------------------
+
+    def put_own(self, entry: ReplicaEntry) -> None:
+        with self._lock:
+            self._put(self._slot("own", entry.key, entry.rank), entry)
+
+    def put_held(self, entry: ReplicaEntry) -> None:
+        """Store a buddy copy.  The torn-replication chaos drill
+        corrupts the copy here — after the owner checksummed it, the
+        way a real torn transfer would."""
+        if chaos().torn(entry.rank) and entry.arrays:
+            arrays = {k: np.array(v, copy=True)
+                      for k, v in entry.arrays.items()}
+            for k in sorted(arrays):
+                a = arrays[k]
+                if not a.size:
+                    continue
+                # Flip the first payload byte (byte-level, so any
+                # dtype/shape — including 0-d scalars — tears).
+                raw = np.frombuffer(a.tobytes(), np.uint8).copy()
+                raw[0] ^= 0xFF
+                arrays[k] = np.frombuffer(
+                    raw.tobytes(), a.dtype).reshape(a.shape)
+                break
+            entry = dataclasses.replace(entry, arrays=arrays)
+        with self._lock:
+            self._put(self._slot("held", entry.key, entry.rank), entry)
+
+    @staticmethod
+    def _put(slot: _Slot, entry: ReplicaEntry) -> None:
+        # An entry that arrives already sealed (a fetch-based repair of
+        # a committed step) lands directly in the sealed position.
+        if entry.sealed:
+            slot.sealed, slot.pending = entry, None
+        else:
+            slot.pending = entry
+
+    def seal(self, key: str, step: int) -> None:
+        """Promote pending entries of ``(key, step)`` to sealed — the
+        owner's commit fully landed.  Sealing also prunes slots for
+        ranks OUTSIDE the sealed world (a superseded larger world's
+        tail ranks): a stale world must not win a later coverage vote.
+
+        In-world slots that have nothing at this step are deliberately
+        LEFT ALONE — a buddy's push may still be in flight (or have
+        failed, a counted non-fatal degrade), and dropping its older
+        sealed copy would destroy the fleet's only redundancy for that
+        rank.  Worst case one stale entry lingers per slot until the
+        next successful put."""
+        step = int(step)
+        with self._lock:
+            world = None
+            for table in self._tables.values():
+                for k in list(table):
+                    if k[0] != key:
+                        continue
+                    slot = table[k]
+                    if slot.pending is not None and \
+                            slot.pending.step == step:
+                        slot.pending.sealed = True
+                        slot.sealed, slot.pending = slot.pending, None
+                        world = slot.sealed.world
+            if world is None:
+                return  # seal arrived before any payload: nothing known
+            for table in self._tables.values():
+                for k in list(table):
+                    if k[0] != key or k[1] < world:
+                        continue
+                    slot = table[k]
+                    if slot.pending is None and (
+                            slot.sealed is None
+                            or slot.sealed.step < step):
+                        table.pop(k)
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key: str, rank: int) -> Optional[ReplicaEntry]:
+        """The newest sealed entry for ``(key, rank)`` — owner copy
+        preferred (never torn by a bad transfer)."""
+        with self._lock:
+            for role in ("own", "held"):
+                slot = self._tables[role].get((key, int(rank)))
+                if slot is not None and slot.sealed is not None:
+                    return slot.sealed
+        return None
+
+    def contribution(self, key: str,
+                     role: Optional[str] = None) -> List[ReplicaEntry]:
+        """Every sealed entry this process can serve for a peer restore
+        of ``key`` — own entries first so the merge prefers the owner's
+        copy when both survive.  ``role`` restricts to one table (the
+        two-phase restore gather ships own payloads first and held
+        buddy copies only for ranks with no surviving owner)."""
+        roles = ("own", "held") if role is None else (role,)
+        with self._lock:
+            out = []
+            for r in roles:
+                for k in sorted(self._tables[r]):
+                    if k[0] != key:
+                        continue
+                    slot = self._tables[r][k]
+                    if slot.sealed is not None:
+                        out.append(slot.sealed)
+        return out
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted({k for t in self._tables.values() for (k, _r)
+                           in t})
+
+    def total_bytes(self) -> int:
+        """Resident payload bytes, deduplicated: in single-controller
+        stores the own and held slots alias the SAME entry object (the
+        arrays are shared references), which must not be priced twice —
+        operators size host RAM from this gauge."""
+        with self._lock:
+            seen = set()
+            total = 0
+            for table in self._tables.values():
+                for slot in table.values():
+                    for e in (slot.sealed, slot.pending):
+                        if e is not None and id(e) not in seen:
+                            seen.add(id(e))
+                            total += e.nbytes()
+            return total
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_key(self, key: str) -> None:
+        with self._lock:
+            for table in self._tables.values():
+                for k in [k for k in table if k[0] == key]:
+                    table.pop(k)
+
+    def clear(self) -> None:
+        with self._lock:
+            for table in self._tables.values():
+                table.clear()
+
+    def simulate_death(self, ranks: List[int], world: int,
+                       stride: int = 1) -> None:
+        """Test/drill helper for single-controller stores: losing rank
+        *r* loses its own entries AND the buddy copies *it* was holding
+        (of ``replica_held(r)``) — its whole memory, exactly what a
+        process death takes."""
+        with self._lock:
+            for r in ranks:
+                for k in [k for k in self._tables["own"]
+                          if k[1] == int(r)]:
+                    self._tables["own"].pop(k)
+                held_src = B.replica_held(int(r), world, stride)
+                if held_src is not None:
+                    for k in [k for k in self._tables["held"]
+                              if k[1] == held_src]:
+                        self._tables["held"].pop(k)
+
+
+_store: Optional[ReplicaStore] = None
+_store_lock = threading.Lock()
+
+
+def store() -> ReplicaStore:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = ReplicaStore()
+        return _store
+
+
+def reset_store() -> None:
+    global _store
+    with _store_lock:
+        _store = None

@@ -1,0 +1,232 @@
+"""Replica transport: per-rank HTTP endpoints on the shared
+``BackgroundHTTPServer`` scaffold (the rendezvous/metrics/debug serving
+idiom), published to the rendezvous KV as ``recovery/replica_addr_<rank>``.
+
+* ``PUT /recovery/replica`` — receive a buddy's pushed payload
+  (commit-time replication; the body is :func:`store.entry_to_bytes`).
+* ``PUT /recovery/seal/<key>/<step>`` — the owner's commit-completed
+  marker for its pushed payloads (two-phase: a payload is never served
+  until sealed).
+* ``GET /recovery/replica/<key>/<rank>`` — serve a sealed entry
+  (operator tooling / targeted fetches; the elastic peer-restore path
+  itself gathers over the collective plane, which every member already
+  speaks).
+* ``GET /healthz`` — liveness.
+
+Requests are HMAC-gated with the launch secret exactly like the debug
+endpoints — replica payloads are raw optimizer state, nothing a stranger
+on the network should read or write.  The slow-peer chaos knob
+(``HVD_TPU_CHAOS_SLOW_PEER_MS``) injects its latency in the handlers, so
+drills exercise the same code path a congested host would.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+# Direct-name imports: the package exports a `store()` accessor that
+# shadows the submodule attribute, so `from . import store` would bind
+# the function here, not the module.
+from .store import ReplicaEntry, entry_from_bytes, entry_to_bytes
+from .store import store as _store
+from .chaos import chaos
+
+_SCOPE = "recovery"
+
+
+def _authorized(headers, method: str, key: str,
+                body: bytes = b"") -> bool:
+    """``key`` is the FULL resource path after the scope and ``body``
+    the payload — both are signed, so a captured signature authorizes
+    exactly one request, never a forged payload or another resource."""
+    from ..runner.rendezvous import request_authorized
+    return request_authorized(headers, method, _SCOPE, key, body)
+
+
+def _sign(req, method: str, key: str, body: bytes = b"") -> None:
+    from ..runner.rendezvous import sign_request
+    sign_request(req, method, _SCOPE, key, body)
+
+
+class _RecoveryHandler(BaseHTTPRequestHandler):
+    server_version = "hvd_tpu_recovery"
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _send(self, code: int, body: bytes = b"",
+              ctype: str = "application/octet-stream"):
+        self.send_response(code)
+        if body:
+            self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        parts = self.path.strip("/").split("/")
+        chaos().slow_peer()
+        if parts[:2] == [_SCOPE, "replica"] and len(parts) == 2:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = self.rfile.read(length)
+            if not _authorized(self.headers, "PUT", "replica", payload):
+                return self._send(403)
+            try:
+                entry = entry_from_bytes(payload)
+            except Exception:  # noqa: BLE001 — a torn PUT must not kill
+                return self._send(400)
+            _store().put_held(entry)
+            return self._send(200)
+        if parts[:2] == [_SCOPE, "seal"] and len(parts) == 4:
+            if not _authorized(self.headers, "PUT",
+                               "/".join(parts[1:])):
+                return self._send(403)
+            try:
+                _store().seal(parts[2], int(parts[3]))
+            except ValueError:
+                return self._send(400)
+            return self._send(200)
+        self._send(404)
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        if parts == ["healthz"]:
+            return self._send(200, b"ok", ctype="text/plain")
+        chaos().slow_peer()
+        if parts[:2] == [_SCOPE, "replica"] and len(parts) == 4:
+            if not _authorized(self.headers, "GET",
+                               "/".join(parts[1:])):
+                return self._send(403)
+            try:
+                entry = _store().get(parts[2], int(parts[3]))
+            except ValueError:
+                return self._send(400)
+            if entry is None or not entry.sealed:
+                return self._send(404)
+            return self._send(200, entry_to_bytes(entry))
+        self._send(404)
+
+
+class _RecoveryHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+
+class RecoveryServer:
+    """Replica endpoints on a background daemon thread."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        from ..runner.rendezvous import BackgroundHTTPServer
+        self._impl = BackgroundHTTPServer(
+            _RecoveryHTTPServer((host, port), _RecoveryHandler))
+
+    @property
+    def port(self) -> int:
+        return self._impl.port
+
+    def start(self) -> int:
+        return self._impl.start()
+
+    def stop(self) -> None:
+        self._impl.stop()
+
+
+_serve_lock = threading.Lock()
+_server: Optional[RecoveryServer] = None
+
+
+def serve(port: int = 0, host: str = "0.0.0.0") -> RecoveryServer:
+    """Start (or return) the module-level replica endpoint — idempotent
+    so elastic re-``init()`` keeps one server across rounds."""
+    global _server
+    with _serve_lock:
+        if _server is None:
+            s = RecoveryServer(host=host, port=port)
+            s.start()
+            _server = s
+        return _server
+
+
+def stop_serving() -> None:
+    global _server
+    with _serve_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def replica_addr_key(rank: int) -> str:
+    return f"replica_addr_{int(rank)}"
+
+
+def serve_and_publish(rank: int, rdv_addr: Optional[str] = None,
+                      port: int = 0) -> Optional[str]:
+    """Start the replica endpoint and publish its ``host:port`` under
+    ``recovery/replica_addr_<rank>`` on the rendezvous KV, so buddies
+    can push and operators can fetch.  Returns the published address
+    (None when no rendezvous address is known)."""
+    rdv_addr = rdv_addr or os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    s = serve(port=port)
+    if rdv_addr is None:
+        return None
+    from ..runner.rendezvous import advertised_host, http_put
+    addr = f"{advertised_host()}:{s.port}"
+    http_put(rdv_addr, _SCOPE, replica_addr_key(rank), addr.encode())
+    return addr
+
+
+def lookup_addr(rank: int, rdv_addr: Optional[str] = None,
+                timeout: float = 3.0) -> Optional[str]:
+    rdv_addr = rdv_addr or os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    if not rdv_addr:
+        return None
+    from ..runner.rendezvous import http_get
+    raw = http_get(rdv_addr, _SCOPE, replica_addr_key(rank),
+                   timeout=timeout)
+    return raw.decode() if raw else None
+
+
+def _request(addr: str, path: str, method: str, sig_key: str,
+             body: Optional[bytes] = None, timeout: float = 5.0) -> bool:
+    import urllib.request
+    req = urllib.request.Request(f"http://{addr}{path}", data=body,
+                                 method=method)
+    _sign(req, method, sig_key, body or b"")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def push_replica(addr: str, entry: ReplicaEntry,
+                 timeout: float = 5.0) -> bool:
+    """PUT one payload to a buddy's replica endpoint (best-effort: a
+    failed push degrades the peer tier for that rank, never the job)."""
+    return _request(addr, f"/{_SCOPE}/replica", "PUT", "replica",
+                    body=entry_to_bytes(entry), timeout=timeout)
+
+
+def push_seal(addr: str, key: str, step: int,
+              timeout: float = 5.0) -> bool:
+    return _request(addr, f"/{_SCOPE}/seal/{key}/{int(step)}", "PUT",
+                    f"seal/{key}/{int(step)}", body=b"",
+                    timeout=timeout)
+
+
+def fetch_replica(addr: str, key: str, rank: int,
+                  timeout: float = 5.0) -> Optional[ReplicaEntry]:
+    """GET one sealed entry from a peer's endpoint; None when absent or
+    unreachable."""
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://{addr}/{_SCOPE}/replica/{key}/{int(rank)}")
+    _sign(req, "GET", f"replica/{key}/{int(rank)}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return entry_from_bytes(resp.read())
+    except (OSError, ValueError):
+        return None

@@ -237,6 +237,21 @@ class ElasticDriver:
                     self._metric("hvd_elastic_health_exclusions_total",
                                  "Hosts excluded by the health "
                                  "hint").inc(len(hosts) - len(kept))
+                    if dropped:
+                        # A watchdog eviction takes the SAME recovery
+                        # path as a crash: the next round's sync tries
+                        # the evicted ranks' buddy replicas before the
+                        # disk manifest.  Record the eviction so a hang
+                        # report (whose `recovery` field then shows the
+                        # restore outcome) can tie the two together.
+                        self._metric(
+                            "hvd_recovery_evictions_total",
+                            "Hosts evicted by the health hint whose "
+                            "state the peer-restore path must cover")\
+                            .inc(len(dropped))
+                        from ..debug import flight as _flight
+                        _flight.record("recovery.evict", None,
+                                       hosts=",".join(sorted(dropped)))
                     hosts = kept
         if self._max_np is not None:
             # Trim to max_np slots.

@@ -52,6 +52,49 @@ def _env_secret() -> Optional[str]:
     return os.environ.get("HVD_TPU_RENDEZVOUS_SECRET")
 
 
+def advertised_host() -> str:
+    """Host other fleet members should use to reach THIS process's
+    auxiliary HTTP endpoints (debug flight dumps, recovery replicas).
+    One knob steers every published endpoint: ``HVD_TPU_FLIGHT_HOST``
+    overrides; else the resolved hostname, loopback as the fallback."""
+    import socket
+    host = os.environ.get("HVD_TPU_FLIGHT_HOST")
+    if host:
+        return host
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def request_authorized(headers, method: str, scope: str, key: str,
+                       body: bytes = b"") -> bool:
+    """HMAC gate for an auxiliary-endpoint request, signed with the
+    launch secret under the KV server's scheme — body included, exactly
+    like the KV PUT protocol, so one observed signature cannot be
+    replayed to authorize a DIFFERENT payload or resource.  Without a
+    secret (unit-test/loopback mode) requests pass, like the KV
+    server's unsigned mode.  Shared by the debug and recovery
+    endpoints."""
+    secret = _env_secret()
+    if not secret:
+        return True
+    provided = headers.get(_SIG_HEADER, "")
+    return _hmac.compare_digest(
+        provided, _signature(secret, method, scope, key, body))
+
+
+def sign_request(req, method: str, scope: str, key: str,
+                 body: bytes = b"") -> None:
+    """Stamp a ``urllib.request.Request`` with the launch-secret
+    signature (no-op without a secret) — the client half of
+    :func:`request_authorized`."""
+    secret = _env_secret()
+    if secret:
+        req.add_header(_SIG_HEADER,
+                       _signature(secret, method, scope, key, body))
+
+
 class _KVHandler(BaseHTTPRequestHandler):
     server_version = "hvd_tpu_rendezvous"
 

@@ -55,6 +55,20 @@ def _fresh_runtime():
         hvd.shutdown()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_recovery_tier():
+    """The replica store and chaos schedule are process-global (one job
+    per process in production); between tests they are state leaks —
+    a sealed replica from one test must not win a later test's peer
+    restore.  Lazy: tests that never touched recovery pay nothing."""
+    yield
+    import sys as _sys
+    mod = _sys.modules.get("horovod_tpu.recovery")
+    if mod is not None:
+        mod.reset_store()
+        mod.reset_chaos()
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _no_stray_background_threads():
     """No non-daemon background thread started during the suite may

@@ -44,12 +44,19 @@ SOAK_WORKER = textwrap.dedent("""
     LOG = {log!r}
     MARK = {mark!r}
     EPOCHS = {epochs}
-    # slot -> one-shot kill epoch (marker file keeps it one-shot across
-    # respawns of the same slot).  One hard kill: the killed host is
-    # blacklisted permanently, and min_np=2 makes exactly one
-    # blacklisted host affordable; the other churn events are capacity
-    # changes (scale-up/down), which do not blacklist.
-    KILLS = {{"127.0.0.1:0": {kill_epoch}}}
+    # Seeded kill schedule: the doomed slot's kill epoch is DERIVED from
+    # HVD_TPU_CHAOS_SEED by every incarnation (hvd.recovery.chaos), not
+    # hardcoded — a respawn of the same slot computes the identical
+    # schedule, and the marker file keeps the kill one-shot across
+    # respawns.  One hard kill: the killed host is blacklisted
+    # permanently, and min_np=2 makes exactly one blacklisted host
+    # affordable; the other churn events are capacity changes
+    # (scale-up/down), which do not blacklist.  The window ends before
+    # the scale-up trigger (EPOCHS * 2 // 5) so the soak's phase order
+    # is stable under any seed.
+    from horovod_tpu.recovery.chaos import chaos
+    KILL_SLOT = "127.0.0.1:0"
+    KILL_WINDOW = (max(1, EPOCHS // 6), max(2, EPOCHS // 4))
 
     hvd.init()
     state = elastic.ObjectState(epoch=0)
@@ -58,7 +65,8 @@ SOAK_WORKER = textwrap.dedent("""
     def train(state):
         while state.epoch < EPOCHS:
             slot = os.environ["HVD_TPU_ELASTIC_SLOT"]
-            kill_epoch = KILLS.get(slot)
+            kill_epoch = (chaos().kill_epoch(slot, *KILL_WINDOW)
+                          if slot == KILL_SLOT else None)
             marker = MARK + "." + slot.replace(":", "_")
             if (kill_epoch is not None and state.epoch == kill_epoch
                     and not os.path.exists(marker)):
@@ -174,8 +182,19 @@ def test_churn_soak_kill_scale_device_autotune_join(tmp_path, monkeypatch):
     epochs = int(os.environ.get("HVD_TPU_SOAK_EPOCHS", "200"))
     script = tmp_path / "worker.py"
     script.write_text(SOAK_WORKER.format(repo=REPO, log=log, mark=mark,
-                                         epochs=epochs,
-                                         kill_epoch=epochs // 5))
+                                         epochs=epochs))
+    # Seeded kill schedule (ISSUE 6): workers derive the kill epoch from
+    # this seed via hvd.recovery.chaos — the same arithmetic verifies
+    # here that the drawn epoch stays inside the soak's stable window.
+    monkeypatch.setenv("HVD_TPU_CHAOS_SEED", "7700")
+    from horovod_tpu.recovery import Chaos
+    lo, hi = max(1, epochs // 6), max(2, epochs // 4)
+    expected_kill = Chaos(seed=7700).kill_epoch("127.0.0.1:0", lo, hi)
+    assert lo <= expected_kill < hi
+    if epochs >= 20:
+        # At realistic soak lengths the whole window sits before the
+        # scale-up trigger, keeping the soak's phase order stable.
+        assert hi <= epochs * 2 // 5
     import socket
     hostname = socket.gethostname()
     # Three distinct local "hosts" (all launch locally via _is_local):

@@ -1,0 +1,766 @@
+"""Peer-to-peer hot recovery tests (ISSUE 6 acceptance criteria).
+
+Worlds are simulated with explicit sub-meshes of the 8 virtual CPU
+devices (conftest), the idiom of test_checkpoint_engine.py.  In
+single-controller mode the process-global replica store holds every
+rank's entries, so rank death is drilled by dropping exactly the memory
+a dead process would take (``ReplicaStore.simulate_death``) — the same
+arithmetic the ring topology promises.
+
+The load-bearing assertions: peer restore is BIT-IDENTICAL to restoring
+the same step from the disk manifest (they share the extraction and the
+rebuild code by construction, and the tests prove it end to end), a
+buddy-pair death falls back to disk, torn replication is detected and
+refused, and the chaos schedules are deterministic in their seed.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu import recovery as rec
+from horovod_tpu.compat import shard_map
+from horovod_tpu.elastic.state import TpuState
+from horovod_tpu.optimizers import ZeroShardedOptimizer
+
+PARAMS = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(4, 3),
+          "b": jnp.linspace(0.5, 2.0, 16)}
+
+
+def _mesh(world):
+    return Mesh(np.array(jax.devices()[:world]), ("data",))
+
+
+def _grads():
+    return jax.tree_util.tree_map(
+        lambda p: 0.1 * (jnp.arange(p.size, dtype=p.dtype) + 1.0
+                         ).reshape(p.shape), PARAMS)
+
+
+def _step_fn(tx, mesh, state_specs):
+    def step(p, g, s):
+        updates, s2 = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s2
+    return jax.jit(shard_map(step, mesh=mesh,
+                             in_specs=(P(), P(), state_specs),
+                             out_specs=(P(), state_specs),
+                             check_vma=False))
+
+
+def _stepped_state(tx, mesh, n=2):
+    """ZeRO state advanced ``n`` optimizer steps so moments carry
+    nontrivial values."""
+    s = ckpt.zero_init(tx, PARAMS, mesh=mesh)
+    p = PARAMS
+    f = _step_fn(tx, mesh, ckpt.zero_state_specs(s))
+    for _ in range(n):
+        p, s = f(p, _grads(), s)
+    return s
+
+
+def _moment_leaves(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if getattr(leaf, "ndim", 0) >= 1:
+            out.append(np.asarray(leaf).reshape(-1))
+    return out
+
+
+def _assert_states_equal(a, b):
+    """Bit-exact equality of two restored states (same world: padded
+    buffers align; across worlds compare the common prefix)."""
+    la, lb = _moment_leaves(a), _moment_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        n = min(x.size, y.size)
+        np.testing.assert_array_equal(x[:n], y[:n])
+
+
+# ---------------------------------------------------------------------------
+# Buddy topology goldens
+# ---------------------------------------------------------------------------
+
+def test_buddy_assignment_goldens():
+    # World 1: nothing to replicate.
+    assert rec.replica_holder(0, 1) is None
+    assert rec.replica_held(0, 1) is None
+    assert rec.buddy_map(1) == {0: None}
+    # Ring shift goldens across world sizes (incl. odd).
+    assert rec.buddy_map(2) == {0: 1, 1: 0}
+    assert rec.buddy_map(3) == {0: 1, 1: 2, 2: 0}
+    assert rec.buddy_map(4) == {0: 1, 1: 2, 2: 3, 3: 0}
+    assert rec.buddy_map(5) == {0: 1, 1: 2, 2: 3, 3: 4, 4: 0}
+    # holder/held are inverses at every size and stride.
+    for world in (2, 3, 4, 5, 8):
+        for stride in (1, 2, 3):
+            for r in range(world):
+                h = rec.replica_holder(r, world, stride)
+                if h is None:
+                    continue
+                assert rec.replica_held(h, world, stride) == r
+    # Stride = local world size pushes buddies off-host: with 2 ranks
+    # per host at world 8, every buddy lands exactly one host over.
+    m = rec.buddy_map(8, stride=2)
+    for r, h in m.items():
+        assert h // 2 != r // 2, (r, h)
+    # A stride that maps every rank onto itself degrades to 1 (never
+    # self-replication).
+    assert rec.replica_holder(0, 4, stride=4) == 1
+
+
+def test_buddy_coverage_matrix():
+    # Single rank: always covered (its buddy survives).
+    assert rec.uncovered_ranks([2], 4) == []
+    # Buddy pair (adjacent on the ring): the first of the pair is lost.
+    assert rec.uncovered_ranks([1, 2], 4) == [1]
+    # Non-adjacent pair: both covered.
+    assert rec.uncovered_ranks([0, 2], 4) == []
+    # Whole world: everyone uncovered.
+    assert rec.uncovered_ranks(list(range(3)), 3) == [0, 1, 2]
+    # Stride-2 ring: adjacent ranks are NOT buddies any more.
+    assert rec.uncovered_ranks([1, 2], 8, stride=2) == []
+    assert rec.uncovered_ranks([1, 3], 8, stride=2) == [1]
+
+
+# ---------------------------------------------------------------------------
+# Chaos layer: seeded, deterministic
+# ---------------------------------------------------------------------------
+
+def test_chaos_schedule_determinism():
+    a = rec.Chaos(seed=1234)
+    b = rec.Chaos(seed=1234)
+    c = rec.Chaos(seed=4321)
+    keys = [f"slot{i}" for i in range(16)]
+    draws_a = [a.kill_epoch(k, 10, 200) for k in keys]
+    draws_b = [b.kill_epoch(k, 10, 200) for k in keys]
+    draws_c = [c.kill_epoch(k, 10, 200) for k in keys]
+    assert draws_a == draws_b                      # same seed, same schedule
+    assert draws_a != draws_c                      # seed moves the schedule
+    assert all(10 <= d < 200 for d in draws_a)
+    # Two entities draw independent epochs under one seed.
+    assert len(set(draws_a)) > 1
+
+
+def test_chaos_kill_and_crash_specs():
+    c = rec.Chaos(seed=0, kill_steps="1@7,2@9, bad, 1@12")
+    assert c.should_kill(1, 7) and c.should_kill(1, 12)
+    assert c.should_kill(2, 9)
+    assert not c.should_kill(1, 8) and not c.should_kill(0, 7)
+    with pytest.raises(rec.ChaosKill):
+        c.maybe_kill(1, 7)
+    c.maybe_kill(0, 7)  # unscheduled: no-op
+
+    # Commit-window crash: point + optional step pin; one-shot per
+    # process so a respawn replaying the step does not crash-loop.
+    c2 = rec.Chaos(seed=0, commit_crash="after_replicate@3")
+    c2.maybe_crash("after_replicate", 2)           # wrong step: no-op
+    c2.maybe_crash("pre_manifest", 3)              # wrong point: no-op
+    with pytest.raises(rec.ChaosCrash):
+        c2.maybe_crash("after_replicate", 3)
+    c2.maybe_crash("after_replicate", 3)           # disarmed after firing
+
+
+def test_chaos_env_parsing(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_CHAOS_SEED", "77")
+    monkeypatch.setenv("HVD_TPU_CHAOS_KILL_STEPS", "0@5")
+    monkeypatch.setenv("HVD_TPU_CHAOS_TORN_RANKS", "2,5")
+    rec.reset_chaos()
+    c = rec.chaos()
+    assert c.seed == 77 and c.should_kill(0, 5)
+    assert c.torn(2) and c.torn(5) and not c.torn(1)
+    assert c.enabled
+    monkeypatch.delenv("HVD_TPU_CHAOS_SEED")
+    monkeypatch.delenv("HVD_TPU_CHAOS_KILL_STEPS")
+    monkeypatch.delenv("HVD_TPU_CHAOS_TORN_RANKS")
+    rec.reset_chaos()
+    assert not rec.chaos().enabled
+
+
+# ---------------------------------------------------------------------------
+# Peer vs disk parity — the tentpole's bit-exactness bar
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("new_world", [4, 2, 8])
+def test_peer_vs_disk_parity_bit_exact(tmp_path, new_world):
+    """The same committed step restored through the replica tier and
+    through the disk manifest is IDENTICAL, at the original world and
+    resharded N→M both ways (4→2, 4→8)."""
+    root = str(tmp_path / "parity")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 0, ext, stride=1)
+    ckpt.save_extracted(root, ext, 0)
+    rec.seal_commit("opt_state", 0)
+
+    mesh_new = _mesh(new_world)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh_new)
+    disk = ckpt.restore_zero_state(root, like, mesh=mesh_new)
+    peer, extra, report = rec.peer_restore("opt_state", like,
+                                           mesh=mesh_new)
+    # Bit-exact across EVERY leaf, including the padded buffers (same
+    # world size on both paths, so shapes align exactly).
+    da = jax.tree_util.tree_leaves(disk)
+    pa = jax.tree_util.tree_leaves(peer)
+    assert len(da) == len(pa)
+    for x, y in zip(da, pa):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert report.path == "peer"
+    assert report.world_from == 4 and report.world_to == new_world
+    assert report.bytes_moved > 0 and report.seconds >= 0.0
+    # The stamped manifest extra (run fingerprint) rides both paths.
+    assert extra["run_fingerprint"]["world_size"] == 4
+    assert extra["run_fingerprint"]["leaf_spec_sha256"] == \
+        ckpt.read_manifest(root, 0).extra["run_fingerprint"][
+            "leaf_spec_sha256"]
+
+
+def test_peer_restore_survives_single_rank_loss(tmp_path):
+    """Losing one rank (own copy gone, buddy copy survives) keeps full
+    coverage: the restore is served from fleet memory, bit-exact."""
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 5, ext, stride=1)
+    rec.seal_commit("opt_state", 5)
+
+    rec.store().simulate_death([2], 4)
+    mesh2 = _mesh(2)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    peer, _, report = rec.peer_restore("opt_state", like, mesh=mesh2)
+    _assert_states_equal(s, peer)
+    assert report.step == 5 and report.path == "peer"
+
+
+def test_buddy_pair_death_is_a_miss_nonadjacent_is_not():
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 0, ext, stride=1)
+    rec.seal_commit("opt_state", 0)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+
+    # Non-adjacent pair: still covered.
+    rec.store().simulate_death([0, 2], 4)
+    _, _, report = rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert report.path == "peer"
+
+    # Adjacent pair: with 1 and 2 both dead, rank 0 (holder 1 dead)
+    # AND rank 1 (holder 2 dead) are gone from every memory.
+    rec.store().simulate_death([1], 4)
+    with pytest.raises(rec.PeerRestoreUnavailable,
+                       match="missing old-world ranks \\[0, 1\\]"):
+        rec.peer_restore("opt_state", like, mesh=mesh4)
+
+
+def test_unsealed_entries_never_restore():
+    """Two-phase commit: a crash inside the commit window (replica
+    placed, commit never completed) must not make that step restorable
+    — the previous sealed step still is."""
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s0 = _stepped_state(tx, mesh4, n=1)
+    s1 = _stepped_state(tx, mesh4, n=3)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+
+    ext0 = ckpt.extract_zero_state(s0, mesh=mesh4)
+    rec.replicate("opt_state", 0, ext0, stride=1)
+    rec.seal_commit("opt_state", 0)
+    ext1 = ckpt.extract_zero_state(s1, mesh=mesh4)
+    rec.replicate("opt_state", 1, ext1, stride=1)   # never sealed
+
+    peer, _, report = rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert report.step == 0
+    _assert_states_equal(s0, peer)
+    # Pinning the unsealed step is a miss, not a torn restore.
+    with pytest.raises(rec.PeerRestoreUnavailable):
+        rec.peer_restore("opt_state", like, mesh=mesh4, step=1)
+    # Once sealed, step 1 wins.
+    rec.seal_commit("opt_state", 1)
+    _, _, report = rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert report.step == 1
+
+
+def test_torn_replication_detected(monkeypatch):
+    """A buddy copy corrupted after checksumming (the torn-replication
+    drill) is excluded from coverage; when it was the ONLY surviving
+    copy the peer tier refuses rather than restoring corrupt bits."""
+    monkeypatch.setenv("HVD_TPU_CHAOS_TORN_RANKS", "1")
+    rec.reset_chaos()
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 0, ext, stride=1)
+    rec.seal_commit("opt_state", 0)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+
+    # Owner alive: its own (untorn) copy wins — restore succeeds.
+    _, _, report = rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert report.path == "peer"
+
+    # Owner dead: only the torn buddy copy remains for rank 1.
+    from horovod_tpu.metrics.registry import registry
+    torn_before = registry().counter(
+        "hvd_recovery_torn_replicas_total").value
+    rec.store().simulate_death([1], 4)
+    with pytest.raises(rec.PeerRestoreUnavailable, match="torn"):
+        rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert registry().counter(
+        "hvd_recovery_torn_replicas_total").value > torn_before
+
+
+# ---------------------------------------------------------------------------
+# TpuState end-to-end: disk-free restarts, disk fallback, chaos windows
+# ---------------------------------------------------------------------------
+
+class _FakeLoader:
+    """Minimal checkpointable-iterator protocol object."""
+
+    def __init__(self, **state):
+        self._state = dict(state)
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def load_state_dict(self, state):
+        self._state = dict(state)
+
+
+def test_tpustate_disk_free_elastic_restart():
+    """The headline: no checkpoint_dir anywhere — commit replicates to
+    fleet memory, a rank dies, and the resized world restores the
+    committed state (moments AND data-iterator position) purely from
+    peers, bit-exact."""
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    s = _stepped_state(tx, mesh4)
+    loader = _FakeLoader(epoch=3, cursor=17, seed=7)
+    state = TpuState(opt_state=s, checkpoint_mesh=mesh4, loader=loader)
+    state.commit()
+
+    rec.store().simulate_death([3], 4)
+    fresh = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    newcomer = TpuState(opt_state=fresh, checkpoint_mesh=mesh2,
+                        loader=_FakeLoader(epoch=0, cursor=0, seed=0))
+    newcomer.sync(root=0)
+    _assert_states_equal(s, newcomer.opt_state)
+    assert newcomer.loader.state_dict() == \
+        {"epoch": 3, "cursor": 17, "seed": 7}
+    report = rec.last_report()
+    assert report.path == "peer" and report.world_to == 2
+
+
+def test_disk_free_step_counters_stay_monotonic_across_sync():
+    """With no disk `latest` to re-seed from, sync() must seed the
+    cleared step counters from the agreed committed record — a restart
+    at 0 would desync mixed rounds and leave a superseded world's
+    higher-step replicas unprunable (and able to outvote the live
+    run)."""
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = TpuState(opt_state=_stepped_state(tx, mesh4),
+                     checkpoint_mesh=mesh4)
+    state.commit()
+    state.commit()
+    assert state._ckpt_committed_step == {"opt_state": 1}
+    state._ckpt_next_step.clear()  # what an elastic reset's sync does
+    state.sync(root=0)
+    state.commit()
+    assert state._ckpt_committed_step["opt_state"] == 2
+    entry = rec.store().get("opt_state", 0)
+    assert entry is not None and entry.step == 2
+
+
+def test_tpustate_peer_and_disk_agree(tmp_path):
+    """With both tiers live, sync prefers peer; forcing the peer tier
+    empty falls back to disk — and both restores are bit-identical."""
+    ckdir = str(tmp_path / "both")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    s = _stepped_state(tx, mesh4)
+    state = TpuState(opt_state=s, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()
+
+    fresh = ckpt.zero_init(tx, PARAMS, mesh=mesh2)
+    via_peer = TpuState(opt_state=fresh, checkpoint_dir=ckdir,
+                        checkpoint_mesh=mesh2)
+    via_peer.sync(root=0)
+    assert rec.last_report().path == "peer"
+
+    rec.store().clear()  # correlated loss: whole fleet memory gone
+    via_disk = TpuState(opt_state=fresh, checkpoint_dir=ckdir,
+                        checkpoint_mesh=mesh2)
+    via_disk.sync(root=0)
+    assert rec.last_report().path == "disk"
+
+    for x, y in zip(jax.tree_util.tree_leaves(via_peer.opt_state),
+                    jax.tree_util.tree_leaves(via_disk.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_tpustate_buddy_pair_death_falls_back_to_disk(tmp_path):
+    ckdir = str(tmp_path / "fallback")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    state = TpuState(opt_state=s, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()
+
+    rec.store().simulate_death([1, 2], 4)  # adjacent: rank 1 uncovered
+    fresh = ckpt.zero_init(tx, PARAMS, mesh=_mesh(2))
+    survivor = TpuState(opt_state=fresh, checkpoint_dir=ckdir,
+                        checkpoint_mesh=_mesh(2))
+    survivor.sync(root=0)
+    assert rec.last_report().path == "disk"
+    _assert_states_equal(s, survivor.opt_state)
+
+
+def test_tpustate_commit_window_crash_restores_previous_step(tmp_path,
+                                                             monkeypatch):
+    """Chaos commit-window drill: a crash between replica placement and
+    the disk commit leaves step 1 unsealed AND torn on disk; the next
+    sync restores step 0 — from peers — on both tiers' agreement."""
+    ckdir = str(tmp_path / "window")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s0 = _stepped_state(tx, mesh4, n=1)
+    state = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4)
+    state.commit()  # step 0 fully committed (disk + sealed replicas)
+
+    monkeypatch.setenv("HVD_TPU_CHAOS_COMMIT_CRASH", "after_replicate@1")
+    rec.reset_chaos()
+    state.opt_state = _stepped_state(tx, mesh4, n=3)
+    with pytest.raises(rec.ChaosCrash):
+        state.commit()
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) == 0
+
+    monkeypatch.delenv("HVD_TPU_CHAOS_COMMIT_CRASH")
+    rec.reset_chaos()
+    fresh = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    survivor = TpuState(opt_state=fresh, checkpoint_dir=ckdir,
+                        checkpoint_mesh=mesh4)
+    survivor.sync(root=0)
+    assert rec.last_report().path == "peer"
+    assert rec.last_report().step == 0
+    _assert_states_equal(s0, survivor.opt_state)
+
+
+def test_chaos_pre_manifest_crash_leaves_torn_disk_step(tmp_path,
+                                                        monkeypatch):
+    """The engine-window drill: shards written, manifest never — the
+    step is torn on disk (never `latest`) and unsealed in memory."""
+    ckdir = str(tmp_path / "torn")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = TpuState(opt_state=_stepped_state(tx, mesh4),
+                     checkpoint_dir=ckdir, checkpoint_mesh=mesh4)
+    state.commit()
+    monkeypatch.setenv("HVD_TPU_CHAOS_COMMIT_CRASH", "pre_manifest@1")
+    rec.reset_chaos()
+    with pytest.raises(rec.ChaosCrash):
+        state.commit()
+    zdir = os.path.join(ckdir, "opt_state")
+    assert ckpt.latest_step(zdir) == 0
+    assert os.path.isdir(ckpt.step_dir(zdir, 1))          # torn debris
+    assert not ckpt.is_committed(zdir, 1)
+    # The replica tier agrees: step 1 never sealed.
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    _, _, report = rec.peer_restore("opt_state", like, mesh=mesh4)
+    assert report.step == 0
+
+
+def test_tpustate_peer_recovery_disabled_touches_nothing(tmp_path):
+    ckdir = str(tmp_path / "off")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = TpuState(opt_state=_stepped_state(tx, mesh4),
+                     checkpoint_dir=ckdir, checkpoint_mesh=mesh4,
+                     peer_recovery=False)
+    state.commit()
+    assert rec.store().keys() == []
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) == 0
+
+
+# ---------------------------------------------------------------------------
+# Async snapshot commit
+# ---------------------------------------------------------------------------
+
+def test_async_commit_overlaps_and_barriers_at_next_commit(tmp_path,
+                                                           monkeypatch):
+    """The disk write runs behind the training step: commit() returns
+    while the flush is in flight; the NEXT commit() waits for it."""
+    ckdir = str(tmp_path / "async")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = TpuState(opt_state=_stepped_state(tx, mesh4),
+                     checkpoint_dir=ckdir, checkpoint_mesh=mesh4,
+                     async_commit=True)
+
+    gate = threading.Event()
+    import horovod_tpu.checkpoint as ckpt_mod
+    real = ckpt_mod.save_extracted
+
+    def slow_save(*args, **kwargs):
+        assert gate.wait(timeout=30), "commit barrier deadlock"
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ckpt_mod, "save_extracted", slow_save)
+    state.commit()
+    # The flush is blocked on the gate, yet commit() already returned —
+    # replication, disk write AND seal all left the hot path.  The
+    # replica tier seals on the background thread BEFORE the disk
+    # write (its commit record must not depend on the flush), so the
+    # sealed entry appears while the disk step is still gated.
+    assert state._committer.pending
+    deadline = time.time() + 10
+    while rec.store().get("opt_state", 0) is None \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    entry = rec.store().get("opt_state", 0)
+    assert entry is not None and entry.sealed and entry.step == 0
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) is None
+
+    gate.set()
+    state.commit()  # barrier: waits for step 0's flush, then flushes 1
+    state._committer.wait()
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) == 1
+    entry = rec.store().get("opt_state", 0)
+    assert entry is not None and entry.sealed and entry.step == 1
+
+
+def test_async_commit_flush_failure_surfaces_at_next_commit(tmp_path,
+                                                            monkeypatch):
+    ckdir = str(tmp_path / "asyncfail")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    state = TpuState(opt_state=_stepped_state(tx, mesh4),
+                     checkpoint_dir=ckdir, checkpoint_mesh=mesh4,
+                     async_commit=True)
+    import horovod_tpu.checkpoint as ckpt_mod
+
+    state.commit()  # step 0: real flush (both tiers land)
+    state._committer.wait()
+
+    def boom(*args, **kwargs):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_mod, "save_extracted", boom)
+    state.commit()  # step 1: failing flush scheduled
+    with pytest.raises(OSError, match="disk full"):
+        state.commit()  # surfaces at the commit barrier
+    # sync() degrades instead of raising — and the REPLICA tier still
+    # covers the recorded step: the async flush seals the replicas
+    # BEFORE the disk write, so a disk failure cannot void a
+    # successful replication (that would pair step-1 params with
+    # step-0 moments).  The peer path restores step 1; disk lags.
+    state.sync(root=0)
+    assert rec.last_report().path == "peer"
+    assert rec.last_report().step == 1
+    assert ckpt.latest_step(os.path.join(ckdir, "opt_state")) == 0
+
+
+def test_async_pre_seal_failure_unpins_the_ghost_step(tmp_path,
+                                                      monkeypatch):
+    """An async flush that dies BEFORE the replica seal leaves the step
+    in no tier; the committed-step record (already updated on the main
+    thread) must be pruned at the next barrier, or sync would pin a
+    ghost step, miss on both tiers, and silently restore one step
+    behind the params."""
+    ckdir = str(tmp_path / "ghost")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s0 = _stepped_state(tx, mesh4)
+    state = TpuState(opt_state=s0, checkpoint_dir=ckdir,
+                     checkpoint_mesh=mesh4, async_commit=True)
+    state.commit()
+    state._committer.wait()  # step 0 lands in both tiers
+
+    import horovod_tpu.recovery as rec_mod
+
+    def boom(*args, **kwargs):
+        raise MemoryError("replication OOM")
+
+    monkeypatch.setattr(rec_mod, "replicate", boom)
+    state.commit()  # step 1: flush dies before replicate, let alone seal
+    with pytest.raises(MemoryError):
+        state.commit()  # surfaces at the barrier; ghost step 1 pruned
+    assert "opt_state" not in state._ckpt_committed_step or \
+        state._ckpt_committed_step["opt_state"] == 0
+    state.sync(root=0)
+    assert rec.last_report().step == 0  # newest REAL step, not the ghost
+    _assert_states_equal(s0, state.opt_state)
+
+
+# ---------------------------------------------------------------------------
+# Streaming per-leaf restore
+# ---------------------------------------------------------------------------
+
+def test_streaming_restore_bit_identical(tmp_path, monkeypatch):
+    root = str(tmp_path / "stream")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ckpt.save_zero_state(root, s, step=0, mesh=mesh4)
+
+    for new_world in (4, 2):
+        mesh_new = _mesh(new_world)
+        like = ckpt.zero_init(tx, PARAMS, mesh=mesh_new)
+        eager = ckpt.restore_zero_state(root, like, mesh=mesh_new,
+                                        streaming=False)
+        lazy = ckpt.restore_zero_state(root, like, mesh=mesh_new,
+                                       streaming=True)
+        for x, y in zip(jax.tree_util.tree_leaves(eager),
+                        jax.tree_util.tree_leaves(lazy)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # The env knob selects streaming without a call-site change.
+    monkeypatch.setenv("HVD_TPU_CKPT_STREAMING", "1")
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    via_env = ckpt.restore_zero_state(root, like, mesh=mesh4)
+    for x, y in zip(jax.tree_util.tree_leaves(s),
+                    jax.tree_util.tree_leaves(via_env)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_lazy_step_reads_one_leaf_at_a_time(tmp_path):
+    root = str(tmp_path / "lazy")
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ckpt.save_zero_state(root, s, step=0, mesh=mesh4)
+    manifest = ckpt.read_manifest(root, 0)
+    full = ckpt.restore_leaves(root, 0, 4)
+    with ckpt.open_step(root, 0, 4) as lazy:
+        assert lazy.manifest.step == 0
+        for spec in manifest.leaves:
+            np.testing.assert_array_equal(lazy.full_value(spec),
+                                          full.full_value(spec))
+            np.testing.assert_array_equal(lazy.padded_full(spec),
+                                          full.padded_full(spec))
+    # Closed handles refuse further reads (the restore freed them).
+    with pytest.raises(Exception):
+        lazy.full_value(manifest.leaves[0])
+
+
+# ---------------------------------------------------------------------------
+# Transport: replica endpoints over HTTP
+# ---------------------------------------------------------------------------
+
+def _sample_entry(step=0, sealed=False):
+    arrays = {".x": np.arange(6, dtype=np.float32)}
+    return rec.ReplicaEntry(
+        key="k", rank=0, step=step, world=2, fingerprint="fp",
+        manifest_json="{}", arrays=arrays,
+        checksum=rec.payload_checksum(arrays), sealed=sealed)
+
+
+def test_transport_push_seal_fetch_roundtrip():
+    server = rec.transport.RecoveryServer(host="127.0.0.1")
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        entry = _sample_entry()
+        assert rec.transport.push_replica(addr, entry)
+        # Unsealed: stored but never served.
+        assert rec.transport.fetch_replica(addr, "k", 0) is None
+        assert rec.transport.push_seal(addr, "k", 0)
+        got = rec.transport.fetch_replica(addr, "k", 0)
+        assert got is not None and got.sealed
+        assert rec.verify_entry(got)
+        np.testing.assert_array_equal(got.arrays[".x"],
+                                      entry.arrays[".x"])
+        # Missing entries are a clean 404 → None.
+        assert rec.transport.fetch_replica(addr, "k", 9) is None
+    finally:
+        server.stop()
+
+
+def test_transport_requires_signature_when_secret_set(monkeypatch):
+    server = rec.transport.RecoveryServer(host="127.0.0.1")
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_SECRET", "s3cret")
+        entry = _sample_entry()
+        assert rec.transport.push_replica(addr, entry)   # signed: ok
+        assert rec.transport.push_seal(addr, "k", 0)
+        assert rec.transport.fetch_replica(addr, "k", 0) is not None
+        # An unsigned request is rejected outright.
+        import urllib.error
+        import urllib.request
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://{addr}/recovery/replica/k/0", timeout=5)
+        assert err.value.code == 403
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability: reports, metrics, hang-report integration
+# ---------------------------------------------------------------------------
+
+def test_hang_report_records_recovery_outcome():
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 3, ext, stride=1)
+    rec.seal_commit("opt_state", 3)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    rec.peer_restore("opt_state", like, mesh=mesh4)
+
+    from horovod_tpu.debug.hang import build_hang_report
+    report = build_hang_report(
+        [{"name": "grad.allreduce", "type": 0, "missing": [1]}],
+        {0: {"events": []}}, world=2, step=9)
+    assert report["recovery"]["path"] == "peer"
+    assert report["recovery"]["step"] == 3
+    assert report["recovery"]["bytes_moved"] > 0
+
+
+def test_recovery_metrics_and_flight_events():
+    from horovod_tpu.debug import flight
+    from horovod_tpu.metrics.registry import registry
+    reg = registry()
+    repl_before = reg.counter("hvd_recovery_replications_total").value
+    peer_before = reg.counter("hvd_recovery_restores_total",
+                              path="peer").value
+
+    tx = ZeroShardedOptimizer(optax.adam(1e-2))
+    mesh4 = _mesh(4)
+    s = _stepped_state(tx, mesh4)
+    ext = ckpt.extract_zero_state(s, mesh=mesh4)
+    rec.replicate("opt_state", 0, ext, stride=1)
+    rec.seal_commit("opt_state", 0)
+    like = ckpt.zero_init(tx, PARAMS, mesh=mesh4)
+    rec.peer_restore("opt_state", like, mesh=mesh4)
+
+    assert reg.counter("hvd_recovery_replications_total").value == \
+        repl_before + 1
+    assert reg.counter("hvd_recovery_restores_total",
+                       path="peer").value == peer_before + 1
+    assert reg.counter("hvd_recovery_replica_bytes_total").value > 0
+    kinds = {e.get("kind") for e in
+             flight.recorder().dump_obj()["events"]}
+    assert "recovery.replicate" in kinds
+    assert "recovery.restore.done" in kinds
