@@ -44,6 +44,7 @@ TIER_FAST=(
   test_flash_attention.py
   test_launch_flags.py
   test_metrics.py
+  test_net_resilience.py
   test_optimizers.py test_parallel.py test_probe_rendezvous.py
   test_quantization.py
   test_recovery.py

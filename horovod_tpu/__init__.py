@@ -46,6 +46,7 @@ from . import data
 from . import debug
 from . import elastic
 from . import metrics
+from . import net
 from . import recovery
 
 __all__ = [
@@ -69,5 +70,5 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "mesh_lib", "checkpoint", "data", "debug", "elastic", "metrics",
-    "recovery",
+    "net", "recovery",
 ]

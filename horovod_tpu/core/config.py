@@ -67,6 +67,25 @@ CHAOS_KILL_STEPS = "CHAOS_KILL_STEPS"          # "rank@step,..." kill schedule
 CHAOS_COMMIT_CRASH = "CHAOS_COMMIT_CRASH"      # "<point>[@step]" crash point
 CHAOS_SLOW_PEER_MS = "CHAOS_SLOW_PEER_MS"      # peer-serving latency injection
 CHAOS_TORN_RANKS = "CHAOS_TORN_RANKS"          # corrupt these ranks' replicas
+# Self-healing wire fabric (horovod_tpu/net/ + native/src/net.cc).  The
+# native knobs are parsed in C (net.cc NetResilience/NetChaos); they are
+# listed here so the knob table has one home and launch.py exports them.
+NET_RESILIENCE = "NET_RESILIENCE"              # escalation ladder on/off
+NET_PROBE_MS = "NET_PROBE_MS"                  # no-progress reconnect probe
+NET_RECONNECT_S = "NET_RECONNECT_S"            # budget per reconnect
+NET_OP_DEADLINE_S = "NET_OP_DEADLINE_S"        # per-transfer total budget
+NET_MAX_RENEG = "NET_MAX_RENEG"                # ring re-formations cap
+NET_RENEGOTIATE = "NET_RENEGOTIATE"            # rung 3 on/off
+NET_HTTP_RETRIES = "NET_HTTP_RETRIES"          # attempts per HTTP request
+NET_HTTP_BACKOFF_MS = "NET_HTTP_BACKOFF_MS"    # base of the jittered backoff
+# Seeded wire chaos (both the native socket layer and the Python HTTP
+# planes read these; inert unless set).
+CHAOS_NET_SEED = "CHAOS_NET_SEED"              # wire-chaos schedule seed
+CHAOS_NET_DROP_PCT = "CHAOS_NET_DROP_PCT"      # swallow a frame/request (%)
+CHAOS_NET_RESET_PCT = "CHAOS_NET_RESET_PCT"    # connection reset (%)
+CHAOS_NET_DELAY_MS = "CHAOS_NET_DELAY_MS"      # injected latency per frame
+CHAOS_NET_TRUNCATE = "CHAOS_NET_TRUNCATE"      # truncate a frame/response (%)
+CHAOS_NET_BLACKHOLE = "CHAOS_NET_BLACKHOLE"    # "a-b,..." dead rank pairs
 
 _PREFIXES = ("HVD_TPU_", "HOROVOD_")
 
@@ -171,6 +190,17 @@ class Config:
     recovery_stride: int = 0   # 0 = auto: the local world size
     async_commit: bool = False
     ckpt_streaming: bool = False
+    # Self-healing wire fabric: graded failure escalation on every
+    # cross-host channel (native TCP ring: framing + acks + reconnect-
+    # and-resume + ring renegotiation; HTTP planes: per-attempt deadlines
+    # with bounded jittered retries).  The native defaults live in
+    # net.cc NetResilience() and MUST match these.
+    net_resilience: bool = True
+    net_probe_ms: float = 10000.0
+    net_reconnect_s: float = 10.0
+    net_op_deadline_s: float = 60.0
+    net_http_retries: int = 3        # attempts per HTTP request
+    net_http_backoff_ms: float = 50.0
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -236,6 +266,16 @@ class Config:
             0, get_int(RECOVERY_STRIDE, cfg.recovery_stride))
         cfg.async_commit = get_bool(ASYNC_COMMIT, cfg.async_commit)
         cfg.ckpt_streaming = get_bool(CKPT_STREAMING, cfg.ckpt_streaming)
+        cfg.net_resilience = get_bool(NET_RESILIENCE, cfg.net_resilience)
+        cfg.net_probe_ms = get_float(NET_PROBE_MS, cfg.net_probe_ms)
+        cfg.net_reconnect_s = get_float(NET_RECONNECT_S,
+                                        cfg.net_reconnect_s)
+        cfg.net_op_deadline_s = get_float(NET_OP_DEADLINE_S,
+                                          cfg.net_op_deadline_s)
+        cfg.net_http_retries = max(
+            1, get_int(NET_HTTP_RETRIES, cfg.net_http_retries))
+        cfg.net_http_backoff_ms = get_float(NET_HTTP_BACKOFF_MS,
+                                            cfg.net_http_backoff_ms)
         if cfg.autotune and get_env(FUSION_THRESHOLD) is None:
             cfg.fusion_threshold_bytes = 128 * 1024 * 1024
         return cfg

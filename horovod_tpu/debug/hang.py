@@ -114,6 +114,12 @@ def build_hang_report(stalled: List[dict],
         # succeeded — or that it fell back to disk and is still
         # restoring.  None when no restore has run.
         "recovery": _last_recovery(),
+        # The wire fabric's escalation-ladder state: a stall with
+        # ``retrying`` True is "retrying, deadline not yet reached" —
+        # the collective is mid reconnect-and-resume and will either
+        # heal or escalate on its own — while ``retrying`` False with a
+        # stall is a genuinely wedged rank (evict, don't wait).
+        "net": _net_status(),
     }
 
 
@@ -122,6 +128,14 @@ def _last_recovery() -> Optional[dict]:
         from ..recovery import last_report
         report = last_report()
         return None if report is None else report.to_dict()
+    except Exception:  # noqa: BLE001 — diagnosis best-effort
+        return None
+
+
+def _net_status() -> Optional[dict]:
+    try:
+        from .. import net as _net
+        return _net.status()
     except Exception:  # noqa: BLE001 — diagnosis best-effort
         return None
 

@@ -169,13 +169,18 @@ def serve_and_publish(rank: Optional[int] = None,
 
 def fetch_flight_dump(addr: str, timeout: float = 3.0) -> Optional[dict]:
     """GET one rank's ``/debug/flight`` (signed with the launch secret
-    when one is set); None when unreachable/invalid."""
+    when one is set); None when unreachable/invalid.  Rides the hvd.net
+    retry ladder so a transient fault doesn't turn a reachable rank's
+    evidence into "unreachable" in a hang report."""
+    import urllib.error
     import urllib.request
+    from .. import net as _net
     from ..runner.rendezvous import sign_request
     req = urllib.request.Request(f"http://{addr}/debug/flight")
     sign_request(req, "GET", "debug", "flight")
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read().decode("utf-8"))
-    except (OSError, ValueError):
+        body = _net.request_bytes(req, timeout=timeout,
+                                  name="debug.flight")
+        return json.loads(body.decode("utf-8"))
+    except (urllib.error.HTTPError, OSError, ValueError):
         return None

@@ -137,6 +137,9 @@ def load_library():
     lib.hvd_native_last_fused_names.restype = ctypes.c_int64
     lib.hvd_native_counters.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
+    lib.hvd_native_net_counters.restype = ctypes.c_int
+    lib.hvd_native_net_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
     lib.hvd_native_allreduce_device.restype = ctypes.c_int64
     lib.hvd_native_allreduce_device.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
@@ -759,6 +762,22 @@ class NativeController:
     def adasum_scratch_peak(self) -> int:
         """Peak scratch bytes of the Adasum VHDD path since last reset."""
         return self._lib.hvd_native_adasum_scratch_peak()
+
+    NET_COUNTER_FIELDS = ("retries", "reconnects", "renegotiations",
+                          "resets_avoided", "chaos_injected",
+                          "recovering_now", "last_recovery_age_ms")
+
+    def net_counters(self) -> dict:
+        """Self-healing wire fabric counters (net.cc escalation ladder):
+        recovery attempts / resumed reconnects / ring renegotiations /
+        collectives completed after >= 1 recovery, plus the live
+        ``recovering_now`` channel count and the age of the last
+        recovery activity (-1 = never) — the hang-report evidence for
+        "retrying, deadline not yet reached" vs "wedged"."""
+        buf = (ctypes.c_int64 * len(self.NET_COUNTER_FIELDS))()
+        n = self._lib.hvd_native_net_counters(buf, len(buf))
+        return {k: int(buf[i]) for i, k in
+                enumerate(self.NET_COUNTER_FIELDS[:n])}
 
     def adasum_scratch_reset(self) -> None:
         self._lib.hvd_native_adasum_scratch_reset()

@@ -257,6 +257,38 @@ void hvd_native_counters(int64_t* bytes, double* seconds) {
   Runtime::Get().ReadCounters(bytes, seconds);
 }
 
+// Self-healing wire fabric counters (net.cc escalation ladder), consumed
+// by hvd.net / hvd.metrics and by hang reports to tell "retrying,
+// deadline not yet reached" from "wedged".  Layout (n capped):
+//   [0] retries          — recovery attempts, any rung
+//   [1] reconnects       — connections re-established and resumed
+//   [2] renegotiations   — ring re-formations around a dead link
+//   [3] resets_avoided   — ops/collectives completed after >= 1 recovery
+//   [4] chaos_injected   — faults the seeded chaos layer fired
+//   [5] recovering_now   — channels currently mid-recovery (> 0 means a
+//                          retry ladder is live right now)
+//   [6] last_recovery_age_ms — ms since the last recovery activity
+//                              (-1: never)
+//   [7..10] dev diagnostics: wall us inside channel Send/Recv + op
+//           counts (protocol-cost attribution; not exported to metrics)
+int hvd_native_net_counters(int64_t* out, int n) {
+  NetCountersState& c = NetCounters();
+  int64_t vals[15] = {
+      c.retries.load(),        c.reconnects.load(),
+      c.renegotiations.load(), c.resets_avoided.load(),
+      c.chaos_injected.load(), c.recovering_now.load(),
+      c.last_recovery_ms.load() == 0
+          ? -1
+          : SteadyNowMs() - c.last_recovery_ms.load(),
+      c.send_us.load(),        c.recv_us.load(),
+      c.send_ops.load(),       c.recv_ops.load(),
+      c.pump_wait_us.load(),   c.pump_read_us.load(),
+      c.write_us.load(),       c.cvwait_us.load()};
+  int m = n < 15 ? n : 15;
+  for (int i = 0; i < m; ++i) out[i] = vals[i];
+  return m;
+}
+
 // Stall-inspector snapshot for the Python-side hang-diagnosis watchdog:
 // fills buf with a JSON array of tensors past the warning window (name,
 // request type, age, missing + submitted rank lists).  Returns the number

@@ -183,24 +183,9 @@ Status SendStream(Network& net, int peer, const uint8_t* buf, size_t n) {
     }
     return Status::OK();
   }
-  Socket* sock = net.peer(peer);
-  size_t sent = 0;
-  while (sent < n) {
-    pollfd pfd{sock->fd(), POLLOUT, 0};
-    int pr = ::poll(&pfd, 1, 60000);
-    if (pr < 0 && errno == EINTR) continue;
-    if (pr <= 0) return Status::Error("collective send timeout");
-    ssize_t k = ::send(sock->fd(), buf + sent,
-                       std::min<size_t>(n - sent, 4 << 20),
-                       MSG_NOSIGNAL | MSG_DONTWAIT);
-    if (k < 0) {
-      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
-        continue;
-      return Status::Error("send failed in collective");
-    }
-    sent += k;
-  }
-  return Status::OK();
+  // TCP: the resilient channel (framing + acks + reconnect-and-resume
+  // when HVD_TPU_NET_RESILIENCE is on; raw 4 MB chunks otherwise).
+  return net.chan(peer)->Send(buf, n);
 }
 
 Status RecvStream(Network& net, int peer, uint8_t* dst, size_t n,
@@ -217,26 +202,7 @@ Status RecvStream(Network& net, int peer, uint8_t* dst, size_t n,
     }
     return Status::OK();
   }
-  Socket* sock = net.peer(peer);
-  size_t received = 0;
-  while (received < n) {
-    pollfd pfd{sock->fd(), POLLIN, 0};
-    int pr = ::poll(&pfd, 1, 60000);
-    if (pr < 0 && errno == EINTR) continue;
-    if (pr <= 0) return Status::Error("collective recv timeout");
-    ssize_t k = ::recv(sock->fd(), dst + received,
-                       std::min<size_t>(n - received, 4 << 20),
-                       MSG_DONTWAIT);
-    if (k == 0) return Status::Aborted("peer closed during collective");
-    if (k < 0) {
-      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
-        continue;
-      return Status::Error("recv failed in collective");
-    }
-    received += k;
-    if (on_recv) on_recv(received);
-  }
-  return Status::OK();
+  return net.chan(peer)->Recv(dst, n, on_recv);
 }
 
 // Full-duplex transfer: simultaneously stream nsend bytes toward
@@ -399,24 +365,27 @@ Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
                   size_t nsend, int recv_peer, uint8_t* recv_buf,
                   size_t nrecv,
                   const std::function<void(size_t)>& on_recv = nullptr) {
-  if (net.shm_tx(send_peer) != nullptr ||
+  if (NetResilience().enabled || net.shm_tx(send_peer) != nullptr ||
       net.shm_rx(recv_peer) != nullptr || nsend + nrecv >= (4u << 20)) {
+    // Resilient mode always takes the threaded variant: the interleaved
+    // single-thread poll loop below speaks the raw byte protocol and
+    // cannot parse frames.
     return FullDuplexThreaded(net, send_peer, send_buf, nsend, recv_peer,
                               recv_buf, nrecv, on_recv);
   }
-  Socket* send_sock = net.peer(send_peer);
-  Socket* recv_sock = net.peer(recv_peer);
+  const int send_fd = net.chan(send_peer)->fd();
+  const int recv_fd = net.chan(recv_peer)->fd();
   size_t sent = 0, received = 0;
   while (sent < nsend || received < nrecv) {
     struct pollfd fds[2];
     int nf = 0;
     int send_i = -1, recv_i = -1;
     if (sent < nsend) {
-      fds[nf] = {send_sock->fd(), POLLOUT, 0};
+      fds[nf] = {send_fd, POLLOUT, 0};
       send_i = nf++;
     }
     if (received < nrecv) {
-      fds[nf] = {recv_sock->fd(), POLLIN, 0};
+      fds[nf] = {recv_fd, POLLIN, 0};
       recv_i = nf++;
     }
     int pr = ::poll(fds, nf, 60000);
@@ -424,7 +393,7 @@ Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
     if (pr <= 0)
       return Status::Error("collective transfer timeout/poll error");
     if (send_i >= 0 && (fds[send_i].revents & (POLLOUT | POLLERR))) {
-      ssize_t k = ::send(send_sock->fd(), send_buf + sent,
+      ssize_t k = ::send(send_fd, send_buf + sent,
                          std::min<size_t>(nsend - sent, 4 << 20),
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
@@ -432,7 +401,7 @@ Status FullDuplex(Network& net, int send_peer, const uint8_t* send_buf,
       if (k > 0) sent += k;
     }
     if (recv_i >= 0 && (fds[recv_i].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t k = ::recv(recv_sock->fd(), recv_buf + received,
+      ssize_t k = ::recv(recv_fd, recv_buf + received,
                          std::min<size_t>(nrecv - received, 4 << 20),
                          MSG_DONTWAIT);
       if (k == 0) return Status::Aborted("peer closed during collective");
@@ -586,11 +555,272 @@ Status RingAllreduceGroup(Network& net, void* vbuf, int64_t count,
   return Status::OK();
 }
 
+// ---------------------------------------------------------------------------
+// Graded ring recovery (rungs 3-4 of the escalation ladder).  Rungs 1-2 —
+// per-frame deadlines/acks and reconnect-and-resume — live inside the
+// Channel layer (net.cc) and are transparent here.  When a reconnect
+// exhausts, the flat ring collectives below agree the failure across the
+// fleet through the coordinator star, re-form the ring with the dead link
+// never an adjacency, reset the mesh at a fresh generation, and retry the
+// attempt from a pre-collective snapshot.  Only when renegotiation
+// exhausts (or the coordinator link itself is dead) does the error
+// propagate into HorovodInternalError → elastic reset.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// A cyclic order of 0..P-1 in which no pair in `bad` is adjacent.
+// Deterministic DFS (identical on every rank, though only rank 0 runs
+// it); returns false when no such cycle exists (e.g. a rank with P-1
+// dead links).
+bool RingOrderDfs(int P, const std::set<std::pair<int, int>>& bad,
+                  std::vector<int>& order, std::vector<bool>& used,
+                  int64_t* budget) {
+  auto is_bad = [&](int a, int b) {
+    return bad.count({std::min(a, b), std::max(a, b)}) != 0;
+  };
+  if (static_cast<int>(order.size()) == P)
+    return !is_bad(order.back(), order.front());
+  if ((*budget)-- <= 0) return false;
+  for (int cand = 0; cand < P; ++cand) {
+    if (used[cand] || is_bad(order.back(), cand)) continue;
+    used[cand] = true;
+    order.push_back(cand);
+    if (RingOrderDfs(P, bad, order, used, budget)) return true;
+    order.pop_back();
+    used[cand] = false;
+  }
+  return false;
+}
+
+bool ComputeRingOrder(int P, const std::set<std::pair<int, int>>& bad,
+                      std::vector<int>* out) {
+  std::vector<int> order{0};
+  std::vector<bool> used(P, false);
+  used[0] = true;
+  int64_t budget = 1 << 20;
+  if (!RingOrderDfs(P, bad, order, used, &budget)) return false;
+  *out = order;
+  return true;
+}
+
+// Post-attempt rendezvous at the coordinator: every rank reports
+// {ok, bad_peer}; rank 0 replies {action} and, on RETRY, the permuted
+// ring order plus the merged bad-link pair list.  Runs after EVERY
+// resilient flat collective — a link can die so late that some ranks
+// complete the attempt while others abort, and those ranks must retry
+// too or the fleet deadlocks half-retried.
+constexpr int32_t kRingProceed = 0;
+constexpr int32_t kRingRetry = 1;
+constexpr int32_t kRingFail = 2;
+
+void PutI32(std::vector<uint8_t>& b, int32_t v) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+  b.insert(b.end(), p, p + 4);
+}
+
+int32_t GetI32(const std::vector<uint8_t>& b, size_t i) {
+  int32_t v;
+  memcpy(&v, b.data() + i * 4, 4);
+  return v;
+}
+
+Status AgreeRingRecovery(Network& net, bool my_ok, int my_bad_peer,
+                         int32_t* action, std::vector<int>* order) {
+  const int size = net.size();
+  const double deadline = NetResilience().op_deadline_s;
+  const uint64_t epoch = net.attempt_epoch();
+  order->clear();
+  if (net.rank() == 0) {
+    bool all_ok = my_ok;
+    bool coord_fail = false;  // a rank beyond even coordinator reach
+    std::set<std::pair<int, int>> bad;
+    auto note = [&](int a, int b) {
+      if (a >= 0 && b >= 0 && a != b)
+        bad.insert({std::min(a, b), std::max(a, b)});
+    };
+    note(0, my_bad_peer);
+    for (int b : net.bad_links()) note(0, b);
+    for (int r = 1; r < size; ++r) {
+      std::vector<uint8_t> msg;
+      Status st = net.chan(r)->AwaitRecoveryFrame(false, epoch, &msg,
+                                                  deadline);
+      if (!st.ok() || msg.size() < 8) {
+        all_ok = false;
+        coord_fail = true;
+        continue;
+      }
+      if (GetI32(msg, 0) == 0) all_ok = false;
+      note(r, GetI32(msg, 1));
+    }
+    std::vector<uint8_t> resp;
+    if (all_ok) {
+      PutI32(resp, kRingProceed);
+      *action = kRingProceed;
+    } else {
+      std::vector<int> new_order;
+      bool can = !coord_fail && NetResilience().renegotiate &&
+                 !bad.empty() && ComputeRingOrder(size, bad, &new_order);
+      if (can) {
+        PutI32(resp, kRingRetry);
+        PutI32(resp, size);
+        for (int v : new_order) PutI32(resp, v);
+        PutI32(resp, static_cast<int32_t>(bad.size()));
+        for (auto& pr : bad) {
+          PutI32(resp, pr.first);
+          PutI32(resp, pr.second);
+        }
+        *action = kRingRetry;
+        *order = new_order;
+      } else {
+        PutI32(resp, kRingFail);
+        *action = kRingFail;
+      }
+    }
+    for (int r = 1; r < size; ++r) {
+      Status st = net.chan(r)->SendRecoveryFrame(true, epoch, resp,
+                                                 deadline);
+      (void)st;  // a lost verdict surfaces as that rank's own failure
+    }
+    return Status::OK();
+  }
+  std::vector<uint8_t> report;
+  PutI32(report, my_ok ? 1 : 0);
+  PutI32(report, my_bad_peer);
+  // Re-send the report each await slice: agreement frames live outside
+  // the op stream and the replay buffer, so one lost to a reset between
+  // write and delivery would otherwise never be retransmitted (the
+  // frames are epoch-fenced and latest-wins — re-sending is free).
+  std::vector<uint8_t> resp;
+  Status st;
+  auto agree_end = std::chrono::steady_clock::now() +
+                   std::chrono::duration_cast<
+                       std::chrono::steady_clock::duration>(
+                       std::chrono::duration<double>(deadline));
+  for (;;) {
+    double remaining = std::chrono::duration<double>(
+                           agree_end - std::chrono::steady_clock::now())
+                           .count();
+    if (remaining <= 0)
+      return Status::Retry("ring recovery: agreement deadline");
+    st = net.chan(0)->SendRecoveryFrame(false, epoch, report, remaining);
+    if (!st.ok()) return st;
+    st = net.chan(0)->AwaitRecoveryFrame(true, epoch, &resp,
+                                         std::min(remaining, 2.0));
+    if (st.ok()) break;
+    if (!st.retryable()) return st;
+  }
+  if (resp.size() < 4)
+    return Status::Error("ring recovery: short response");
+  *action = GetI32(resp, 0);
+  if (getenv("HVD_TPU_NET_TRACE"))
+    fprintf(stderr, "[hvdagree r%d] worker got action=%d resp=%zu\n",
+            net.rank(), *action, resp.size());
+  if (*action == kRingRetry) {
+    int n = GetI32(resp, 1);
+    for (int i = 0; i < n; ++i) order->push_back(GetI32(resp, 2 + i));
+    int nbad = GetI32(resp, 2 + n);
+    // Record every bad pair touching this rank so MeshReset skips them
+    // symmetrically on BOTH endpoints.
+    for (int i = 0; i < nbad; ++i) {
+      int a = GetI32(resp, 3 + n + 2 * i);
+      int b = GetI32(resp, 3 + n + 2 * i + 1);
+      if (a == net.rank()) net.NoteBadLink(b);
+      if (b == net.rank()) net.NoteBadLink(a);
+    }
+  }
+  return Status::OK();
+}
+
+// Run a flat ring collective under the full escalation ladder.
+// `snapshot`/`restore` bracket the in-place mutation so a renegotiated
+// retry reruns from the original input.
+Status RunResilientRing(
+    Network& net, const std::function<void()>& snapshot,
+    const std::function<void()>& restore,
+    const std::function<Status(const std::vector<int>&)>& fn) {
+  if (!NetResilience().enabled || net.size() <= 1)
+    return fn(net.ring_order());
+  if (!NetResilience().renegotiate) {
+    // Rung 3 off: reconnect-and-resume (inside the channels) still
+    // heals transient faults transparently, but there is no
+    // renegotiation and therefore no per-collective agreement or
+    // snapshot to pay for — exhausted reconnects escalate directly.
+    net.BeginAttempt();
+    return fn(net.ring_order());
+  }
+  if (snapshot) snapshot();
+  int renegs = 0;
+  bool recovered_any = false;
+  for (;;) {
+    net.BeginAttempt();
+    Status st = fn(net.ring_order());
+    if (getenv("HVD_TPU_NET_TRACE"))
+      fprintf(stderr, "[hvdring r%d] fn st=%d %s\n", net.rank(),
+              (int)st.type, st.reason.c_str());
+    // EVERY failure joins the agreement — including non-retryable ones
+    // (e.g. a same-host neighbor's shm op timing out because the abort
+    // broadcast cannot unblock shared memory): skipping it would leave
+    // the fleet's agreement one report short and convert a repairable
+    // link death into a blanket kRingFail.  Genuinely symmetric
+    // validation errors carry no bad link, so the coordinator answers
+    // kRingFail and the error still surfaces unchanged.
+    int bad_peer = net.TakeLastBadPeer();
+    if (!st.ok()) net.BroadcastAbort();
+    int32_t action = kRingProceed;
+    std::vector<int> order;
+    Status ag = AgreeRingRecovery(net, st.ok(), st.ok() ? -1 : bad_peer,
+                                  &action, &order);
+    if (!ag.ok()) return st.ok() ? ag : st;
+    if (action == kRingProceed) {
+      if (recovered_any && st.ok()) NetCounters().resets_avoided++;
+      return st;
+    }
+    if (action == kRingFail)
+      return st.ok() ? Status::Error(
+                           "ring recovery: fleet agreed the collective "
+                           "cannot be repaired")
+                     : st;
+    if (++renegs > NetResilience().max_renegotiations)
+      return Status::Error("ring recovery: renegotiation limit reached");
+    net.set_ring_order(order);
+    Status mr = net.MeshReset(NetResilience().reconnect_s * 2 + 5.0);
+    if (!mr.ok()) return mr;
+    NetCounters().renegotiations++;
+    NetCounters().last_recovery_ms.store(SteadyNowMs());
+    recovered_any = true;
+    if (restore) restore();
+  }
+}
+
+}  // namespace
+
 Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
-                     ReduceOp op) {
-  std::vector<int> all(net.size());
-  for (int i = 0; i < net.size(); ++i) all[i] = i;
-  return RingAllreduceGroup(net, vbuf, count, dtype, op, all);
+                     ReduceOp op, const std::function<void()>* restore) {
+  const size_t nbytes = count * DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  if (restore != nullptr && *restore) {
+    // The caller can rebuild buf from still-intact inputs: no
+    // pre-collective snapshot copy on the clean path at all.
+    return RunResilientRing(
+        net, nullptr, *restore, [&](const std::vector<int>& members) {
+          return RingAllreduceGroup(net, vbuf, count, dtype, op, members);
+        });
+  }
+  // Fallback (true in-place aliasing, hierarchical degenerate paths):
+  // the ring mutates buf, so a renegotiated retry needs the original
+  // addends back — one memcpy per collective when resilience is on.
+  thread_local std::vector<uint8_t> snap;
+  return RunResilientRing(
+      net,
+      [&] {
+        if (snap.size() < nbytes) snap.resize(nbytes);
+        memcpy(snap.data(), buf, nbytes);
+      },
+      [&] { memcpy(buf, snap.data(), nbytes); },
+      [&](const std::vector<int>& members) {
+        return RingAllreduceGroup(net, vbuf, count, dtype, op, members);
+      });
 }
 
 namespace {
@@ -715,9 +945,20 @@ Status RingAllgatherv(Network& net, uint8_t* buf,
   // No schedule-marker store here: internal users (Adasum gather+tree,
   // VHDD reassembly) must not clobber the user-level allgather hook —
   // HierarchicalAllgatherv is the marker-setting entry point.
-  std::vector<int> all(net.size());
-  for (int i = 0; i < net.size(); ++i) all[i] = i;
-  return RingAllgathervGroup(net, buf, bytes, offsets, all);
+  //
+  // No retry snapshot needed: the ring never rewrites a rank's own
+  // block, and every other block is pure output.
+  return RunResilientRing(
+      net, nullptr, nullptr, [&](const std::vector<int>& members) {
+        // bytes/offsets are indexed BY RANK; the group ring indexes by
+        // member POSITION — remap for permuted (renegotiated) orders.
+        std::vector<int64_t> pb(members.size()), po(members.size());
+        for (size_t i = 0; i < members.size(); ++i) {
+          pb[i] = bytes[members[i]];
+          po[i] = offsets[members[i]];
+        }
+        return RingAllgathervGroup(net, buf, pb, po, members);
+      });
 }
 
 Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
@@ -903,19 +1144,23 @@ Status AgreeAllRanks(Network& net, int32_t* ok, int32_t* first_bad_rank) {
   if (net.rank() == 0) {
     for (int r = 1; r < net.size(); ++r) {
       int32_t peer[2];
-      Status st = net.peer(r)->RecvAll(peer, sizeof(peer));
+      Status st = net.chan(r)->Recv(reinterpret_cast<uint8_t*>(peer),
+                                    sizeof(peer), nullptr, true);
       if (!st.ok()) return st;
       if (peer[0] == 0 && (msg[1] < 0 || peer[1] < msg[1])) msg[1] = peer[1];
       msg[0] &= peer[0];
     }
     for (int r = 1; r < net.size(); ++r) {
-      Status st = net.peer(r)->SendAll(msg, sizeof(msg));
+      Status st = net.chan(r)->Send(reinterpret_cast<const uint8_t*>(msg),
+                                    sizeof(msg), true);
       if (!st.ok()) return st;
     }
   } else {
-    Status st = net.coordinator()->SendAll(msg, sizeof(msg));
+    Status st = net.coordinator_chan()->Send(
+        reinterpret_cast<const uint8_t*>(msg), sizeof(msg), true);
     if (!st.ok()) return st;
-    st = net.coordinator()->RecvAll(msg, sizeof(msg));
+    st = net.coordinator_chan()->Recv(reinterpret_cast<uint8_t*>(msg),
+                                      sizeof(msg), nullptr, true);
     if (!st.ok()) return st;
   }
   *ok = msg[0];

@@ -9,6 +9,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <vector>
 
 #include "common.h"
@@ -17,8 +18,13 @@
 namespace hvdtpu {
 
 // In-place allreduce of buf (count elements of dtype) across all ranks.
+// ``restore`` (optional): rewinds buf to its pre-collective contents for
+// a renegotiated retry — when the caller can re-pack from still-intact
+// inputs (the runtime's fusion path), the resilient wrapper skips its
+// internal pre-collective snapshot copy entirely.
 Status RingAllreduce(Network& net, void* buf, int64_t count, DataType dtype,
-                     ReduceOp op);
+                     ReduceOp op,
+                     const std::function<void()>* restore = nullptr);
 
 // Ring allreduce restricted to `members` (sorted rank list containing the
 // caller) — building block for hierarchical schedules.

@@ -18,7 +18,8 @@
 namespace hvdtpu {
 
 enum class StatusType : uint8_t { OK = 0, UNKNOWN_ERROR, PRECONDITION_ERROR,
-                                 ABORTED, INVALID_ARGUMENT, IN_PROGRESS };
+                                 ABORTED, INVALID_ARGUMENT, IN_PROGRESS,
+                                 RETRYABLE };
 
 struct Status {
   StatusType type = StatusType::OK;
@@ -36,7 +37,15 @@ struct Status {
   static Status Aborted(const std::string& msg) {
     return Status{StatusType::ABORTED, msg};
   }
+  // A transport failure the ring-level recovery may retry (reconnect
+  // exhausted on one link, or a peer's abort of the attempt): never
+  // returned to callers — collectives.cc either renegotiates the ring
+  // or converts it to a terminal error.
+  static Status Retry(const std::string& msg) {
+    return Status{StatusType::RETRYABLE, msg};
+  }
   bool ok() const { return type == StatusType::OK; }
+  bool retryable() const { return type == StatusType::RETRYABLE; }
 };
 
 // Matches the Python/dtype codes in native/controller.py. Subset of the

@@ -91,12 +91,16 @@ int64_t NumElements(const std::vector<int64_t>& shape) {
 Status Controller::Exchange(const RequestList& mine, ResponseList* out) {
   Writer w;
   SerializeRequestList(mine, w);
+  // Control-profile channel transfers: resilient to connection resets
+  // (reconnect-and-resume through the persistent listeners) but with the
+  // raw protocol's open-ended patience — a worker blocked in a long
+  // device collective between rounds is not a network fault.
   if (net_->rank() == 0) {
     std::vector<RequestList> lists(net_->size());
     lists[0] = mine;
     for (int r = 1; r < net_->size(); ++r) {
       std::vector<uint8_t> frame;
-      Status st = net_->peer(r)->RecvFrame(frame);
+      Status st = net_->chan(r)->RecvMsg(frame);
       if (!st.ok()) return st;
       Reader rd(frame.data(), frame.size());
       lists[r] = DeserializeRequestList(rd);
@@ -105,15 +109,15 @@ Status Controller::Exchange(const RequestList& mine, ResponseList* out) {
     Writer rw;
     SerializeResponseList(rl, rw);
     for (int r = 1; r < net_->size(); ++r) {
-      Status st = net_->peer(r)->SendFrame(rw.buf);
+      Status st = net_->chan(r)->SendMsg(rw.buf);
       if (!st.ok()) return st;
     }
     *out = rl;
   } else {
-    Status st = net_->coordinator()->SendFrame(w.buf);
+    Status st = net_->coordinator_chan()->SendMsg(w.buf);
     if (!st.ok()) return st;
     std::vector<uint8_t> frame;
-    st = net_->coordinator()->RecvFrame(frame);
+    st = net_->coordinator_chan()->RecvMsg(frame);
     if (!st.ok()) return st;
     Reader rd(frame.data(), frame.size());
     *out = DeserializeResponseList(rd);

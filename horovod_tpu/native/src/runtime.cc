@@ -621,6 +621,33 @@ void Runtime::ExecuteAllreduce(
   if (resp.prescale != 1.0)
     ScaleBuffer(fb, total_elems, resp.dtype, resp.prescale);
 
+  // Ring-recovery restore: a renegotiated retry re-packs the fusion
+  // buffer from the entries' inputs, which the ring never touches — so
+  // the resilient wrapper skips its clean-path snapshot copy.  The only
+  // shape it cannot rebuild is a truly in-place submission (input ==
+  // output): that one falls back to the wrapper's internal snapshot.
+  std::function<void()> repack;
+  if (!in_place || entries[0]->input != entries[0]->output) {
+    repack = [&, fb]() {
+      if (in_place) {
+        memcpy(fb, entries[0]->input, total_bytes);
+      } else {
+        int64_t off = 0;
+        for (size_t i = 0; i < resp.names.size(); ++i) {
+          int64_t nbytes = resp.sizes[i] * elem;
+          if (entries[i] && entries[i]->input) {
+            memcpy(fb + off, entries[i]->input, nbytes);
+          } else {
+            memset(fb + off, 0, nbytes);
+          }
+          off += nbytes;
+        }
+      }
+      if (resp.prescale != 1.0)
+        ScaleBuffer(fb, total_elems, resp.dtype, resp.prescale);
+    };
+  }
+
   timeline_.Record(resp.names[0], "B", "RING_ALLREDUCE");
   Status st;
   // Algorithm choice comes from the RESPONSE (coordinator-stamped), not
@@ -635,7 +662,8 @@ void Runtime::ExecuteAllreduce(
     st = HierarchicalAllreduce(*net_, fb, total_elems, resp.dtype, resp.op,
                                local_size_);
   } else {
-    st = RingAllreduce(*net_, fb, total_elems, resp.dtype, resp.op);
+    st = RingAllreduce(*net_, fb, total_elems, resp.dtype, resp.op,
+                       repack ? &repack : nullptr);
   }
   timeline_.Record(resp.names[0], "E", "RING_ALLREDUCE");
 

@@ -1,0 +1,81 @@
+"""``hvd.net`` — the self-healing wire fabric's shared resilience layer.
+
+Every cross-host channel in horovod_tpu climbs the same graded
+failure-escalation ladder before a fault is allowed to cost an elastic
+reset:
+
+1. **Per-attempt deadlines + bounded jittered-backoff retries** — this
+   module's :func:`retry_call` / :func:`request_bytes` for the Python
+   HTTP planes (rendezvous KV, replica transport, debug dump fetches).
+2. **Reconnect-and-resume** — the native TCP mesh (``native/src/net.cc``)
+   frames every transfer with sequence numbers and op-completion acks; a
+   broken connection re-establishes through the pair's persistent
+   listeners and retransmits from the last delivered frame.
+3. **Ring re-negotiation** — when reconnect exhausts, the fleet agrees
+   the dead link at the coordinator and re-forms the ring so that link
+   is never an adjacency again (``collectives.cc``).
+4. **Elastic reset** — only then does ``HorovodInternalError`` surface
+   and the PR 6 peer-recovery / elastic machinery take over.
+
+Every rung is drilled by the seeded wire-chaos plane
+(``HVD_TPU_CHAOS_NET_*`` — deterministic drop/reset/delay/truncate in
+both the native socket layer and these HTTP transports) and observable
+through ``hvd_net_{retries,reconnects,renegotiations,resets_avoided}_total``,
+``net.retry/reconnect/renegotiate`` flight events, and the hang-report
+``net`` section that tells "retrying, deadline not yet reached" from
+"wedged".  See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .chaos import (ChaosNetFault, ChaosNetReset, NetChaos, net_chaos,
+                    reset_net_chaos)
+from .native import (native_counters, reset_sync_state, status,
+                     sync_native_metrics)
+from .retry import DeadlineExceeded, Policy, poll_kv, retry_call
+
+__all__ = [
+    "ChaosNetFault", "ChaosNetReset", "DeadlineExceeded", "NetChaos",
+    "Policy", "native_counters", "net_chaos", "poll_kv", "request_bytes",
+    "reset_net_chaos", "reset_sync_state", "retry_call", "status",
+    "sync_native_metrics",
+]
+
+
+def request_bytes(req, *, timeout: float = 5.0,
+                  policy: Optional[Policy] = None,
+                  name: str = "http") -> bytes:
+    """Perform one ``urllib.request.Request`` under the ladder's rung 1:
+    chaos injection, a per-attempt timeout, and bounded jittered
+    retries.  Returns the response body.  ``HTTPError`` propagates
+    un-retried (a 403/404 is semantic, not transient); transport-level
+    ``OSError``/``URLError`` consume attempts.  A chaos-truncated
+    response is retried like a transport fault."""
+    import urllib.error
+    import urllib.request
+
+    chaos = net_chaos()
+
+    def attempt() -> bytes:
+        chaos.before_request(name)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = resp.read()
+                length = resp.headers.get("Content-Length")
+        except urllib.error.HTTPError:
+            raise  # semantic: do not let the URLError clause below eat it
+        except urllib.error.URLError as e:
+            # urllib wraps socket errors; unify on OSError for retry_on.
+            raise OSError(f"transport failure: {e.reason}") from e
+        body, truncated = chaos.mangle_response(name, body)
+        if truncated or (length is not None
+                         and len(body) != int(length)):
+            raise OSError(
+                f"truncated response ({len(body)} bytes of {length})")
+        return body
+
+    return retry_call(attempt, policy=policy, name=name,
+                      retry_on=(OSError,),
+                      raise_on=(urllib.error.HTTPError,))

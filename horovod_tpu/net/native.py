@@ -1,0 +1,105 @@
+"""Bridge to the native wire fabric's escalation-ladder counters.
+
+The C side (``native/src/net.cc``) counts retries / reconnects /
+renegotiations / resets-avoided as it climbs the ladder;
+``hvd_native_net_counters`` exports them and this module folds them into
+``hvd.metrics`` (``hvd_net_*_total{plane="native"}``), flight events and
+the hang-report ``net`` section — the "retrying, deadline not yet
+reached" vs "wedged" distinction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_FIELDS = ("retries", "reconnects", "renegotiations", "resets_avoided",
+           "chaos_injected", "recovering_now", "last_recovery_age_ms")
+
+# How recent native recovery activity must be (ms) for status() to call
+# the fabric "retrying" rather than idle/wedged.
+RECENT_RECOVERY_MS = 30000.0
+
+_sync_lock = threading.Lock()
+_last_synced: Dict[str, int] = {}
+
+
+def native_counters() -> Optional[Dict[str, int]]:
+    """The native ladder counters, or None when no native controller is
+    attached (pure-compiled jobs, unit tests)."""
+    from ..core.state import global_state
+    ctl = getattr(global_state, "controller", None)
+    if ctl is None or not hasattr(ctl, "net_counters"):
+        return None
+    try:
+        return ctl.net_counters()
+    except Exception:  # noqa: BLE001 — observability never kills training
+        return None
+
+
+def sync_native_metrics() -> Optional[Dict[str, int]]:
+    """Fold the native counters into the hvd.metrics registry (delta
+    since the last sync) and emit flight events for new reconnects /
+    renegotiations.  Returns the snapshot.  Called from ``status()``,
+    hang-report assembly, and anywhere else that wants a fresh view."""
+    counters = native_counters()
+    if counters is None:
+        return None
+    from ..debug import flight as _flight
+    from ..metrics.registry import registry as _registry
+    reg = _registry()
+    with _sync_lock:
+        for field, metric in (
+                ("retries", "hvd_net_retries_total"),
+                ("reconnects", "hvd_net_reconnects_total"),
+                ("renegotiations", "hvd_net_renegotiations_total"),
+                ("resets_avoided", "hvd_net_resets_avoided_total"),
+                ("chaos_injected", "hvd_net_chaos_injected_total")):
+            cur = int(counters.get(field, 0))
+            prev = _last_synced.get(field, 0)
+            if cur > prev:
+                reg.counter(metric,
+                            "Wire-fabric recovery counters by plane",
+                            plane="native").inc(cur - prev)
+                if field == "reconnects":
+                    _flight.record("net.reconnect", None,
+                                   total=cur, new=cur - prev)
+                elif field == "renegotiations":
+                    _flight.record("net.renegotiate", None,
+                                   total=cur, new=cur - prev)
+            _last_synced[field] = cur
+        reg.gauge("hvd_net_recovering_now",
+                  "Channels currently mid-recovery").set(
+            float(counters.get("recovering_now", 0)))
+    return counters
+
+
+def reset_sync_state() -> None:
+    """Forget the delta baseline (tests; elastic re-init keeps it — the
+    native counters are process-cumulative)."""
+    with _sync_lock:
+        _last_synced.clear()
+
+
+def status() -> Dict[str, object]:
+    """One merged view of the wire fabric for humans and hang reports:
+    the native ladder counters, the HTTP retry count, and a ``retrying``
+    verdict — True while any channel is mid-recovery or recovery
+    activity happened within the last :data:`RECENT_RECOVERY_MS`."""
+    from ..metrics.registry import registry as _registry
+    native = sync_native_metrics()
+    http_retries = _registry().counter(
+        "hvd_net_retries_total",
+        "Wire-fabric recovery attempts by plane", plane="http").value
+    retrying = False
+    if native is not None:
+        age = native.get("last_recovery_age_ms", -1)
+        retrying = (native.get("recovering_now", 0) > 0
+                    or (0 <= age < RECENT_RECOVERY_MS))
+    return {
+        "native": native,
+        "http_retries": int(http_retries),
+        "retrying": retrying,
+        "verdict": ("retrying, deadline not yet reached" if retrying
+                    else "no recent wire recovery activity"),
+    }

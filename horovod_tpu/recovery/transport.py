@@ -180,32 +180,67 @@ def serve_and_publish(rank: int, rdv_addr: Optional[str] = None,
 
 def lookup_addr(rank: int, rdv_addr: Optional[str] = None,
                 timeout: float = 3.0) -> Optional[str]:
+    """Buddy endpoint lookup via the shared KV poller (hvd.net.poll_kv —
+    the same deadline-bounded loop the elastic worker uses), so a
+    transient rendezvous fault during a commit window retries instead of
+    silently degrading the peer tier."""
     rdv_addr = rdv_addr or os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
     if not rdv_addr:
         return None
-    from ..runner.rendezvous import http_get
-    raw = http_get(rdv_addr, _SCOPE, replica_addr_key(rank),
-                   timeout=timeout)
+    from .. import net as _net
+    try:
+        raw = _net.poll_kv(rdv_addr, _SCOPE, replica_addr_key(rank),
+                           deadline_s=timeout, interval_s=0.2,
+                           timeout_s=timeout)
+    except (_net.DeadlineExceeded, PermissionError):
+        return None
     return raw.decode() if raw else None
+
+
+def _push_retry_policy():
+    """Replica pushes get exactly ONE bounded retry within the commit
+    window (the satellite contract): a transient fault must not leave a
+    rank uncovered until the next commit, but the commit latency budget
+    cannot absorb a long ladder."""
+    import dataclasses
+    from .. import net as _net
+    return dataclasses.replace(_net.Policy.from_env(), attempts=2)
 
 
 def _request(addr: str, path: str, method: str, sig_key: str,
              body: Optional[bytes] = None, timeout: float = 5.0) -> bool:
     import urllib.request
+    from .. import net as _net
+    from ..metrics.registry import registry as _reg
     req = urllib.request.Request(f"http://{addr}{path}", data=body,
                                  method=method)
     _sign(req, method, sig_key, body or b"")
+    attempts = {"n": 0}
+
+    def run() -> bytes:
+        attempts["n"] += 1
+        return _net.request_bytes(
+            req, timeout=timeout, name=f"recovery.{method.lower()}",
+            policy=_net.Policy(attempts=1))
+
     try:
-        with urllib.request.urlopen(req, timeout=timeout):
-            return True
+        _net.retry_call(run, policy=_push_retry_policy(),
+                        name=f"recovery.{sig_key}")
+        if attempts["n"] > 1:
+            _reg().counter(
+                "hvd_recovery_push_retries_total",
+                "Replica pushes that succeeded only on a retry").inc()
+        return True
     except OSError:
         return False
 
 
 def push_replica(addr: str, entry: ReplicaEntry,
                  timeout: float = 5.0) -> bool:
-    """PUT one payload to a buddy's replica endpoint (best-effort: a
-    failed push degrades the peer tier for that rank, never the job)."""
+    """PUT one payload to a buddy's replica endpoint (best-effort with
+    one bounded retry: a transiently failed push is re-sent within the
+    commit window and counted in hvd_recovery_push_retries_total; only
+    a persistent failure degrades the peer tier for that rank)."""
     return _request(addr, f"/{_SCOPE}/replica", "PUT", "replica",
                     body=entry_to_bytes(entry), timeout=timeout)
 
@@ -220,13 +255,17 @@ def push_seal(addr: str, key: str, step: int,
 def fetch_replica(addr: str, key: str, rank: int,
                   timeout: float = 5.0) -> Optional[ReplicaEntry]:
     """GET one sealed entry from a peer's endpoint; None when absent or
-    unreachable."""
+    unreachable.  Transport faults ride the hvd.net retry ladder; a 404
+    (entry genuinely absent) does not."""
+    import urllib.error
     import urllib.request
+    from .. import net as _net
     req = urllib.request.Request(
         f"http://{addr}/{_SCOPE}/replica/{key}/{int(rank)}")
     _sign(req, "GET", f"replica/{key}/{int(rank)}")
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return entry_from_bytes(resp.read())
-    except (OSError, ValueError):
+        body = _net.request_bytes(req, timeout=timeout,
+                                  name="recovery.fetch")
+        return entry_from_bytes(body)
+    except (urllib.error.HTTPError, OSError, ValueError):
         return None

@@ -132,6 +132,14 @@ class ElasticDriver:
         # belong to, so a stale callback from a superseded process can
         # never untrack or fail its replacement.
         self._gen: Dict[str, int] = {}
+        # Spawn wall-clock per slot generation + the one SSH-retry credit:
+        # a remote worker dying with ssh's transport exit code (255)
+        # within seconds of spawn is a dropped handshake, not a bad host —
+        # it gets one respawn before the blacklist path.
+        self._spawn_ts: Dict[str, tuple] = {}
+        self._ssh_retried: Set[tuple] = set()
+        self._ssh_retry_window_s = float(os.environ.get(
+            "HVD_TPU_ELASTIC_SSH_RETRY_WINDOW", "8"))
         self._shutdown = threading.Event()
         self._finished: Dict[str, int] = {}
         # Cascade-failure leniency (see _on_worker_exit): failures within
@@ -365,7 +373,7 @@ class ElasticDriver:
                     continue  # surviving worker re-rendezvouses in place
                 self._spawn(s)
 
-    def _spawn(self, s: SlotInfo):
+    def _spawn(self, s: SlotInfo, _retry: bool = True):
         sid = self._slot_id(s)
         env = dict(self._extra_env)
         env["HVD_TPU_ELASTIC_SLOT"] = sid
@@ -386,16 +394,41 @@ class ElasticDriver:
         # Any scale-down marker belongs to a superseded generation; the
         # replacement's exits are real events.
         self._expected_exits.pop(sid, None)
-        ws = exec_mod.launch_workers(
-            [s], self._command, controller_addr="elastic",
-            extra_env=env,
-            on_exit=lambda slot, code, sid=sid, gen=gen:
-                self._on_worker_exit(sid, gen, slot, code),
-            platform_policy=policy,
-            ssh_identity_file=self._ssh_identity_file,
-            output_dir=self._output_dir,
-            prefix_timestamp=self._prefix_timestamp,
-            cpu_jax_world=False)
+
+        def _launch():
+            return exec_mod.launch_workers(
+                [s], self._command, controller_addr="elastic",
+                extra_env=env,
+                on_exit=lambda slot, code, sid=sid, gen=gen:
+                    self._on_worker_exit(sid, gen, slot, code),
+                platform_policy=policy,
+                ssh_identity_file=self._ssh_identity_file,
+                output_dir=self._output_dir,
+                prefix_timestamp=self._prefix_timestamp,
+                cpu_jax_world=False)
+
+        try:
+            ws = _launch()
+        except OSError as e:
+            # A dropped SSH handshake / transient exec failure gets ONE
+            # bounded backed-off retry before it can cost a blacklist +
+            # discovery round (hvd.net rung-1 semantics for the spawn
+            # plane).  The second failure takes the normal worker-
+            # failure path: blacklist + re-rendezvous with survivors.
+            if not _retry:
+                raise
+            from .. import net as _net
+            delay_s = _net.Policy.from_env().backoff_ms(
+                1, f"spawn.{sid}") / 1e3
+            self._metric("hvd_elastic_spawn_retries_total",
+                         "Worker spawns retried after a transient "
+                         "exec/SSH failure").inc()
+            if self._verbose:
+                print(f"[elastic] spawn of {sid} failed ({e}); retrying "
+                      f"once in {delay_s * 1e3:.0f}ms")
+            time.sleep(delay_s)
+            ws = _launch()
+        self._spawn_ts[sid] = (gen, time.monotonic())
         self._workers[sid] = ws[0]
 
     def _on_worker_exit(self, sid: str, gen: int, slot: SlotInfo,
@@ -437,6 +470,49 @@ class ElasticDriver:
                 if not self._workers:
                     self._set_result(0)
                 return
+            # SSH-transport exception: exit 255 is ssh's own failure code
+            # (connection refused/reset mid-handshake), and arriving
+            # within seconds of spawn it means the COMMAND likely never
+            # ran.  One respawn credit per (slot, generation) — a single
+            # dropped handshake must not cost a blacklist + discovery
+            # round.  A second 255, or one outside the window, is treated
+            # as the host failure it probably is.
+            spawn_gen, spawn_t = self._spawn_ts.get(sid, (None, None))
+            if (code == 255 and spawn_gen == gen and spawn_t is not None
+                    and time.monotonic() - spawn_t
+                    < self._ssh_retry_window_s
+                    and (sid, gen) not in self._ssh_retried
+                    # One credit per incident: if the RESPAWN also dies
+                    # with 255, its predecessor's burned credit denies a
+                    # second one — no crash-looping past the blacklist.
+                    and (sid, gen - 1) not in self._ssh_retried):
+                self._ssh_retried.add((sid, gen))
+                self._metric("hvd_elastic_spawn_retries_total",
+                             "Worker spawns retried after a transient "
+                             "exec/SSH failure").inc()
+                if self._verbose:
+                    print(f"[elastic] worker {sid} died with ssh exit "
+                          f"255 {time.monotonic() - spawn_t:.1f}s after "
+                          "spawn; respawning once before blacklist")
+                # Backoff + SSH round-trip on a timer, NOT under the
+                # exit callback's lock hold — a correlated blip would
+                # serialize every other slot's exit handling behind a
+                # sleeping respawn.
+                from .. import net as _net
+                delay_s = _net.Policy.from_env().backoff_ms(
+                    1, f"respawn.{sid}") / 1e3
+
+                def _respawn(slot=slot):
+                    with self._lock:
+                        if (self._shutdown.is_set()
+                                or self._result is not None):
+                            return
+                        self._spawn(slot)
+
+                t = threading.Timer(delay_s, _respawn)
+                t.daemon = True
+                t.start()
+                return
             # Failure: blacklist the host (reference registration.py) and
             # re-rendezvous with the survivors.  CASCADE exception: a
             # failure arriving shortly after another failure is usually
@@ -471,6 +547,11 @@ class ElasticDriver:
             # window would let a fast crash-looper read as an endless
             # cascade and never trip blacklist/min-np).
             self._last_failure_ts = now
+            # A real failure resolves the slot's SSH-retry incident; a
+            # LATER transient 255 on a fresh generation earns a fresh
+            # credit.
+            self._ssh_retried = {t for t in self._ssh_retried
+                                 if t[0] != sid}
             self._blacklist.add(slot.hostname)
             self._metric("hvd_elastic_worker_failures_total",
                          "Worker failures that blacklisted a host").inc()

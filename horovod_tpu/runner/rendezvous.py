@@ -230,18 +230,24 @@ class RendezvousServer(BackgroundHTTPServer):
 
 
 def http_get(addr: str, scope: str, key: str, timeout: float = 5.0,
-             secret: Optional[str] = None) -> Optional[bytes]:
+             secret: Optional[str] = None,
+             policy=None) -> Optional[bytes]:
     """Tiny client (reference http/http_client.py); signs with the launch
-    secret (arg or HVD_TPU_RENDEZVOUS_SECRET env) when one is present."""
+    secret (arg or HVD_TPU_RENDEZVOUS_SECRET env) when one is present.
+    Rides the wire fabric's rung-1 ladder (hvd.net): per-attempt
+    ``timeout``, bounded jittered retries, seeded-chaos injection —
+    a transient fault is absorbed here instead of surfacing as a missing
+    key.  Returns None once the budget is spent (callers poll)."""
     import urllib.error
     import urllib.request
+    from .. import net as _net
     secret = secret or _env_secret()
     req = urllib.request.Request(f"http://{addr}/{scope}/{key}")
     if secret:
         req.add_header(_SIG_HEADER, _signature(secret, "GET", scope, key))
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.read()
+        return _net.request_bytes(req, timeout=timeout,
+                                  name=f"kv.get.{scope}", policy=policy)
     except urllib.error.HTTPError as e:
         if e.code == 403:
             # Auth failure must not look like "key not published yet" —
@@ -256,7 +262,9 @@ def http_get(addr: str, scope: str, key: str, timeout: float = 5.0,
 
 def http_put(addr: str, scope: str, key: str, value: bytes,
              timeout: float = 5.0, secret: Optional[str] = None) -> bool:
+    import urllib.error
     import urllib.request
+    from .. import net as _net
     secret = secret or _env_secret()
     req = urllib.request.Request(
         f"http://{addr}/{scope}/{key}", data=value, method="PUT")
@@ -264,8 +272,8 @@ def http_put(addr: str, scope: str, key: str, value: bytes,
         req.add_header(_SIG_HEADER,
                        _signature(secret, "PUT", scope, key, value))
     try:
-        with urllib.request.urlopen(req, timeout=timeout):
-            return True
+        _net.request_bytes(req, timeout=timeout, name=f"kv.put.{scope}")
+        return True
     except urllib.error.HTTPError as e:
         if e.code == 403:
             raise PermissionError(

@@ -30,39 +30,50 @@ def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
     """Block until a rendezvous round >= min_round includes this worker's
     slot; returns {round, size, controller_addr, rank, local_rank, ...}.
     ``min_round`` prevents a worker that just left a failed round from
-    re-joining it before the driver publishes the replacement round."""
+    re-joining it before the driver publishes the replacement round.
+
+    The polling loop is ``hvd.net.poll_kv`` — one deadline-bounded
+    sleep-and-retry implementation shared with the controller-port and
+    replica-address lookups, riding the same HTTP retry ladder."""
+    from .. import net as _net
     addr = rendezvous_addr()
     slot = my_slot_id()
     if not addr or not slot:
         raise RuntimeError("elastic worker without rendezvous env "
                            "(HVD_TPU_RENDEZVOUS_ADDR / HVD_TPU_ELASTIC_SLOT)")
     deadline = time.time() + timeout
-    last_round = -1
-    while time.time() < deadline:
-        cur = http_get(addr, "elastic", "current_round", timeout=5)
-        if cur is not None:
-            rnd = int(cur.decode())
-            if rnd != last_round and rnd >= min_round:
-                last_round = rnd
-                blob = http_get(addr, "elastic", f"round.{rnd}", timeout=5)
-                if blob is not None:
-                    assignment = json.loads(blob.decode())
-                    mine = assignment["slots"].get(slot)
-                    if mine is not None:
-                        ctl_addr = _resolve_controller_addr(
-                            addr, assignment, mine,
-                            deadline - time.time(), poll_interval)
-                        return {
-                            "round": assignment["round"],
-                            "size": assignment["size"],
-                            "controller_addr": ctl_addr,
-                            "jax_coord_addr":
-                                assignment.get("jax_coord_addr"),
-                            **mine,
-                        }
-        time.sleep(poll_interval)
-    raise TimeoutError(f"no rendezvous round included slot {slot} within "
-                       f"{timeout}s")
+    state = {"last_round": -1}
+
+    def accept(cur: bytes):
+        rnd = int(cur.decode())
+        if rnd == state["last_round"] or rnd < min_round:
+            return None
+        state["last_round"] = rnd
+        blob = http_get(addr, "elastic", f"round.{rnd}", timeout=5)
+        if blob is None:
+            return None
+        assignment = json.loads(blob.decode())
+        mine = assignment["slots"].get(slot)
+        if mine is None:
+            return None
+        return assignment, mine
+
+    try:
+        assignment, mine = _net.poll_kv(
+            addr, "elastic", "current_round", deadline_s=timeout,
+            interval_s=poll_interval, timeout_s=5, accept=accept)
+    except _net.DeadlineExceeded:
+        raise TimeoutError(f"no rendezvous round included slot {slot} "
+                           f"within {timeout}s") from None
+    ctl_addr = _resolve_controller_addr(
+        addr, assignment, mine, deadline - time.time(), poll_interval)
+    return {
+        "round": assignment["round"],
+        "size": assignment["size"],
+        "controller_addr": ctl_addr,
+        "jax_coord_addr": assignment.get("jax_coord_addr"),
+        **mine,
+    }
 
 
 def _resolve_controller_addr(rdv_addr: str, assignment: Dict[str, Any],
@@ -93,14 +104,16 @@ def _resolve_controller_addr(rdv_addr: str, assignment: Dict[str, Any],
         s.close()
         http_put(rdv_addr, "elastic", key, str(port).encode())
         return f"{host}:{port}"
-    deadline = time.time() + max(budget, 5.0)
-    while time.time() < deadline:
-        blob = http_get(rdv_addr, "elastic", key, timeout=5)
-        if blob is not None:
-            return f"{host}:{int(blob.decode())}"
-        time.sleep(poll_interval)
-    raise TimeoutError(
-        f"rank 0 never published a controller port for round {rnd}")
+    from .. import net as _net
+    try:
+        blob = _net.poll_kv(rdv_addr, "elastic", key,
+                            deadline_s=max(budget, 5.0),
+                            interval_s=poll_interval, timeout_s=5)
+    except _net.DeadlineExceeded:
+        raise TimeoutError(
+            f"rank 0 never published a controller port for round "
+            f"{rnd}") from None
+    return f"{host}:{int(blob.decode())}"
 
 
 def poll_host_event(last_ts: float) -> Optional[Dict[str, Any]]:

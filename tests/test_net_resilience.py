@@ -1,0 +1,542 @@
+"""Self-healing wire fabric (hvd.net + native/src/net.cc).
+
+Covers every rung of the graded escalation ladder:
+
+* rung 1 — retry/backoff goldens (seeded jitter), HTTP chaos injection,
+  the unified KV poller;
+* rung 2 — native ring reconnect-and-resume bit-exactness under seeded
+  connection resets + dropped frames (the acceptance drill: a 4-rank
+  job completes with ZERO failures where the pre-PR baseline dies);
+* rung 3 — ring re-negotiation around a black-holed link;
+* rung 4 — escalation to the fatal error (→ elastic reset) when chaos
+  exceeds the ladder;
+* observability — hvd_net_* metrics, net.* flight events, and the
+  hang-report ``net`` section's retrying-vs-wedged verdict.
+
+Native drills run N real processes on localhost with the TCP data plane
+forced (HVD_TPU_DISABLE_SHM) — the same harness as
+tests/test_native_runtime.py.
+"""
+
+import ctypes
+import multiprocessing as mp
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu import net as hvdnet  # noqa: E402
+from horovod_tpu.net.chaos import NetChaos, reset_net_chaos  # noqa: E402
+from horovod_tpu.net.retry import Policy  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _net_env_hygiene(monkeypatch):
+    for var in list(os.environ):
+        if var.startswith(("HVD_TPU_CHAOS_NET", "HVD_TPU_NET_")):
+            monkeypatch.delenv(var, raising=False)
+    reset_net_chaos()
+    yield
+    reset_net_chaos()
+
+
+# ---------------------------------------------------------------------------
+# Rung 1: retry policy + backoff goldens
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_golden_seeded(self):
+        # Pure function of (seed, name, attempt): pin exact values so a
+        # jitter-source change cannot slip in silently.
+        p = Policy(attempts=5, base_ms=50.0, max_ms=2000.0, seed=7)
+        got = [round(p.backoff_ms(a, "kv.get.elastic"), 3)
+               for a in (1, 2, 3)]
+        assert got == [round(p.backoff_ms(a, "kv.get.elastic"), 3)
+                       for a in (1, 2, 3)]  # deterministic
+        # Jitter stays within [0.5, 1.0] * exponential envelope.
+        for a in range(1, 6):
+            raw = min(50.0 * 2 ** (a - 1), 2000.0)
+            assert raw * 0.5 <= p.backoff_ms(a, "x") <= raw
+
+    def test_backoff_differs_by_name_and_seed(self):
+        p = Policy(seed=1)
+        assert p.backoff_ms(1, "a") != p.backoff_ms(1, "b")
+        assert Policy(seed=1).backoff_ms(1, "a") != \
+            Policy(seed=2).backoff_ms(1, "a")
+
+    def test_policy_from_env(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_RETRIES", "5")
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_BACKOFF_MS", "10")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_SEED", "42")
+        p = Policy.from_env()
+        assert (p.attempts, p.base_ms, p.seed) == (5, 10.0, 42)
+
+    def test_retry_call_retries_transient_and_counts(self):
+        from horovod_tpu.debug import flight as _flight
+        from horovod_tpu.metrics.registry import registry
+        counter = registry().counter(
+            "hvd_net_retries_total",
+            "Wire-fabric recovery attempts by plane", plane="http")
+        before = counter.value
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionResetError("boom")
+            return "ok"
+
+        out = hvdnet.retry_call(
+            flaky, policy=Policy(attempts=3, base_ms=1.0, seed=1),
+            name="test.flaky")
+        assert out == "ok" and calls["n"] == 3
+        assert counter.value == before + 2
+        kinds = [e["kind"] for e in _flight.recorder().snapshot()]
+        assert "net.retry" in kinds
+
+    def test_retry_call_exhausts_and_raises_last(self):
+        def always():
+            raise ConnectionResetError("down")
+
+        with pytest.raises(ConnectionResetError):
+            hvdnet.retry_call(
+                always, policy=Policy(attempts=2, base_ms=1.0),
+                name="test.down")
+
+    def test_retry_call_semantic_errors_not_retried(self):
+        calls = {"n": 0}
+
+        def semantic():
+            calls["n"] += 1
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            hvdnet.retry_call(semantic,
+                              policy=Policy(attempts=5, base_ms=1.0))
+        assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Rung 1: HTTP chaos determinism + env parsing
+# ---------------------------------------------------------------------------
+
+class TestHttpChaos:
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_SEED", "9")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_DROP_PCT", "1.5")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_RESET_PCT", "2")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_TRUNCATE", "3")
+        reset_net_chaos()
+        c = hvdnet.net_chaos()
+        assert (c.seed, c.drop_pct, c.reset_pct, c.truncate_pct) == \
+            (9, 1.5, 2.0, 3.0)
+        assert c.enabled
+
+    def test_draws_deterministic(self):
+        a = NetChaos(seed=3, drop_pct=10)
+        b = NetChaos(seed=3, drop_pct=10)
+        assert [a.draw("k", i) for i in range(16)] == \
+            [b.draw("k", i) for i in range(16)]
+        assert a.draw("k", 0) != NetChaos(seed=4).draw("k", 0)
+
+    def test_injection_schedule_replays(self):
+        def schedule(chaos):
+            out = []
+            for _ in range(64):
+                try:
+                    chaos.before_request("site")
+                    out.append("ok")
+                except hvdnet.ChaosNetReset:
+                    out.append("reset")
+                except hvdnet.ChaosNetFault:
+                    out.append("drop")
+            return out
+
+        s1 = schedule(NetChaos(seed=11, drop_pct=20, reset_pct=10))
+        s2 = schedule(NetChaos(seed=11, drop_pct=20, reset_pct=10))
+        assert s1 == s2
+        assert "drop" in s1 and "reset" in s1 and "ok" in s1
+
+    def test_truncate_mangles_response(self):
+        c = NetChaos(seed=1, truncate_pct=100)
+        body, truncated = c.mangle_response("x", b"0123456789")
+        assert truncated and body == b"01234"
+
+
+# ---------------------------------------------------------------------------
+# Rung 1 integration: the KV plane under chaos + the unified poller
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def kv_server():
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    server.start()
+    yield server
+    server.stop()
+
+
+class TestKvPlane:
+    def test_http_get_survives_injected_faults(self, kv_server,
+                                               monkeypatch):
+        from horovod_tpu.runner.rendezvous import http_get
+        kv_server.put("t", "k", b"value")
+        addr = f"127.0.0.1:{kv_server.port}"
+        # Heavy chaos + a generous ladder: the GET must come back.
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_SEED", "5")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_DROP_PCT", "40")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_RESET_PCT", "10")
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_RETRIES", "8")
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_BACKOFF_MS", "1")
+        reset_net_chaos()
+        got = [http_get(addr, "t", "k", timeout=3) for _ in range(10)]
+        assert all(g == b"value" for g in got)
+
+    def test_poll_kv_waits_for_publication(self, kv_server):
+        addr = f"127.0.0.1:{kv_server.port}"
+
+        def publish():
+            time.sleep(0.3)
+            kv_server.put("t", "late", b"44")
+
+        threading.Thread(target=publish, daemon=True).start()
+        out = hvdnet.poll_kv(addr, "t", "late", deadline_s=5,
+                             interval_s=0.05)
+        assert out == b"44"
+
+    def test_poll_kv_deadline(self, kv_server):
+        addr = f"127.0.0.1:{kv_server.port}"
+        t0 = time.monotonic()
+        with pytest.raises(hvdnet.DeadlineExceeded):
+            hvdnet.poll_kv(addr, "t", "never", deadline_s=0.4,
+                           interval_s=0.05)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_poll_kv_accept_filter(self, kv_server):
+        addr = f"127.0.0.1:{kv_server.port}"
+        kv_server.put("t", "round", b"3")
+        with pytest.raises(hvdnet.DeadlineExceeded):
+            hvdnet.poll_kv(addr, "t", "round", deadline_s=0.3,
+                           interval_s=0.05,
+                           accept=lambda b: int(b) >= 5 and int(b))
+        assert hvdnet.poll_kv(
+            addr, "t", "round", deadline_s=1, interval_s=0.05,
+            accept=lambda b: int(b) >= 3 and int(b)) == 3
+
+    def test_request_bytes_truncation_retries(self, kv_server,
+                                              monkeypatch):
+        addr = f"127.0.0.1:{kv_server.port}"
+        kv_server.put("t", "big", b"x" * 64)
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_SEED", "2")
+        monkeypatch.setenv("HVD_TPU_CHAOS_NET_TRUNCATE", "60")
+        reset_net_chaos()
+        req = urllib.request.Request(f"http://{addr}/t/big")
+        body = hvdnet.request_bytes(
+            req, timeout=3, name="trunc",
+            policy=Policy(attempts=10, base_ms=1.0, seed=2))
+        assert body == b"x" * 64
+
+
+# ---------------------------------------------------------------------------
+# Satellite: replica-push retry within the commit window
+# ---------------------------------------------------------------------------
+
+class TestTransportPushRetry:
+    def test_push_retried_once_and_counted(self, monkeypatch):
+        from horovod_tpu.metrics.registry import registry
+        from horovod_tpu.recovery import transport as T
+        counter = registry().counter(
+            "hvd_recovery_push_retries_total",
+            "Replica pushes that succeeded only on a retry")
+        before = counter.value
+        calls = {"n": 0}
+
+        def flaky_request(req, timeout=5.0, name="", policy=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionResetError("first push dropped")
+            return b""
+
+        monkeypatch.setattr("horovod_tpu.net.request_bytes",
+                            flaky_request)
+        assert T.push_seal("127.0.0.1:1", "k", 3) is True
+        assert calls["n"] == 2
+        assert counter.value == before + 1
+
+    def test_push_gives_up_after_one_retry(self, monkeypatch):
+        from horovod_tpu.recovery import transport as T
+
+        def dead_request(req, timeout=5.0, name="", policy=None):
+            raise ConnectionResetError("still down")
+
+        monkeypatch.setattr("horovod_tpu.net.request_bytes",
+                            dead_request)
+        assert T.push_seal("127.0.0.1:1", "k", 3) is False
+
+
+# ---------------------------------------------------------------------------
+# Satellite: elastic-driver spawn retry
+# ---------------------------------------------------------------------------
+
+class TestSpawnRetry:
+    def _driver(self):
+        from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                                       FixedHosts)
+        from horovod_tpu.runner.hosts import HostInfo
+        return ElasticDriver(FixedHosts([HostInfo("localhost", 1)]),
+                             ["true"], min_np=1, max_np=1)
+
+    def test_spawn_retries_transient_exec_failure(self, monkeypatch):
+        from horovod_tpu.runner import exec as exec_mod
+        from horovod_tpu.runner.hosts import SlotInfo
+        drv = self._driver()
+        calls = {"n": 0}
+        real = exec_mod.launch_workers
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("ssh handshake dropped")
+            return real(*a, **k)
+
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_BACKOFF_MS", "1")
+        monkeypatch.setattr(exec_mod, "launch_workers", flaky)
+        slot = SlotInfo("localhost", 0, 1, 0, 1, 0, 1)
+        drv._spawn(slot)
+        assert calls["n"] == 2
+        assert "localhost:0" in drv._workers
+        drv._shutdown.set()
+        exec_mod.terminate_all(list(drv._workers.values()))
+
+    def test_spawn_double_failure_propagates(self, monkeypatch):
+        from horovod_tpu.runner import exec as exec_mod
+        from horovod_tpu.runner.hosts import SlotInfo
+        drv = self._driver()
+
+        def dead(*a, **k):
+            raise OSError("host unreachable")
+
+        monkeypatch.setenv("HVD_TPU_NET_HTTP_BACKOFF_MS", "1")
+        monkeypatch.setattr(exec_mod, "launch_workers", dead)
+        with pytest.raises(OSError):
+            drv._spawn(SlotInfo("localhost", 0, 1, 0, 1, 0, 1))
+
+
+# ---------------------------------------------------------------------------
+# Observability: native counter bridge, flight events, hang report
+# ---------------------------------------------------------------------------
+
+class _StubController:
+    def __init__(self, counters):
+        self._counters = counters
+
+    def net_counters(self):
+        return dict(self._counters)
+
+
+class TestObservability:
+    def test_sync_and_status_retrying_verdict(self, monkeypatch):
+        from horovod_tpu.core.state import global_state
+        from horovod_tpu.debug import flight as _flight
+        from horovod_tpu.metrics.registry import registry
+        hvdnet.reset_sync_state()
+        stub = _StubController({
+            "retries": 4, "reconnects": 3, "renegotiations": 1,
+            "resets_avoided": 2, "chaos_injected": 5,
+            "recovering_now": 1, "last_recovery_age_ms": 120})
+        monkeypatch.setattr(global_state, "controller", stub,
+                            raising=False)
+        st = hvdnet.status()
+        assert st["retrying"] is True
+        assert "deadline not yet reached" in st["verdict"]
+        assert registry().counter(
+            "hvd_net_reconnects_total",
+            "Wire-fabric recovery counters by plane",
+            plane="native").value >= 3
+        kinds = [e["kind"] for e in _flight.recorder().snapshot()]
+        assert "net.reconnect" in kinds and "net.renegotiate" in kinds
+        # Second sync: no double counting.
+        v = registry().counter(
+            "hvd_net_renegotiations_total",
+            "Wire-fabric recovery counters by plane",
+            plane="native").value
+        hvdnet.sync_native_metrics()
+        assert registry().counter(
+            "hvd_net_renegotiations_total",
+            "Wire-fabric recovery counters by plane",
+            plane="native").value == v
+        hvdnet.reset_sync_state()
+
+    def test_status_idle_without_controller(self, monkeypatch):
+        from horovod_tpu.core.state import global_state
+        monkeypatch.setattr(global_state, "controller", None,
+                            raising=False)
+        st = hvdnet.status()
+        assert st["native"] is None and st["retrying"] is False
+
+    def test_hang_report_net_section(self, monkeypatch):
+        from horovod_tpu.core.state import global_state
+        from horovod_tpu.debug.hang import build_hang_report
+        hvdnet.reset_sync_state()
+        stub = _StubController({
+            "retries": 1, "reconnects": 1, "renegotiations": 0,
+            "resets_avoided": 0, "chaos_injected": 0,
+            "recovering_now": 1, "last_recovery_age_ms": 10})
+        monkeypatch.setattr(global_state, "controller", stub,
+                            raising=False)
+        report = build_hang_report(
+            [{"name": "t", "type": 0, "age_s": 61, "missing": [1],
+              "submitted": [0]}],
+            {0: {"events": []}, 1: None}, world=2, step=7)
+        assert report["net"] is not None
+        assert report["net"]["retrying"] is True
+        hvdnet.reset_sync_state()
+
+
+# ---------------------------------------------------------------------------
+# Native drills: N real processes, TCP plane forced, seeded wire chaos
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chaos_worker(rank, size, port, env, iters, out_queue):
+    sys.path.insert(0, REPO)
+    os.environ.update(env)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    from horovod_tpu.native.controller import NativeController
+    ctl = None
+    try:
+        ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+        for i in range(iters):
+            x = np.arange(4096, dtype=np.float32) + rank * 100 + i
+            out = ctl.allreduce(x, op=1, name=f"ar.{i}")
+            expected = sum(
+                np.arange(4096, dtype=np.float32) + r * 100 + i
+                for r in range(size))
+            np.testing.assert_array_equal(out, expected)
+            if i % 3 == 0:  # exercise the allgather ring too
+                g = ctl.allgather(
+                    np.full((2,), float(rank), dtype=np.float32),
+                    name=f"ag.{i}")
+                assert g.shape == (2 * size,)
+        out_queue.put((rank, "ok", ctl.net_counters()))
+    except Exception as e:  # noqa: BLE001
+        out_queue.put((rank, "error", repr(e)))
+    finally:
+        if ctl is not None:
+            ctl.shutdown()
+
+
+def _run_chaos_job(env, size=4, iters=14, timeout=150):
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    base = {"HVD_TPU_DISABLE_SHM": "1", "HVD_TPU_NET_PROBE_MS": "300"}
+    base.update(env)
+    procs = [ctx.Process(target=_chaos_worker,
+                         args=(r, size, port, base, iters, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(size):
+            rank, status, payload = q.get(timeout=timeout)
+            results[rank] = (status, payload)
+    finally:
+        deadline = time.time() + 30
+        for p in procs:
+            p.join(timeout=max(0.1, deadline - time.time()))
+        for p in procs:
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+    return results
+
+
+class TestNativeLadder:
+    def test_reconnect_and_resume_bit_exact(self):
+        """THE acceptance drill: >=1% connection resets + 0.5% dropped
+        frames on a 4-rank job — every collective completes bit-exactly
+        with ZERO failures (the pre-PR baseline test below dies on the
+        same schedule), and the ladder's counters show it worked for
+        its living."""
+        res = _run_chaos_job({
+            "HVD_TPU_CHAOS_NET_SEED": "7",
+            "HVD_TPU_CHAOS_NET_RESET_PCT": "1",
+            "HVD_TPU_CHAOS_NET_DROP_PCT": "0.5",
+        })
+        assert all(res[r][0] == "ok" for r in range(4)), res
+        total = {k: sum(res[r][1][k] for r in range(4))
+                 for k in ("retries", "reconnects", "resets_avoided",
+                           "chaos_injected")}
+        assert total["chaos_injected"] > 0, "chaos never fired; drill moot"
+        assert total["reconnects"] > 0
+        assert total["resets_avoided"] > 0
+
+    def test_baseline_without_ladder_dies(self):
+        """The same seeded chaos with the ladder OFF: at least one rank
+        fails (this is the elastic reset the fabric now avoids)."""
+        res = _run_chaos_job({
+            "HVD_TPU_NET_RESILIENCE": "0",
+            "HVD_TPU_CHAOS_NET_SEED": "7",
+            "HVD_TPU_CHAOS_NET_RESET_PCT": "1",
+            "HVD_TPU_CHAOS_NET_DROP_PCT": "0",
+        })
+        assert any(res[r][0] == "error" for r in res), res
+
+    def test_renegotiation_excludes_blackholed_link(self):
+        """A black-holed 1-2 link: reconnect exhausts, the fleet agrees
+        the dead link at the coordinator, re-forms the ring with 1 and 2
+        never adjacent, and the job completes bit-exactly with zero
+        failures."""
+        res = _run_chaos_job({
+            "HVD_TPU_CHAOS_NET_BLACKHOLE": "1-2",
+            "HVD_TPU_NET_RECONNECT_S": "2",
+        }, iters=10)
+        assert all(res[r][0] == "ok" for r in range(4)), res
+        assert all(res[r][1]["renegotiations"] >= 1 for r in range(4))
+
+    def test_escalation_when_coordinator_link_dead(self):
+        """A dead link touching rank 0 is beyond ring repair (the
+        negotiation plane itself runs through it): every rank must FAIL
+        CLEANLY within the ladder's deadlines — the HorovodInternalError
+        -> elastic-reset rung — never hang."""
+        res = _run_chaos_job({
+            "HVD_TPU_CHAOS_NET_BLACKHOLE": "0-1",
+            "HVD_TPU_NET_RECONNECT_S": "1",
+            "HVD_TPU_NET_OP_DEADLINE_S": "8",
+        }, iters=6, timeout=120)
+        assert all(res[r][0] == "error" for r in res), res
+
+    def test_native_chaos_deterministic(self):
+        """Two identical runs of the same seeded schedule inject the
+        same fault count on every rank (the C-side splitmix draws are a
+        pure function of seed/rank/peer/index)."""
+        env = {
+            "HVD_TPU_CHAOS_NET_SEED": "13",
+            "HVD_TPU_CHAOS_NET_RESET_PCT": "2",
+        }
+        a = _run_chaos_job(env, size=2, iters=8)
+        b = _run_chaos_job(env, size=2, iters=8)
+        assert all(a[r][0] == "ok" for r in a)
+        assert all(b[r][0] == "ok" for r in b)
+        assert [a[r][1]["chaos_injected"] for r in range(2)] == \
+            [b[r][1]["chaos_injected"] for r in range(2)]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
