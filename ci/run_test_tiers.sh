@@ -42,6 +42,7 @@ TIER_FAST=(
   test_ci_tiers.py
   test_collectives.py test_data_pipeline.py test_debug_flight.py
   test_flash_attention.py
+  test_fleet.py
   test_launch_flags.py
   test_metrics.py
   test_net_resilience.py

@@ -45,6 +45,7 @@ from . import checkpoint
 from . import data
 from . import debug
 from . import elastic
+from . import fleet
 from . import metrics
 from . import net
 from . import recovery
@@ -69,6 +70,6 @@ __all__ = [
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "checkpoint", "data", "debug", "elastic", "metrics",
-    "net", "recovery",
+    "mesh_lib", "checkpoint", "data", "debug", "elastic", "fleet",
+    "metrics", "net", "recovery",
 ]

@@ -78,6 +78,16 @@ NET_MAX_RENEG = "NET_MAX_RENEG"                # ring re-formations cap
 NET_RENEGOTIATE = "NET_RENEGOTIATE"            # rung 3 on/off
 NET_HTTP_RETRIES = "NET_HTTP_RETRIES"          # attempts per HTTP request
 NET_HTTP_BACKOFF_MS = "NET_HTTP_BACKOFF_MS"    # base of the jittered backoff
+# Fleet service mode (horovod_tpu/fleet/): always-on multi-tenant job
+# gateway multiplexing submitted jobs onto one device fleet.
+FLEET_PORT = "FLEET_PORT"                      # gateway HTTP port
+FLEET_ADDR = "FLEET_ADDR"                      # client default gateway addr
+FLEET_SECRET = "FLEET_SECRET"                  # submission HMAC secret
+FLEET_DIR = "FLEET_DIR"                        # durable job-queue directory
+FLEET_TICK_S = "FLEET_TICK_S"                  # scheduler cadence
+FLEET_QUOTA_SLOTS = "FLEET_QUOTA_SLOTS"        # per-tenant slots; 0 = unlimited
+FLEET_PREEMPTION = "FLEET_PREEMPTION"          # priority preemption on/off
+FLEET_PREEMPT_GRACE_S = "FLEET_PREEMPT_GRACE_S"  # commit wait before forcing
 # Seeded wire chaos (both the native socket layer and the Python HTTP
 # planes read these; inert unless set).
 CHAOS_NET_SEED = "CHAOS_NET_SEED"              # wire-chaos schedule seed
@@ -195,6 +205,17 @@ class Config:
     # and-resume + ring renegotiation; HTTP planes: per-attempt deadlines
     # with bounded jittered retries).  The native defaults live in
     # net.cc NetResilience() and MUST match these.
+    # Fleet service mode: the job gateway's port, durable-queue home,
+    # scheduler cadence, per-tenant slot quota (0 = unlimited), and the
+    # checkpoint-mediated preemption knobs (preemption on/off + how long
+    # the scheduler waits for the victim's next commit before shrinking
+    # anyway).  See docs/fleet.md.
+    fleet_port: int = 28642
+    fleet_dir: str = "./fleet_state"
+    fleet_tick_s: float = 0.5
+    fleet_quota_slots: int = 0
+    fleet_preemption: bool = True
+    fleet_preempt_grace_s: float = 30.0
     net_resilience: bool = True
     net_probe_ms: float = 10000.0
     net_reconnect_s: float = 10.0
@@ -266,6 +287,16 @@ class Config:
             0, get_int(RECOVERY_STRIDE, cfg.recovery_stride))
         cfg.async_commit = get_bool(ASYNC_COMMIT, cfg.async_commit)
         cfg.ckpt_streaming = get_bool(CKPT_STREAMING, cfg.ckpt_streaming)
+        cfg.fleet_port = get_int(FLEET_PORT, cfg.fleet_port)
+        cfg.fleet_dir = get_env(FLEET_DIR, cfg.fleet_dir) or cfg.fleet_dir
+        cfg.fleet_tick_s = max(
+            0.05, get_float(FLEET_TICK_S, cfg.fleet_tick_s))
+        cfg.fleet_quota_slots = max(
+            0, get_int(FLEET_QUOTA_SLOTS, cfg.fleet_quota_slots))
+        cfg.fleet_preemption = get_bool(FLEET_PREEMPTION,
+                                        cfg.fleet_preemption)
+        cfg.fleet_preempt_grace_s = get_float(FLEET_PREEMPT_GRACE_S,
+                                              cfg.fleet_preempt_grace_s)
         cfg.net_resilience = get_bool(NET_RESILIENCE, cfg.net_resilience)
         cfg.net_probe_ms = get_float(NET_PROBE_MS, cfg.net_probe_ms)
         cfg.net_reconnect_s = get_float(NET_RECONNECT_S,

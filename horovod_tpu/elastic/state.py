@@ -73,6 +73,7 @@ class State:
                        generation=self._sync_generation)
         _elastic_counter("hvd_elastic_commits_total",
                          "Elastic state commits").inc()
+        notification_manager.announce_commit(self._sync_generation)
         notification_manager.poll()
         self.check_host_updates()
 
@@ -893,6 +894,39 @@ class WorkerNotificationManager:
     def remove_listener(self, state: State):
         if state in self._listeners:
             self._listeners.remove(state)
+
+    def announce_commit(self, generation: int):
+        """Publish this job's commit generation to the launcher's
+        rendezvous KV (``elastic/commit``).  The launcher side —
+        ``ElasticDriver.last_commit()``, consumed by the fleet gateway's
+        scheduler — uses it as the evidence for checkpoint-mediated
+        preemption: shrink a victim only once it has committed.
+        Fleet-managed jobs only (``HVD_TPU_FLEET_JOB_ID``, stamped by
+        the gateway's runner): a plain elastic job has no consumer for
+        the key and must not pay an HTTP round-trip per commit.  Rank 0
+        only (commits advance in lockstep, one announcement covers the
+        fleet); a publish failure is absorbed — telemetry never kills
+        training."""
+        if not self._enabled:
+            return
+        import json
+        import os
+        import time
+        if not os.environ.get("HVD_TPU_FLEET_JOB_ID"):
+            return
+        if global_state.initialized and global_state.rank != 0:
+            return
+        addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+        if not addr:
+            return
+        from ..runner.rendezvous import http_put
+        try:
+            http_put(addr, "elastic", "commit", json.dumps({
+                "ts": time.time(), "generation": int(generation),
+                "slot": os.environ.get("HVD_TPU_ELASTIC_SLOT", ""),
+            }).encode(), timeout=5)
+        except Exception:  # noqa: BLE001 — an announcement, not a barrier
+            pass
 
     def poll(self):
         if not self._enabled:

@@ -1,0 +1,33 @@
+"""``hvd.fleet`` — fleet service mode: a multi-tenant job gateway on the
+elastic fabric.
+
+Instead of one job owning the device fleet from ``horovodrun`` to exit,
+an always-on :class:`FleetGateway` owns it: tenants submit job specs
+(HTTP, the :mod:`.submit` CLI, or ``horovodrun --submit``) into a
+durable queue, and the scheduler multiplexes them onto the inventory by
+driving per-job ``ElasticDriver``s — priority + per-tenant quota + fair
+share, **checkpoint-mediated preemption** (the victim commits, shrinks
+through the existing ``HostsUpdatedInterrupt`` path, and later resumes
+bit-identically from its committed step), and SLO-driven admission
+control fed by the health plane.  See docs/fleet.md.
+"""
+
+from .client import (cancel_job, default_addr, detect_gateway, get_job,
+                     list_jobs, submit_job, wait_job)
+from .gateway import SERVICE_NAME, FleetGateway
+from .job import (ACTIVE_STATES, CANCELLED, DENIED, DONE, FAILED,
+                  PREEMPTED, PREEMPTING, QUEUED, RUNNING,
+                  TERMINAL_STATES, JobRecord, JobSpec)
+from .policy import JobView, plan
+from .queue import DurableJobQueue
+from .scheduler import ElasticJobRunner, Scheduler
+
+__all__ = [
+    "ACTIVE_STATES", "CANCELLED", "DENIED", "DONE", "FAILED",
+    "PREEMPTED", "PREEMPTING", "QUEUED", "RUNNING", "TERMINAL_STATES",
+    "SERVICE_NAME",
+    "DurableJobQueue", "ElasticJobRunner", "FleetGateway", "JobRecord",
+    "JobSpec", "JobView", "Scheduler",
+    "cancel_job", "default_addr", "detect_gateway", "get_job",
+    "list_jobs", "plan", "submit_job", "wait_job",
+]

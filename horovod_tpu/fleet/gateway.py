@@ -1,0 +1,228 @@
+"""The fleet gateway — an always-on, multi-tenant job submission plane.
+
+Promoted from the rendezvous/metrics ``BackgroundHTTPServer`` scaffold
+(``runner/rendezvous.py``): one HTTP server owns the fleet, tenants
+submit jobs to it, and the :class:`.scheduler.Scheduler` multiplexes
+them onto the device inventory.  Endpoints::
+
+    GET    /fleet/healthz      liveness + identity (unsigned — this is
+                               what ``horovodrun`` probes to print the
+                               "fleet mode is active" error)
+    GET    /fleet/status       capacity + job counts
+    POST   /fleet/jobs         submit a JobSpec (JSON body)
+    GET    /fleet/jobs         list job records
+    GET    /fleet/jobs/<id>    one job record
+    DELETE /fleet/jobs/<id>    cancel (queued or running)
+
+All job endpoints are HMAC-gated with the fleet secret
+(``HVD_TPU_FLEET_SECRET``) under the rendezvous KV's signature scheme —
+method + path + body, so a captured signature authorizes nothing else.
+Admission control runs at submit time: a spec whose ``min_np`` exceeds
+the *healthy* capacity (inventory minus health-hint exclusions) is
+recorded DENIED with a pointed reason instead of queueing forever.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from ..runner.hosts import HostInfo
+from ..runner.rendezvous import BackgroundHTTPServer, _signature
+from .job import DENIED, PREEMPTED, QUEUED, JobSpec
+from .queue import DurableJobQueue
+from .scheduler import Scheduler
+
+SERVICE_NAME = "horovod_tpu_fleet"
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    server_version = "hvd_tpu_fleet"
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _key(self) -> Optional[str]:
+        """The signature key: the path under /fleet/ (None = not ours)."""
+        parts = self.path.strip("/").split("/")
+        if not parts or parts[0] != "fleet":
+            return None
+        return "/".join(parts[1:])
+
+    def _authorized(self, method: str, key: str, body: bytes = b"") -> bool:
+        secret = self.server.gateway.secret  # type: ignore[attr-defined]
+        if not secret:
+            return True
+        import hmac
+        provided = self.headers.get("X-HVD-Signature", "")
+        return hmac.compare_digest(
+            provided, _signature(secret, method, "fleet", key, body))
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):
+        gw = self.server.gateway  # type: ignore[attr-defined]
+        key = self._key()
+        if key is None:
+            return self._send(404, {"error": "not found"})
+        if key == "healthz":
+            # Unsigned on purpose: liveness probes and the launcher's
+            # gateway detection must work without the tenant secret.
+            return self._send(200, {
+                "service": SERVICE_NAME, "ok": True,
+                "jobs": len(gw.store.list()),
+            })
+        if not self._authorized("GET", key):
+            return self._send(403, {"error": "bad or missing signature"})
+        if key == "status":
+            records = gw.store.list()
+            return self._send(200, {
+                "service": SERVICE_NAME,
+                "healthy_slots": gw.scheduler.healthy_slots(),
+                "total_slots": sum(
+                    h.slots for h in gw.scheduler.fleet_hosts()),
+                "queued": sum(1 for r in records
+                              if r.state in (QUEUED, PREEMPTED)),
+                "running": gw.scheduler.running_count(),
+            })
+        if key == "jobs":
+            return self._send(200, {
+                "jobs": [r.to_dict() for r in gw.store.list()]})
+        if key.startswith("jobs/"):
+            rec = gw.store.get(key[len("jobs/"):])
+            if rec is None:
+                return self._send(404, {"error": "no such job"})
+            return self._send(200, rec.to_dict())
+        return self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        gw = self.server.gateway  # type: ignore[attr-defined]
+        key = self._key()
+        if key != "jobs":
+            return self._send(404, {"error": "not found"})
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._authorized("POST", key, body):
+            return self._send(403, {"error": "bad or missing signature"})
+        try:
+            spec = JobSpec.from_dict(json.loads(body.decode()))
+        except (ValueError, TypeError, KeyError) as e:
+            return self._send(400, {"error": f"malformed job spec: {e}"})
+        rec = gw.submit(spec)
+        if isinstance(rec, str):  # validation refusal
+            return self._send(400, {"error": rec})
+        return self._send(200, rec.to_dict())
+
+    def do_DELETE(self):
+        gw = self.server.gateway  # type: ignore[attr-defined]
+        key = self._key()
+        if key is None or not key.startswith("jobs/"):
+            return self._send(404, {"error": "not found"})
+        if not self._authorized("DELETE", key):
+            return self._send(403, {"error": "bad or missing signature"})
+        rec = gw.scheduler.cancel(key[len("jobs/"):])
+        if rec is None:
+            return self._send(404, {"error": "no such job"})
+        return self._send(200, rec.to_dict())
+
+
+class _FleetServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, gateway: "FleetGateway"):
+        super().__init__(addr, _FleetHandler)
+        self.gateway = gateway
+
+
+class FleetGateway(BackgroundHTTPServer):
+    """The composed service: durable queue + scheduler + HTTP plane.
+
+    ``hosts`` is the fleet inventory — a static list or a callable
+    (e.g. a discovery script wrapper) re-evaluated each tick.  Pass
+    ``port=0`` for an ephemeral port (tests); the production default is
+    ``HVD_TPU_FLEET_PORT``."""
+
+    def __init__(self, hosts, port: Optional[int] = None,
+                 host: str = "0.0.0.0",
+                 fleet_dir: Optional[str] = None,
+                 secret: Optional[str] = None,
+                 runner_factory=None,
+                 health_hook: Optional[Callable[[], List[str]]] = None,
+                 quota_slots: Optional[int] = None,
+                 preemption: Optional[bool] = None,
+                 preempt_grace_s: Optional[float] = None,
+                 tick_s: Optional[float] = None,
+                 extra_env=None,
+                 verbose: bool = False):
+        from ..core.config import Config, get_env, get_int
+        if port is None:
+            port = get_int("FLEET_PORT", Config.fleet_port)
+        if fleet_dir is None:
+            fleet_dir = get_env("FLEET_DIR", Config.fleet_dir) \
+                or Config.fleet_dir
+        if secret is None:
+            secret = get_env("FLEET_SECRET")
+        self.secret = secret
+        self.store = DurableJobQueue(fleet_dir)
+        hosts_provider = hosts if callable(hosts) else (lambda: list(hosts))
+        self.scheduler = Scheduler(
+            self.store, hosts_provider, runner_factory=runner_factory,
+            health_hook=health_hook, quota_slots=quota_slots,
+            preemption=preemption, preempt_grace_s=preempt_grace_s,
+            tick_s=tick_s, extra_env=extra_env, verbose=verbose)
+        super().__init__(_FleetServer((host, port), self))
+        self._submit_lock = threading.Lock()
+
+    # -- service lifecycle -------------------------------------------------
+
+    def serve(self) -> int:
+        """Start the HTTP plane and the scheduler; returns the port."""
+        port = self.start()
+        self.scheduler.start()
+        return port
+
+    def close(self, cancel_jobs: bool = False) -> None:
+        self.scheduler.stop(cancel_jobs=cancel_jobs)
+        self.stop()
+
+    # -- submission plane --------------------------------------------------
+
+    def submit(self, spec: JobSpec):
+        """Admission-checked submission.  Returns the JobRecord (state
+        QUEUED or DENIED), or an error string for a malformed spec."""
+        bad = spec.validate()
+        if bad is not None:
+            return bad
+        with self._submit_lock:
+            healthy = self.scheduler.healthy_slots()
+            # Deny only against a capacity we have actually observed: a
+            # hosts-provider glitch at startup reads as "unknown", and
+            # an unknown fleet queues the job instead of refusing it.
+            if spec.min_np > healthy and self.scheduler.inventory_seen:
+                rec = self.store.submit(
+                    spec, state=DENIED,
+                    reason=(f"admission refused: healthy capacity "
+                            f"{healthy} < min_np {spec.min_np}"))
+                from ..metrics.registry import registry
+                registry().counter(
+                    "hvd_fleet_admission_denials_total",
+                    "Jobs denied by the admission controller").inc()
+            else:
+                rec = self.store.submit(spec)
+            from ..debug import flight
+            flight.record("fleet.submit", rec.id, tenant=spec.tenant,
+                          priority=spec.priority, min_np=spec.min_np,
+                          state=rec.state)
+            return rec

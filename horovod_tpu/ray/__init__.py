@@ -199,6 +199,23 @@ class RayHostDiscovery:
         return sorted(hosts, key=lambda h: h.hostname)
 
 
+def submit_to_fleet(command: List[str], min_np: int = 1,
+                    max_np: Optional[int] = None, priority: int = 0,
+                    tenant: str = "default", gateway: Optional[str] = None,
+                    secret: Optional[str] = None, wait: bool = False):
+    """Fleet-mode front door: submit a worker command through the job
+    gateway instead of assuming this Ray driver owns the device fleet
+    (docs/fleet.md).  Returns the JobRecord (terminal when ``wait``)."""
+    from ..fleet import JobSpec, client
+    rec = client.submit_job(
+        JobSpec(command=list(command), min_np=min_np, max_np=max_np,
+                priority=priority, tenant=tenant),
+        addr=gateway, secret=secret)
+    if wait and rec.state == "queued":
+        rec = client.wait_job(rec.id, addr=gateway, secret=secret)
+    return rec
+
+
 class ElasticRayExecutor:
     """Elastic variant: the ElasticDriver polls RayHostDiscovery and
     respawns worker commands as the Ray cluster grows or shrinks
@@ -221,7 +238,17 @@ class ElasticRayExecutor:
                                           cpus_per_slot=cpus_per_slot)
         self._controller_port = controller_port
 
-    def run(self, command: List[str]) -> int:
+    def run(self, command: List[str], gateway: Optional[str] = None,
+            secret: Optional[str] = None) -> int:
+        """Drive the job on this Ray cluster — or, with ``gateway=``,
+        submit it through a fleet gateway and wait: the executor then
+        shares the device fleet with other tenants instead of owning it
+        (docs/fleet.md)."""
+        if gateway is not None:
+            rec = submit_to_fleet(list(command), min_np=self.min_np,
+                                  max_np=self.max_np, gateway=gateway,
+                                  secret=secret, wait=True)
+            return 0 if rec.state == "done" else 1
         from ..runner.elastic_driver import ElasticDriver
         driver = ElasticDriver(
             discovery=self.discovery, command=list(command),

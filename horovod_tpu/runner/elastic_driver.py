@@ -28,6 +28,12 @@ from . import exec as exec_mod
 from .hosts import HostInfo, SlotInfo, get_host_assignments, parse_hosts
 from .rendezvous import RendezvousServer
 
+# Exit status a preempted job reports from run(): distinct from worker
+# failure codes (and from ssh's 255) so a scheduler — the fleet gateway —
+# can tell "suspend me and requeue" from "I failed".  78 = EX_CONFIG's
+# neighbor in the sysexits range, unused by the toolchain here.
+PREEMPTED_EXIT = 78
+
 
 class HostDiscovery:
     def find_available_hosts_and_slots(self) -> List[HostInfo]:
@@ -89,7 +95,8 @@ class ElasticDriver:
                  ssh_identity_file: Optional[str] = None,
                  output_dir: Optional[str] = None,
                  prefix_timestamp: bool = False,
-                 health_hook=None):
+                 health_hook=None,
+                 rendezvous_port: Optional[int] = None):
         self._discovery = discovery
         # Optional straggler-health hint (hvd.metrics): a callable
         # returning hostnames to keep out of new rounds — a SOFT
@@ -115,7 +122,15 @@ class ElasticDriver:
 
         from .rendezvous import generate_secret
         self._rdv_secret = generate_secret()
-        self._rendezvous = RendezvousServer(secret=self._rdv_secret)
+        if rendezvous_port:
+            # Fixed port (hvdrun --rendezvous-port): same bind path as
+            # the static launcher, including the pointed "fleet mode is
+            # active" error when a gateway already owns the port.
+            from .launch import bind_rendezvous
+            self._rendezvous = bind_rendezvous(rendezvous_port,
+                                               secret=self._rdv_secret)
+        else:
+            self._rendezvous = RendezvousServer(secret=self._rdv_secret)
         self._lock = threading.RLock()
         self._round = -1
         self._resets = 0
@@ -156,6 +171,16 @@ class ElasticDriver:
         self._succeeded = False  # any worker exited 0: job is completing
         self._result: Optional[int] = None
         self._result_cv = threading.Condition()
+        # External resize cap (request_resize): tightens max_np without
+        # touching discovery — the scheduler's lever for handing slots
+        # between jobs.  None = uncapped.
+        self._np_cap: Optional[int] = None
+        self._preempted = False
+        # announce_resize() published a host event whose round does not
+        # exist yet: workers park at their next commit awaiting it, so
+        # the next request_resize/preempt MUST produce that round (or
+        # end the job) even when the host set turns out unchanged.
+        self._resize_announced = False
 
     @staticmethod
     def _metric(name: str, help: str, **labels):
@@ -220,6 +245,124 @@ class ElasticDriver:
                 exec_mod.terminate_all(list(self._workers.values()))
             self._rendezvous.stop()
 
+    def request_resize(self, np: int, reason: str = "") -> bool:
+        """Resize this job's world to ``np`` slots NOW — the public API
+        carve-out a scheduler (the fleet gateway) drives, instead of
+        mutating the discovery source and waiting for the poll loop.
+
+        Shrinks publish a host event (survivors take the
+        ``HostsUpdatedInterrupt`` at their next commit — the checkpoint-
+        mediated preemption path) and start a trimmed round, terminating
+        removed workers as expected scale-down exits.  Grows lift the cap
+        and round up to whatever discovery offers.  The cap persists: the
+        discovery loop respects it until the next ``request_resize``.
+
+        Returns False (and changes nothing) when ``np`` < min_np, the job
+        already ended, or discovery cannot cover min_np."""
+        with self._lock:
+            if (self._result is not None or self._shutdown.is_set()
+                    or self._succeeded):
+                return False
+            np = int(np)
+            if np < self._min_np:
+                return False
+            prev_cap = self._np_cap
+            self._np_cap = np
+            try:
+                hosts = self._discover_filtered()
+            except RuntimeError:
+                hosts = [h for h in self._current_hosts
+                         if h.hostname not in self._blacklist]
+            if sum(h.slots for h in hosts) < self._min_np:
+                # Unlaunchable round: keep the world AND the previous
+                # cap — "returns False and changes nothing" must include
+                # the cap, or a failed grow would let the discovery loop
+                # regrow a shrunk victim past its reservation.
+                self._np_cap = prev_cap
+                return False
+            announced = self._resize_announced
+            cur = {h.hostname: h.slots for h in self._current_hosts}
+            new = {h.hostname: h.slots for h in hosts}
+            if new == cur:
+                if announced:
+                    # A host event already promised the next round (the
+                    # announce raced a failure-path round that consumed
+                    # its shape change): workers are parked polling for
+                    # it, so publish a fresh round with the unchanged
+                    # host set — the cascade-round rule — or they wait
+                    # out their fetch timeout and read as failures.
+                    self._start_round(hosts)
+                return True  # already at the requested shape
+            self._metric("hvd_elastic_resize_requests_total",
+                         "External resize requests (fleet scheduler)").inc()
+            if self._verbose:
+                print(f"[elastic] resize to {np} slots requested"
+                      f"{' (' + reason + ')' if reason else ''}: "
+                      f"{cur} -> {new}")
+            added_only = (set(cur).issubset(set(new)) and
+                          all(new[h] >= cur[h] for h in cur))
+            self._publish_host_event(added_only=added_only)
+            self._start_round(hosts)
+            return True
+
+    def announce_resize(self) -> float:
+        """Phase one of a graceful (checkpoint-mediated) resize: publish
+        a host event so every worker parks at its next ``commit()`` —
+        the ``HostsUpdatedInterrupt`` path — polling for the next round
+        instead of entering another collective with about-to-die peers.
+        Returns the publish wall time; callers wait for
+        ``last_commit()`` newer than it (every rank is then at or past
+        that commit) before ``request_resize``/``preempt`` — the world
+        changes between steps, never mid-collective."""
+        with self._lock:
+            self._resize_announced = True
+            self._publish_host_event(added_only=False)
+        return time.time()
+
+    def preempt(self, reason: str = "") -> bool:
+        """Suspend the whole job: every live worker is terminated as an
+        expected exit (no blacklist, no failure round) and ``run()``
+        returns ``PREEMPTED_EXIT``.  The caller — the fleet gateway —
+        requeues the job; its entrypoint resumes from its last committed
+        checkpoint when rescheduled.  Returns False if the job already
+        ended."""
+        with self._lock:
+            if (self._result is not None or self._shutdown.is_set()
+                    or self._succeeded):
+                return False
+            self._preempted = True
+            self._metric("hvd_elastic_preemptions_total",
+                         "Jobs suspended by an external preempt()").inc()
+            if self._verbose:
+                print(f"[elastic] preempted"
+                      f"{' (' + reason + ')' if reason else ''}; "
+                      "suspending all workers")
+            for sid, w in self._workers.items():
+                if w.proc.poll() is None:
+                    self._expected_exits[sid] = self._gen.get(sid, 0)
+        # run()'s finally terminates the workers once the result lands;
+        # setting it outside the lock avoids holding it across the wait.
+        self._set_result(PREEMPTED_EXIT)
+        return True
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def last_commit(self) -> Optional[Dict]:
+        """The newest commit announcement workers published to this
+        job's rendezvous KV (``elastic/commit``): ``{"ts", "generation",
+        "slot"}``, or None before the first commit.  The fleet
+        scheduler's evidence for checkpoint-mediated preemption — shrink
+        only after the victim committed."""
+        blob = self._rendezvous.get("elastic", "commit")
+        if blob is None:
+            return None
+        try:
+            return json.loads(blob.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
     # -- internals ---------------------------------------------------------
 
     def _discover_filtered(self) -> List[HostInfo]:
@@ -261,17 +404,23 @@ class ElasticDriver:
                         _flight.record("recovery.evict", None,
                                        hosts=",".join(sorted(dropped)))
                     hosts = kept
-        if self._max_np is not None:
-            # Trim to max_np slots.
+        cap = self._effective_max()
+        if cap is not None:
+            # Trim to the effective slot cap.
             out, total = [], 0
             for h in hosts:
-                if total >= self._max_np:
+                if total >= cap:
                     break
-                take = min(h.slots, self._max_np - total)
+                take = min(h.slots, cap - total)
                 out.append(HostInfo(h.hostname, take))
                 total += take
             hosts = out
         return hosts
+
+    def _effective_max(self) -> Optional[int]:
+        """max_np tightened by any external resize cap."""
+        caps = [c for c in (self._max_np, self._np_cap) if c is not None]
+        return min(caps) if caps else None
 
     def _slot_id(self, s: SlotInfo) -> str:
         return f"{s.hostname}:{s.local_rank}"
@@ -293,6 +442,10 @@ class ElasticDriver:
 
     def _start_round(self, hosts: List[HostInfo]):
         with self._lock:
+            # Any published round fulfills an outstanding announce: its
+            # number is the _round+1 the announce's host event promised
+            # (or later), so parked workers' min_round is satisfied.
+            self._resize_announced = False
             self._round += 1
             self._metric("hvd_elastic_rounds_total",
                          "Rendezvous rounds published").inc()
@@ -654,8 +807,9 @@ class ElasticDriver:
                     continue
                 added_only = (set(cur).issubset(set(new)) and
                               all(new[h] >= cur[h] for h in cur))
-                if self._max_np is not None and added_only and \
-                        sum(cur.values()) >= self._max_np:
+                cap = self._effective_max()
+                if cap is not None and added_only and \
+                        sum(cur.values()) >= cap:
                     continue  # already at capacity
                 if self._verbose:
                     print(f"[elastic] host change: {cur} -> {new}")
@@ -686,5 +840,6 @@ def run_elastic(args) -> int:
         ssh_identity_file=getattr(args, "ssh_identity_file", None),
         output_dir=getattr(args, "output_filename", None),
         prefix_timestamp=getattr(args, "prefix_output_with_timestamp",
-                                 False))
+                                 False),
+        rendezvous_port=getattr(args, "rendezvous_port", None))
     return driver.run()

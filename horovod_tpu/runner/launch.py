@@ -72,6 +72,26 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "CPU eager workers, tpu = inherit (externally "
                         "partitioned)")
     p.add_argument("--config-file", default=None)
+    # Fleet service mode (docs/fleet.md): submit through a running job
+    # gateway instead of owning the device fleet for the process
+    # lifetime.
+    p.add_argument("--submit", action="store_true",
+                   help="submit this command to the fleet gateway "
+                        "instead of launching directly (multi-tenant "
+                        "fleet mode; see docs/fleet.md)")
+    p.add_argument("--gateway", default=None,
+                   help="fleet gateway address host:port for --submit "
+                        "(default: HVD_TPU_FLEET_ADDR, then "
+                        "127.0.0.1:<HVD_TPU_FLEET_PORT>)")
+    p.add_argument("--priority", type=int, default=0,
+                   help="job priority for --submit (higher preempts "
+                        "lower)")
+    p.add_argument("--tenant", default="default",
+                   help="tenant name for --submit (quota/fair-share "
+                        "accounting)")
+    p.add_argument("--rendezvous-port", type=int, default=None,
+                   help="bind the rendezvous KV server to this fixed "
+                        "port (default: ephemeral)")
     # Elastic.
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -224,9 +244,39 @@ def _controller_addr(hosts: List[HostInfo], port: int) -> str:
     return f"{first}:{port}"
 
 
+def bind_rendezvous(port: Optional[int],
+                    secret: Optional[str] = None) -> RendezvousServer:
+    """Construct the KV server on ``port`` (None/0 = ephemeral).  A bind
+    failure on a fixed port used to surface as an opaque
+    ``OSError: [Errno 98] Address already in use`` traceback; when the
+    listener already there is a fleet gateway — the one service that
+    legitimately parks on a well-known port — say exactly what to do
+    instead."""
+    try:
+        return RendezvousServer(port=port or 0, secret=secret)
+    except OSError as e:
+        if port:
+            from ..fleet.client import detect_gateway
+            if detect_gateway(f"127.0.0.1:{port}") is not None:
+                raise SystemExit(
+                    f"port {port} is serving a fleet gateway: fleet mode "
+                    "is active on this machine — the device fleet is "
+                    "managed by the gateway, so submit the job instead "
+                    "of launching it directly:\n"
+                    f"    horovodrun --submit --gateway 127.0.0.1:{port} "
+                    "... <command>\n"
+                    "(or python -m horovod_tpu.fleet.submit; see "
+                    "docs/fleet.md)") from None
+            raise SystemExit(
+                f"rendezvous port {port} is already bound ({e}); pick "
+                "another --rendezvous-port or free the port") from None
+        raise
+
+
 def start_rendezvous(hosts: List[HostInfo],
                      ssh_port: Optional[int] = None,
-                     iface: Optional[str] = None):
+                     iface: Optional[str] = None,
+                     port: Optional[int] = None):
     """Per-launch rendezvous bring-up shared by every launch path: HMAC
     secret, KV server, and a driver address NIC-probed so every remote
     host can route to it (reference driver_service.py:49-218 —
@@ -235,7 +285,7 @@ def start_rendezvous(hosts: List[HostInfo],
     from .probe import advertised_host
     from .rendezvous import generate_secret
     secret = generate_secret()
-    rendezvous = RendezvousServer(secret=secret)
+    rendezvous = bind_rendezvous(port, secret=secret)
     rdv_port = rendezvous.start()
     rdv_host = advertised_host(
         [h.hostname for h in hosts if not exec_mod._is_local(h.hostname)],
@@ -252,8 +302,9 @@ def run_static(args: argparse.Namespace) -> int:
     slots = get_host_assignments(hosts, np_)
     controller_addr = _controller_addr(hosts, args.controller_port)
 
-    rendezvous, rdv_env = start_rendezvous(hosts, ssh_port=args.ssh_port,
-                                           iface=args.network_interface)
+    rendezvous, rdv_env = start_rendezvous(
+        hosts, ssh_port=args.ssh_port, iface=args.network_interface,
+        port=getattr(args, "rendezvous_port", None))
     extra_env = knob_env(args)
     extra_env.update(rdv_env)
     rendezvous.put("global", "controller", controller_addr.encode())
@@ -279,6 +330,30 @@ def run_static(args: argparse.Namespace) -> int:
 def run_elastic(args: argparse.Namespace) -> int:
     from .elastic_driver import run_elastic
     return run_elastic(args)
+
+
+def run_submit(args: argparse.Namespace) -> int:
+    """``horovodrun --submit``: hand the command to the fleet gateway
+    (multi-tenant fleet mode) instead of owning the device fleet.  The
+    launch knobs ride the job spec as worker env, so a submitted job
+    tunes exactly like a directly-launched one."""
+    from ..fleet import JobSpec, client
+    min_np = args.min_np if args.min_np is not None else \
+        (args.num_proc or 1)
+    max_np = args.max_np if args.max_np is not None else args.num_proc
+    spec = JobSpec(command=list(args.command), min_np=min_np,
+                   max_np=max_np, priority=args.priority,
+                   tenant=args.tenant, env=knob_env(args))
+    addr = client.default_addr(args.gateway)
+    if client.detect_gateway(addr) is None:
+        raise SystemExit(
+            f"no fleet gateway answering at {addr} — start one "
+            "(horovod_tpu.fleet.FleetGateway.serve()) or drop --submit "
+            "to launch directly (see docs/fleet.md)")
+    rec = client.submit_job(spec, addr=addr)
+    print(f"job {rec.id}: {rec.state}"
+          + (f" ({rec.reason})" if rec.reason else ""))
+    return 0 if rec.state == "queued" else 1
 
 
 def check_build() -> int:
@@ -342,6 +417,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.check_build:
         return check_build()
+    if args.submit:
+        return run_submit(args)
     if args.host_discovery_script or args.min_np or args.max_np:
         return run_elastic(args)
     return run_static(args)

@@ -63,8 +63,10 @@ def fetch_assignment(min_round: int = 0, timeout: float = 120.0,
             addr, "elastic", "current_round", deadline_s=timeout,
             interval_s=poll_interval, timeout_s=5, accept=accept)
     except _net.DeadlineExceeded:
-        raise TimeoutError(f"no rendezvous round included slot {slot} "
-                           f"within {timeout}s") from None
+        raise TimeoutError(
+            f"no rendezvous round >= {min_round} included slot {slot} "
+            f"within {timeout}s (last round seen: "
+            f"{state['last_round']})") from None
     ctl_addr = _resolve_controller_addr(
         addr, assignment, mine, deadline - time.time(), poll_interval)
     return {

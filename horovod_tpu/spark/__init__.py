@@ -119,6 +119,9 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
                 controller_base_port: int = 29400,
                 work_dir: Optional[str] = None,
                 hosts: Optional[List[HostInfo]] = None,
+                gateway: Optional[str] = None,
+                priority: int = 0,
+                tenant: str = "default",
                 verbose: bool = False) -> List[Any]:
     """Elastic Spark run (reference spark/runner.py:306 run_elastic).
 
@@ -131,13 +134,17 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
     e.g. a Store prefix, on real clusters).
 
     ``hosts`` overrides executor discovery (test seam / static clusters).
+
+    With ``gateway=`` the job is SUBMITTED to a fleet gateway instead of
+    this process owning the device fleet: the gateway schedules it onto
+    its inventory (priority/quota/preemption apply; docs/fleet.md), and
+    ``work_dir`` must be visible to the gateway's hosts.
     """
-    from ..runner.elastic_driver import ElasticDriver, FixedHosts
     from ..runner.fnpickle import collect_results, dump_payload
 
     kwargs = kwargs or {}
     num_proc = num_proc or (sum(h.slots for h in hosts) if hosts else 1)
-    if hosts is None:
+    if hosts is None and gateway is None:
         hosts = _discover_executor_hosts(num_proc)
     min_np = min_np or num_proc
 
@@ -147,12 +154,26 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
 
     command = [sys.executable, "-m", "horovod_tpu.spark.elastic_exec",
                payload_path, results_dir]
-    driver = ElasticDriver(
-        FixedHosts(hosts), command, min_np=min_np, max_np=max_np,
-        controller_base_port=controller_base_port, verbose=verbose)
-    rc = driver.run()
-    if rc != 0:
-        raise RuntimeError(f"elastic spark job failed (exit {rc})")
+    if gateway is not None:
+        from ..fleet import JobSpec, client
+        rec = client.submit_job(
+            JobSpec(command=command, min_np=min_np, max_np=max_np,
+                    priority=priority, tenant=tenant), addr=gateway)
+        if rec.state == "queued":
+            rec = client.wait_job(rec.id, addr=gateway)
+        if rec.state != "done":
+            raise RuntimeError(
+                f"fleet job {rec.id} ended {rec.state}"
+                + (f": {rec.reason}" if rec.reason else ""))
+        rc = 0
+    else:
+        from ..runner.elastic_driver import ElasticDriver, FixedHosts
+        driver = ElasticDriver(
+            FixedHosts(hosts), command, min_np=min_np, max_np=max_np,
+            controller_base_port=controller_base_port, verbose=verbose)
+        rc = driver.run()
+        if rc != 0:
+            raise RuntimeError(f"elastic spark job failed (exit {rc})")
 
     out = collect_results(results_dir)
     if own_tmp:

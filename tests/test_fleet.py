@@ -1,0 +1,941 @@
+"""Fleet service mode: job gateway, scheduling policy, checkpoint-
+mediated preemption, admission control, and the end-to-end multiplex
+drill (two jobs on one 4-rank fleet; the higher-priority job preempts
+the running one via commit → shrink → reassign, both complete, and the
+preempted job's post-resume state is bit-identical to an uninterrupted
+run of the same seeded schedule)."""
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import horovod_tpu.fleet as fleet
+from horovod_tpu.fleet.job import JobSpec
+from horovod_tpu.fleet.policy import JobView, plan
+from horovod_tpu.runner.hosts import HostInfo
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+class FakeRunner:
+    """Scheduler-facing runner double: no processes, full control."""
+
+    def __init__(self, rec, env):
+        self.rec = rec
+        self.env = env
+        self.hosts = None
+        self.np_now = 0
+        self.resizes = []
+        self.cancelled = False
+        self.preempted = False
+        self._rc = None
+        self._commit = None
+
+    def start(self, hosts):
+        self.hosts = list(hosts)
+        self.np_now = sum(h.slots for h in hosts)
+
+    def resize(self, hosts, np, reason):
+        self.hosts = list(hosts)
+        self.np_now = np
+        self.resizes.append((np, reason))
+        return True
+
+    def announce_resize(self):
+        self.announced = getattr(self, "announced", 0) + 1
+        return time.time()
+
+    def preempt(self, reason):
+        self.preempted = True
+        self._rc = 78
+        return True
+
+    def cancel(self, reason):
+        self.cancelled = True
+        self._rc = 78
+        return True
+
+    def commit_now(self):
+        gen = (self._commit or {}).get("generation", 0) + 1
+        self._commit = {"ts": time.time(), "generation": gen}
+
+    def last_commit(self):
+        return self._commit
+
+    def finish(self, rc):
+        self._rc = rc
+
+    def result(self):
+        return self._rc
+
+    def join(self, timeout=None):
+        pass
+
+
+def _gateway(tmp_path, hosts, **kw):
+    """Ephemeral-port gateway whose scheduler is driven by tick() (the
+    background loop is only started where a test needs it)."""
+    runners = {}
+
+    def factory(rec, env):
+        r = FakeRunner(rec, env)
+        runners[rec.id] = r
+        return r
+
+    kw.setdefault("runner_factory", factory)
+    kw.setdefault("preempt_grace_s", 5.0)
+    gw = fleet.FleetGateway(hosts, port=0, fleet_dir=str(tmp_path / "fl"),
+                            tick_s=0.05, **kw)
+    gw.start()
+    return gw, f"127.0.0.1:{gw.port}", runners
+
+
+def _fleet_events():
+    from horovod_tpu.debug import flight
+    return [e for e in flight.snapshot()
+            if str(e.get("kind", "")).startswith("fleet.")]
+
+
+# ---------------------------------------------------------------------------
+# Job spec + durable queue
+# ---------------------------------------------------------------------------
+
+
+def test_job_spec_validation_and_roundtrip():
+    assert JobSpec(command=[]).validate()
+    assert JobSpec(command=["x"], min_np=0).validate()
+    assert JobSpec(command=["x"], min_np=4, max_np=2).validate()
+    assert JobSpec(command=["x"], tenant="").validate()
+    spec = JobSpec(command=["python", "t.py"], min_np=2, max_np=8,
+                   priority=3, tenant="research", env={"A": "1"},
+                   checkpoint_dir="/ckpt", max_queue_s=60.0)
+    assert spec.validate() is None
+    assert JobSpec.from_dict(spec.to_dict()) == spec
+    # Unknown keys from a newer client are ignored, not fatal.
+    d = spec.to_dict()
+    d["future_field"] = True
+    assert JobSpec.from_dict(d) == spec
+    # Numeric fields coerce at the boundary (JSON clients send "5"):
+    # a queued string priority would wedge the policy's sort key on
+    # every tick otherwise.
+    s = JobSpec(command=["x"], min_np="2", max_np="4", priority="5",
+                max_queue_s="1.5")
+    assert (s.min_np, s.max_np, s.priority, s.max_queue_s) \
+        == (2, 4, 5, 1.5)
+    with pytest.raises((ValueError, TypeError)):
+        JobSpec(command=["x"], priority="high")
+    assert JobSpec(command=["x"], env={"A": 1}).validate()
+
+
+def test_durable_queue_survives_restart(tmp_path):
+    q = fleet.DurableJobQueue(str(tmp_path))
+    a = q.submit(JobSpec(command=["a"]))
+    b = q.submit(JobSpec(command=["b"], priority=5))
+    assert (a.submit_seq, b.submit_seq) == (1, 2)
+    q.update(b.id, lambda r: setattr(r, "state", fleet.RUNNING))
+    # A fresh gateway over the same directory reloads the queue; jobs
+    # that were RUNNING when the old gateway died are requeued (their
+    # drivers died with it).
+    q2 = fleet.DurableJobQueue(str(tmp_path))
+    recs = {r.id: r for r in q2.list()}
+    assert recs[a.id].state == fleet.QUEUED
+    assert recs[b.id].state == fleet.QUEUED
+    assert recs[b.id].resumes == 1
+    assert "gateway restart" in recs[b.id].reason
+    c = q2.submit(JobSpec(command=["c"]))
+    assert c.submit_seq == 3  # sequence survives the restart
+
+
+# ---------------------------------------------------------------------------
+# Scheduling policy goldens (pure)
+# ---------------------------------------------------------------------------
+
+
+def _qv(id, seq, prio=0, min_np=1, max_np=None, tenant="t",
+        max_queue_s=0.0):
+    return JobView(id=id, tenant=tenant, priority=prio, min_np=min_np,
+                   max_np=max_np, submit_seq=seq, state="queued",
+                   max_queue_s=max_queue_s)
+
+
+def _rv(id, seq, np, prio=0, min_np=1, max_np=None, tenant="t",
+        state="running"):
+    return JobView(id=id, tenant=tenant, priority=prio, min_np=min_np,
+                   max_np=max_np, submit_seq=seq, state=state, np=np)
+
+
+def test_policy_priority_then_fifo_golden():
+    views = [_qv("lo", 1, prio=0, min_np=2, max_np=2),
+             _qv("hi", 2, prio=5, min_np=2, max_np=2),
+             _qv("mid", 3, prio=1, min_np=2, max_np=2)]
+    assert plan(views, 4) == [("start", "hi", 2), ("start", "mid", 2)]
+
+
+def test_policy_fair_share_and_slo_tiebreak():
+    # Tenant "busy" already holds 3 slots; equal-priority queued jobs go
+    # to the emptier tenant first, and within one tenant the tighter
+    # queue-wait SLO goes first.
+    views = [_rv("r", 1, np=3, tenant="busy"),
+             _qv("b2", 2, tenant="busy", min_np=1, max_np=1),
+             _qv("i2", 3, tenant="idle", min_np=1, max_np=1,
+                 max_queue_s=60.0),
+             _qv("i1", 4, tenant="idle", min_np=1, max_np=1,
+                 max_queue_s=5.0)]
+    assert plan(views, 6) == [("start", "i1", 1), ("start", "i2", 1),
+                              ("start", "b2", 1)]
+
+
+def test_policy_quota_golden():
+    views = [_rv("r", 1, np=3, tenant="a"),
+             _qv("q1", 2, tenant="a", min_np=2),
+             _qv("q2", 3, tenant="b", min_np=2, max_np=4)]
+    # Quota 4: tenant a has 3 running, q1 needs 2 -> waits (counted);
+    # tenant b starts but is clipped to its quota, not to free capacity.
+    decisions = plan(views, 10, quota_slots=4)
+    assert ("quota_wait", "q1", "a") in decisions
+    assert ("start", "q2", 4) in decisions
+
+
+def test_policy_admission_denial_on_unhealthy_capacity():
+    views = [_qv("big", 1, min_np=4)]
+    decisions = plan(views, 2)  # health hints shrank the fleet below min
+    assert len(decisions) == 1
+    kind, job_id, reason = decisions[0]
+    assert (kind, job_id) == ("deny", "big")
+    assert "healthy capacity 2 < min_np 4" in reason
+
+
+def test_policy_preemption_shrink_newest_victims_first():
+    views = [_rv("old", 1, np=2, prio=0, min_np=1),
+             _rv("new", 2, np=2, prio=0, min_np=1),
+             _qv("hi", 3, prio=9, min_np=2, max_np=2)]
+    # Capacity 4, no free slots: reclaim 2 by shrinking, newest victim
+    # first, each only down to its min_np.
+    assert plan(views, 4) == [("shrink", "new", 1, "hi"),
+                              ("shrink", "old", 1, "hi")]
+
+
+def test_policy_preemption_stops_when_shrink_cannot_cover():
+    views = [_rv("a", 1, np=2, prio=0, min_np=2),
+             _qv("hi", 2, prio=9, min_np=2, max_np=2)]
+    # The victim is already at min_np: shrinking frees nothing, so it is
+    # suspended outright.
+    assert plan(views, 2) == [("stop", "a", "hi")]
+
+
+def test_policy_preemption_never_touches_equal_or_higher_priority():
+    views = [_rv("a", 1, np=2, prio=5, min_np=1),
+             _qv("same", 2, prio=5, min_np=2),
+             _qv("lower", 3, prio=1, min_np=2)]
+    assert plan(views, 2) == []
+    assert plan(views, 2, preemption=False) == []
+
+
+def test_policy_grow_prefers_higher_priority():
+    views = [_rv("lo", 1, np=1, prio=0, min_np=1, max_np=4),
+             _rv("hi", 2, np=1, prio=5, min_np=1, max_np=4)]
+    # 2 free slots: the higher-priority job absorbs them first.
+    assert plan(views, 4) == [("grow", "hi", 3)]
+    # With more headroom both grow, higher priority first.
+    assert plan(views, 8) == [("grow", "hi", 4), ("grow", "lo", 4)]
+
+
+def test_policy_preempting_jobs_hold_their_slots():
+    # A victim already pending preemption is not re-planned, and its
+    # slots are not double-promised.
+    views = [_rv("v", 1, np=4, prio=0, min_np=1, state="preempting"),
+             _qv("hi", 2, prio=9, min_np=2)]
+    assert plan(views, 4) == []
+
+
+# ---------------------------------------------------------------------------
+# Scheduler over fake runners
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_multiplex_shrink_preemption(tmp_path):
+    gw, addr, runners = _gateway(tmp_path, [HostInfo("localhost", 4)])
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=1, max_np=4,
+                              tenant="t1"))
+        gw.scheduler.tick()
+        assert gw.store.get(a.id).state == fleet.RUNNING
+        assert runners[a.id].np_now == 4
+
+        b = gw.submit(JobSpec(command=["B"], min_np=2, max_np=2,
+                              priority=9, tenant="t2"))
+        d1 = gw.scheduler.tick()
+        assert ("shrink", a.id, 2, b.id) in d1
+        # The victim commits AFTER the decision -> the next tick
+        # executes the shrink; the one after starts the preemptor on
+        # the freed slots.
+        runners[a.id].commit_now()
+        gw.scheduler.tick()
+        assert runners[a.id].np_now == 2
+        assert gw.store.get(a.id).preemptions == 1
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.RUNNING
+        assert runners[b.id].np_now == 2
+
+        runners[b.id].finish(0)
+        gw.scheduler.tick()
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.DONE
+        # The victim regrew to its full width once the preemptor left.
+        assert runners[a.id].np_now == 4
+
+        from horovod_tpu.metrics.registry import registry
+        snap = registry().snapshot()
+        assert snap["hvd_fleet_preemptions_total"]["series"][0][
+            "value"] >= 1
+        kinds = {e["kind"] for e in _fleet_events()}
+        assert {"fleet.submit", "fleet.schedule",
+                "fleet.preempt", "fleet.resume"} <= kinds
+    finally:
+        gw.close()
+
+
+def test_scheduler_commit_gates_preemption(tmp_path):
+    gw, addr, runners = _gateway(tmp_path, [HostInfo("localhost", 2)],
+                                 preempt_grace_s=30.0)
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=1, max_np=2))
+        gw.scheduler.tick()
+        b = gw.submit(JobSpec(command=["B"], min_np=1, max_np=1,
+                              priority=9))
+        gw.scheduler.tick()
+        # No commit yet: the shrink stays parked, the victim keeps its
+        # world, and the preemptor stays queued.
+        for _ in range(3):
+            gw.scheduler.tick()
+        assert runners[a.id].np_now == 2
+        assert gw.store.get(a.id).state == fleet.PREEMPTING
+        assert gw.store.get(b.id).state == fleet.QUEUED
+        # The victim commits -> the shrink lands on the next tick.
+        runners[a.id].commit_now()
+        gw.scheduler.tick()
+        assert runners[a.id].np_now == 1
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.RUNNING
+    finally:
+        gw.close()
+
+
+def test_scheduler_preempt_grace_expiry_forces(tmp_path):
+    gw, addr, runners = _gateway(tmp_path, [HostInfo("localhost", 2)],
+                                 preempt_grace_s=0.15)
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=1, max_np=2))
+        gw.scheduler.tick()
+        gw.submit(JobSpec(command=["B"], min_np=1, priority=9))
+        gw.scheduler.tick()
+        assert gw.store.get(a.id).state == fleet.PREEMPTING
+        time.sleep(0.2)  # a victim that never commits cannot stall the
+        gw.scheduler.tick()  # fleet past the grace window
+        assert runners[a.id].np_now == 1
+    finally:
+        gw.close()
+
+
+def test_scheduler_stop_preemption_requeues_and_resumes(tmp_path):
+    gw, addr, runners = _gateway(tmp_path, [HostInfo("localhost", 2)])
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=2, max_np=2))
+        gw.scheduler.tick()
+        b = gw.submit(JobSpec(command=["B"], min_np=2, max_np=2,
+                              priority=9))
+        d = gw.scheduler.tick()
+        assert ("stop", a.id, b.id) in d
+        runners[a.id].commit_now()  # commit after the decision
+        gw.scheduler.tick()  # executes the suspend
+        assert runners[a.id].preempted
+        gw.scheduler.tick()  # reaps -> PREEMPTED (requeued), B starts
+        rec = gw.store.get(a.id)
+        assert rec.state in (fleet.PREEMPTED, fleet.RUNNING)
+        assert rec.preemptions == 1
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.RUNNING
+        runners[b.id].finish(0)
+        gw.scheduler.tick()
+        gw.scheduler.tick()
+        # The victim resumed (fresh runner, counted as a resume).
+        rec = gw.store.get(a.id)
+        assert rec.state == fleet.RUNNING and rec.resumes == 1
+        assert any(e["kind"] == "fleet.resume" and e.get("name") == a.id
+                   for e in _fleet_events())
+    finally:
+        gw.close()
+
+
+def test_scheduler_inventory_glitch_never_denies(tmp_path):
+    """A transient hosts-provider failure must read as "capacity
+    unknown", not "capacity 0": no mass denial of the queue, and the
+    last good inventory keeps scheduling."""
+    calls = {"n": 0, "fail": False}
+
+    def provider():
+        calls["n"] += 1
+        if calls["fail"]:
+            raise RuntimeError("discovery glitch")
+        return [HostInfo("localhost", 4)]
+
+    gw, addr, runners = _gateway(tmp_path, provider)
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=3, max_np=3))
+        assert a.state == fleet.QUEUED
+        calls["fail"] = True  # glitch before the first scheduling pass
+        gw.scheduler.tick()
+        # Last good view (from the submit-time admission read) holds:
+        # the job STARTED against the cached 4-slot inventory.
+        assert gw.store.get(a.id).state == fleet.RUNNING
+        b = gw.submit(JobSpec(command=["B"], min_np=2, max_np=2))
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.QUEUED  # never denied
+        calls["fail"] = False
+        gw.scheduler.tick()
+        assert gw.store.get(b.id).state == fleet.QUEUED  # 1 slot free
+    finally:
+        gw.close()
+    # A gateway whose provider NEVER succeeded queues instead of
+    # denying — capacity is unknown, not absent.
+    def always_fail():
+        raise RuntimeError("no inventory yet")
+    gw2 = fleet.FleetGateway(always_fail, port=0,
+                             fleet_dir=str(tmp_path / "fl2"),
+                             runner_factory=lambda r, e: FakeRunner(r, e),
+                             tick_s=0.05)
+    gw2.start()
+    try:
+        rec = gw2.submit(JobSpec(command=["x"], min_np=8))
+        assert rec.state == fleet.QUEUED
+        gw2.scheduler.tick()
+        assert gw2.store.get(rec.id).state == fleet.QUEUED
+    finally:
+        gw2.close()
+
+
+def test_durable_queue_sidelines_unreadable_file(tmp_path):
+    """A present-but-corrupt queue file is quarantined, not silently
+    overwritten by the next flush."""
+    q = fleet.DurableJobQueue(str(tmp_path))
+    q.submit(JobSpec(command=["a"]))
+    path = os.path.join(str(tmp_path), "jobs.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    q2 = fleet.DurableJobQueue(str(tmp_path))
+    assert q2.list() == []
+    quarantined = [p for p in os.listdir(str(tmp_path))
+                   if p.startswith("jobs.json.unreadable-")]
+    assert quarantined, "corrupt queue file was not sidelined"
+
+
+def test_scheduler_denies_queued_job_when_health_degrades(tmp_path):
+    excluded = []
+    gw, addr, runners = _gateway(
+        tmp_path, [HostInfo("h1", 2), HostInfo("h2", 2)],
+        health_hook=lambda: excluded)
+    try:
+        a = gw.submit(JobSpec(command=["A"], min_np=3, max_np=3))
+        assert a.state == fleet.QUEUED
+        excluded.append("h2")  # straggler plane condemns h2 pre-start
+        gw.scheduler.tick()
+        rec = gw.store.get(a.id)
+        assert rec.state == fleet.DENIED
+        assert "healthy capacity 2 < min_np 3" in rec.reason
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# Gateway HTTP plane
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_http_submit_status_cancel(tmp_path):
+    gw, addr, runners = _gateway(tmp_path, [HostInfo("localhost", 4)],
+                                 secret="tok")
+    try:
+        assert fleet.detect_gateway(addr)["service"] == \
+            "horovod_tpu_fleet"
+        rec = fleet.submit_job(
+            JobSpec(command=["python", "t.py"], min_np=1, max_np=2),
+            addr=addr, secret="tok")
+        assert rec.state == fleet.QUEUED
+        assert fleet.get_job(rec.id, addr=addr, secret="tok").id == rec.id
+        assert [r.id for r in fleet.list_jobs(addr=addr, secret="tok")] \
+            == [rec.id]
+        out = fleet.cancel_job(rec.id, addr=addr, secret="tok")
+        assert out.state == fleet.CANCELLED
+        with pytest.raises(RuntimeError, match="404"):
+            fleet.get_job("nope", addr=addr, secret="tok")
+        # An uncoercible spec gets a 400, not a queued wedge or a
+        # dropped connection.
+        import urllib.error
+        import urllib.request
+        from horovod_tpu.runner.rendezvous import _signature
+        body = json.dumps({"command": ["x"],
+                           "priority": "high"}).encode()
+        req = urllib.request.Request(f"http://{addr}/fleet/jobs",
+                                     data=body, method="POST")
+        req.add_header("X-HVD-Signature",
+                       _signature("tok", "POST", "fleet", "jobs", body))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+    finally:
+        gw.close()
+
+
+def test_gateway_unsigned_submission_403(tmp_path):
+    gw, addr, _ = _gateway(tmp_path, [HostInfo("localhost", 4)],
+                           secret="tok")
+    try:
+        with pytest.raises(PermissionError, match="signature"):
+            fleet.submit_job(JobSpec(command=["x"]), addr=addr,
+                             secret=None)
+        with pytest.raises(PermissionError, match="signature"):
+            fleet.submit_job(JobSpec(command=["x"]), addr=addr,
+                             secret="wrong")
+        # healthz stays unsigned (liveness + launcher detection).
+        assert fleet.detect_gateway(addr) is not None
+        # A signature for one resource cannot authorize another: sign a
+        # GET of jobs, replay it against a DELETE of a job.
+        import urllib.error
+        import urllib.request
+        from horovod_tpu.runner.rendezvous import _signature
+        req = urllib.request.Request(
+            f"http://{addr}/fleet/jobs/abc", method="DELETE")
+        req.add_header("X-HVD-Signature",
+                       _signature("tok", "GET", "fleet", "jobs"))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 403
+    finally:
+        gw.close()
+
+
+def test_gateway_admission_refusal_on_health_hint(tmp_path):
+    # Health hints blacklist one of two hosts; a job whose min_np needs
+    # both is refused AT SUBMIT with a pointed reason.
+    gw, addr, _ = _gateway(tmp_path,
+                           [HostInfo("h1", 2), HostInfo("h2", 2)],
+                           health_hook=lambda: ["h2"])
+    try:
+        rec = fleet.submit_job(JobSpec(command=["x"], min_np=3),
+                               addr=addr)
+        assert rec.state == fleet.DENIED
+        assert "healthy capacity 2 < min_np 3" in rec.reason
+        # Within the healthy envelope it queues normally.
+        ok = fleet.submit_job(JobSpec(command=["x"], min_np=2),
+                              addr=addr)
+        assert ok.state == fleet.QUEUED
+    finally:
+        gw.close()
+
+
+def test_submit_cli_and_horovodrun_submit(tmp_path, capsys):
+    gw, addr, _ = _gateway(tmp_path, [HostInfo("localhost", 4)])
+    try:
+        from horovod_tpu.fleet import submit as submit_cli
+        rc = submit_cli.main(["--gateway", addr, "-np", "2",
+                              "--priority", "3", "--tenant", "ml",
+                              "--", "python", "train.py"])
+        assert rc == 0
+        assert "queued" in capsys.readouterr().out
+        jobs = fleet.list_jobs(addr=addr)
+        assert jobs[0].spec.max_np == 2 and jobs[0].spec.priority == 3
+
+        from horovod_tpu.runner import launch
+        rc = launch.main(["--submit", "--gateway", addr, "-np", "1",
+                          "--fusion-threshold-mb", "4",
+                          "--", "python", "train.py"])
+        assert rc == 0
+        jobs = fleet.list_jobs(addr=addr)
+        assert len(jobs) == 2
+        # Launch knobs ride the spec env, so a submitted job tunes like
+        # a launched one.
+        assert jobs[1].spec.env["HVD_TPU_FUSION_THRESHOLD"] == \
+            str(4 * 1024 * 1024)
+    finally:
+        gw.close()
+
+
+def test_rendezvous_port_conflict_points_at_fleet_mode(tmp_path):
+    from horovod_tpu.runner import launch
+    gw, addr, _ = _gateway(tmp_path, [HostInfo("localhost", 2)])
+    try:
+        with pytest.raises(SystemExit,
+                           match="fleet mode is active") as e:
+            launch.bind_rendezvous(gw.port)
+        assert "--submit" in str(e.value)
+    finally:
+        gw.close()
+    # A non-gateway listener on the port keeps the plain (but still
+    # pointed, non-traceback) message.
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    s.listen(1)
+    try:
+        with pytest.raises(SystemExit, match="already bound"):
+            launch.bind_rendezvous(s.getsockname()[1])
+    finally:
+        s.close()
+
+
+def test_fleet_knob_defaults_single_sourced():
+    from horovod_tpu.core.config import Config
+    cfg = Config.from_env()
+    assert cfg.fleet_port == Config.fleet_port
+    assert cfg.fleet_tick_s == Config.fleet_tick_s
+    assert cfg.fleet_quota_slots == 0
+    assert cfg.fleet_preemption is True
+    assert cfg.fleet_preempt_grace_s == Config.fleet_preempt_grace_s
+
+
+def test_fleet_knob_env_overrides(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLEET_PORT", "12345")
+    monkeypatch.setenv("HVD_TPU_FLEET_QUOTA_SLOTS", "8")
+    monkeypatch.setenv("HVD_TPU_FLEET_PREEMPTION", "0")
+    monkeypatch.setenv("HVD_TPU_FLEET_TICK_S", "0.01")  # clamped
+    from horovod_tpu.core.config import Config
+    cfg = Config.from_env()
+    assert cfg.fleet_port == 12345
+    assert cfg.fleet_quota_slots == 8
+    assert cfg.fleet_preemption is False
+    assert cfg.fleet_tick_s == 0.05
+
+
+# ---------------------------------------------------------------------------
+# ElasticDriver public hooks (satellite: unit-tested independently of
+# the gateway) — real driver, real worker processes.
+# ---------------------------------------------------------------------------
+
+
+HOOK_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    LOG = {log!r}
+
+    hvd.init()
+    state = elastic.ObjectState(epoch=0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < {epochs}:
+            x = np.full((2,), float(hvd.rank() + 1), dtype=np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"ep.{{state.epoch}}")
+            with open(LOG + f".{{os.environ['HVD_TPU_ELASTIC_SLOT']}}",
+                      "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size()}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+            time.sleep(0.3)
+    train(state)
+    hvd.shutdown()
+""")
+
+
+def _read_logs(prefix, slots):
+    events = []
+    for slot in slots:
+        path = f"{prefix}.{slot}"
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                ev = json.loads(line)
+                ev["slot"] = slot
+                events.append(ev)
+    return events
+
+
+def _wait_for(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.timeout(240)
+def test_elastic_driver_resize_and_preempt_hooks(tmp_path, monkeypatch):
+    """request_resize shrinks the live world through the host-event
+    path (no blacklist, commit announcements flowing), and preempt()
+    suspends the job with the distinct PREEMPTED_EXIT — both driven
+    directly, no gateway involved."""
+    from horovod_tpu.runner.elastic_driver import (PREEMPTED_EXIT,
+                                                   ElasticDriver,
+                                                   FixedHosts)
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "0.2")
+    log = str(tmp_path / "log")
+    script = tmp_path / "worker.py"
+    script.write_text(HOOK_WORKER.format(repo=REPO, log=log, epochs=200))
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("localhost", 2)]),
+        [sys.executable, str(script)], min_np=1, max_np=2, verbose=True,
+        # Commit announcements are fleet-gated (plain elastic jobs must
+        # not pay the per-commit PUT); this unit test stands in for the
+        # gateway's runner, which stamps the id.
+        extra_env={"HVD_TPU_FLEET_JOB_ID": "hook-test"})
+    rc = {}
+    t = threading.Thread(target=lambda: rc.setdefault("v", driver.run()),
+                         daemon=True)
+    t.start()
+    slots = ["localhost:0", "localhost:1"]
+    try:
+        _wait_for(lambda: any(e["size"] == 2
+                              for e in _read_logs(log, slots)),
+                  90, "first 2-rank epoch")
+        # Commit announcements reach the driver's KV.
+        _wait_for(lambda: driver.last_commit() is not None, 30,
+                  "a commit announcement")
+        lc = driver.last_commit()
+        assert lc["generation"] >= 1 and lc["ts"] > 0
+
+        # Below min_np or after-the-fact sizes are refused.
+        assert driver.request_resize(0, "bogus") is False
+        assert driver.request_resize(1, "fleet test") is True
+        _wait_for(lambda: any(e["size"] == 1
+                              for e in _read_logs(log, slots)),
+                  90, "a 1-rank epoch after the shrink")
+        assert driver._blacklist == set()
+
+        # Regression: an announce whose shape change is consumed by
+        # another round (here: a same-shape resize) must STILL publish
+        # the promised round — parked workers would otherwise wait out
+        # their fetch timeout and read as failures.
+        driver.announce_resize()
+        n_before = len(_read_logs(log, slots))
+        assert driver.request_resize(1, "same shape") is True
+        _wait_for(lambda: len(_read_logs(log, slots)) > n_before,
+                  60, "epochs resuming after a same-shape resize "
+                      "fulfilled the announce")
+
+        before = [e for e in _read_logs(log, slots) if e["size"] == 1]
+        assert driver.preempt("fleet test") is True
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert rc["v"] == PREEMPTED_EXIT
+        assert driver.preempted
+        assert driver._blacklist == set()
+        # The shrink resumed from committed state: 1-rank epochs pick up
+        # where the 2-rank commits left off (monotonic, no restart at 0).
+        assert before, "no size-1 epochs logged"
+        max2 = max(e["epoch"] for e in _read_logs(log, slots)
+                   if e["size"] == 2)
+        assert min(e["epoch"] for e in before) >= max2 - 1
+    finally:
+        driver._shutdown.set()
+        t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end multiplex drill (acceptance) + chaos arm — real gateway,
+# real ElasticDriver-backed jobs on one local fleet.
+# ---------------------------------------------------------------------------
+
+
+FLEET_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+    from horovod_tpu.recovery.chaos import chaos
+
+    LOG = {log!r}
+    FINAL = {final!r}
+    SEED = {seed}
+    EPOCHS = {epochs}
+    PACE = {pace}
+    MARK = {mark!r}
+
+    hvd.init()
+    state = elastic.ObjectState(epoch=0,
+                                params=np.zeros(4, dtype=np.float64))
+
+    @elastic.run
+    def train(state):
+        while state.epoch < EPOCHS:
+            slot = os.environ.get("HVD_TPU_ELASTIC_SLOT", "?")
+            marker = MARK + "." + slot.replace(":", "_") if MARK else ""
+            if (marker and chaos().should_kill(hvd.rank(), state.epoch)
+                    and not os.path.exists(marker)):
+                open(marker, "w").close()  # one kill per slot
+                os._exit(1)
+            upd = np.random.default_rng(
+                (SEED, state.epoch)).standard_normal(4)
+            x = np.full((2,), float(hvd.rank() + 1), dtype=np.float32)
+            out = hvd.allreduce(x, op=hvd.Sum,
+                                name=f"ep.{{state.epoch}}")
+            state.params = state.params + upd
+            with open(LOG + "." + slot, "a") as f:
+                f.write(json.dumps({{
+                    "epoch": state.epoch, "rank": hvd.rank(),
+                    "size": hvd.size(), "wall": time.time(),
+                    "sum": float(np.asarray(out)[0])}}) + "\\n")
+            state.epoch += 1
+            state.commit()
+            time.sleep(PACE)
+    train(state)
+    if hvd.rank() == 0:
+        with open(FINAL, "w") as f:
+            json.dump({{"params": state.params.tolist(),
+                        "epoch": state.epoch}}, f)
+    hvd.shutdown()
+""")
+
+
+def _expected_params(seed, epochs):
+    params = np.zeros(4, dtype=np.float64)
+    for e in range(epochs):
+        params = params + np.random.default_rng(
+            (seed, e)).standard_normal(4)
+    return params
+
+
+def _write_worker(tmp_path, tag, seed, epochs, pace, mark=""):
+    log = str(tmp_path / f"log_{tag}")
+    final = str(tmp_path / f"final_{tag}.json")
+    script = tmp_path / f"worker_{tag}.py"
+    script.write_text(FLEET_WORKER.format(
+        repo=REPO, log=log, final=final, seed=seed, epochs=epochs,
+        pace=pace, mark=mark))
+    return script, log, final
+
+
+@pytest.mark.timeout(420)
+def test_fleet_multiplex_preemption_drill(tmp_path, monkeypatch):
+    """Acceptance: two jobs on one 4-rank fleet.  A (low priority) takes
+    all 4 slots; B (high priority) preempts via commit → shrink →
+    reassign; both complete; A's post-resume state is bit-identical to
+    the uninterrupted seeded schedule, and the restored step matches the
+    preemption commit."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "0.2")
+    a_script, a_log, a_final = _write_worker(
+        tmp_path, "a", seed=7, epochs=12, pace=0.5)
+    b_script, b_log, b_final = _write_worker(
+        tmp_path, "b", seed=5, epochs=6, pace=0.4)
+    gw = fleet.FleetGateway(
+        [HostInfo("localhost", 4)], port=0,
+        fleet_dir=str(tmp_path / "fleet"), tick_s=0.3,
+        preempt_grace_s=30.0, verbose=True)
+    gw.serve()
+    addr = f"127.0.0.1:{gw.port}"
+    a_slots = [f"localhost:{i}" for i in range(4)]
+    try:
+        a = fleet.submit_job(
+            JobSpec(command=[sys.executable, str(a_script)], min_np=1,
+                    max_np=4, priority=0, tenant="t1"), addr=addr)
+        # Let A run wide and commit before the preemptor shows up.
+        _wait_for(lambda: sum(1 for e in _read_logs(a_log, a_slots)
+                              if e["size"] == 4) >= 4,
+                  120, "job A committing at the full 4-rank width")
+        b = fleet.submit_job(
+            JobSpec(command=[sys.executable, str(b_script)], min_np=2,
+                    max_np=2, priority=9, tenant="t2"), addr=addr)
+        b_rec = fleet.wait_job(b.id, addr=addr, timeout=180)
+        assert b_rec.state == fleet.DONE, b_rec.reason
+        a_rec = fleet.wait_job(a.id, addr=addr, timeout=180)
+        assert a_rec.state == fleet.DONE, a_rec.reason
+        assert a_rec.preemptions >= 1
+        assert a_rec.preempt_generation is not None
+
+        events = _read_logs(a_log, a_slots)
+        sizes = {e["size"] for e in events}
+        assert 4 in sizes, "A never ran at full width"
+        assert 2 in sizes, "A was never shrunk for the preemptor"
+        # B actually ran while A was shrunk (multiplexing, not serial).
+        b_events = _read_logs(b_log, a_slots)
+        assert b_events and all(e["size"] == 2 for e in b_events)
+        a2 = [e for e in events if e["size"] == 2]
+        overlap_start = min(e["wall"] for e in a2)
+        overlap_end = max(e["wall"] for e in events)
+        assert any(overlap_start <= e["wall"] <= overlap_end
+                   for e in b_events), "B never overlapped shrunk A"
+
+        # Restored step equals the commit the scheduler acted on: the
+        # record carries the generation (== epochs committed), and the
+        # first post-shrink epoch resumes there — nothing replayed from
+        # before the commit, nothing skipped.
+        gen = int(a_rec.preempt_generation)
+        first_shrunk_epoch = min(e["epoch"] for e in a2)
+        assert first_shrunk_epoch >= gen, \
+            f"A replayed epoch {first_shrunk_epoch} < commit {gen}"
+        # Bit-identical to the uninterrupted seeded schedule: exact
+        # float64 equality, preemption cost zero arithmetic drift.
+        with open(a_final) as f:
+            final = json.load(f)
+        assert final["epoch"] == 12
+        assert final["params"] == _expected_params(7, 12).tolist()
+        with open(b_final) as f:
+            assert json.load(f)["params"] == \
+                _expected_params(5, 6).tolist()
+    finally:
+        gw.close(cancel_jobs=True)
+
+
+@pytest.mark.timeout(420)
+def test_gateway_survives_worker_kill_mid_preemption(tmp_path,
+                                                     monkeypatch):
+    """Chaos arm (HVD_TPU_CHAOS_*): a victim worker dies exactly when
+    the preemptor arrives; the elastic layer absorbs the kill, the
+    gateway keeps scheduling, and both jobs still complete."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "0.2")
+    a_script, a_log, a_final = _write_worker(
+        tmp_path, "a", seed=3, epochs=8, pace=0.3,
+        mark=str(tmp_path / "mark"))
+    b_script, b_log, b_final = _write_worker(
+        tmp_path, "b", seed=4, epochs=2, pace=0.1)
+    hosts = [HostInfo("localhost", 1), HostInfo("127.0.0.1", 1)]
+    gw = fleet.FleetGateway(
+        hosts, port=0, fleet_dir=str(tmp_path / "fleet"), tick_s=0.3,
+        preempt_grace_s=30.0, verbose=True)
+    gw.serve()
+    addr = f"127.0.0.1:{gw.port}"
+    slots = ["localhost:0", "127.0.0.1:0"]
+    try:
+        a = fleet.submit_job(
+            JobSpec(command=[sys.executable, str(a_script)], min_np=1,
+                    max_np=2, priority=0,
+                    env={"HVD_TPU_CHAOS_KILL_STEPS": "1@3"}),
+            addr=addr)
+        _wait_for(lambda: any(e["epoch"] >= 2
+                              for e in _read_logs(a_log, slots)),
+                  120, "job A reaching the kill window")
+        b = fleet.submit_job(
+            JobSpec(command=[sys.executable, str(b_script)], min_np=1,
+                    max_np=1, priority=9), addr=addr)
+        b_rec = fleet.wait_job(b.id, addr=addr, timeout=180)
+        assert b_rec.state == fleet.DONE, b_rec.reason
+        a_rec = fleet.wait_job(a.id, addr=addr, timeout=180)
+        assert a_rec.state == fleet.DONE, a_rec.reason
+        # The chaos kill really fired (the marker is the proof)…
+        assert any(os.path.exists(str(tmp_path / "mark") + "."
+                                  + s.replace(":", "_")) for s in slots)
+        # …and the gateway survived it mid-preemption, still answering.
+        assert fleet.detect_gateway(addr) is not None
+        with open(a_final) as f:
+            assert json.load(f)["params"] == \
+                _expected_params(3, 8).tolist()
+    finally:
+        gw.close(cancel_jobs=True)
