@@ -46,7 +46,9 @@ TIER_FAST=(
   test_launch_flags.py
   test_metrics.py
   test_net_resilience.py
-  test_optimizers.py test_parallel.py test_probe_rendezvous.py
+  test_optimizers.py
+  test_overlap.py
+  test_parallel.py test_probe_rendezvous.py
   test_quantization.py
   test_recovery.py
   test_resnet.py test_response_cache.py test_timeline.py

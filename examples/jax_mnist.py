@@ -35,6 +35,10 @@ def main():
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--lr", type=float, default=0.05)
     parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--overlap", action="store_true",
+                        help="backward-overlap bucketed gradient schedule "
+                             "(docs/overlap.md); identical losses, the "
+                             "wire rides under the remaining backward")
     args = parser.parse_args()
 
     hvd.init()
@@ -43,7 +47,10 @@ def main():
 
     params = mlp.init_params(jax.random.PRNGKey(0))
     # Scale LR by parallelism; wrap the optimizer for gradient averaging.
-    tx = hvd.DistributedOptimizer(optax.sgd(args.lr * hvd.size()))
+    # --overlap opts into the bucketed scheduler explicitly; otherwise
+    # the HVD_TPU_OVERLAP session default decides.
+    tx = hvd.DistributedOptimizer(optax.sgd(args.lr * hvd.size()),
+                                  overlap=True if args.overlap else None)
     opt_state = tx.init(params)
     # Start every member from rank-0 weights.
     x, y = synthetic_mnist(jax.random.PRNGKey(1 + hvd.rank()))

@@ -154,9 +154,10 @@ class ParameterManager:
 
     # log2(bytes): 1 MB .. 256 MB; cycle: 0.5 .. 25 ms; three relaxed
     # booleans {hierarchical_allreduce, hierarchical_allgather, cache};
-    # one relaxed trinary (wire compression, rounded into thirds).
+    # one relaxed trinary (wire compression, rounded into thirds); one
+    # relaxed quaternary (overlap bucket bytes, rounded into quarters).
     BOUNDS = [(20.0, 28.0), (0.5, 25.0),
-              (0.0, 1.0), (0.0, 1.0), (0.0, 1.0), (0.0, 1.0)]
+              (0.0, 1.0), (0.0, 1.0), (0.0, 1.0), (0.0, 1.0), (0.0, 1.0)]
 
     # Wire-format categorical (quantized collective engine): tuned like
     # the boolean toggles, as a relaxed [0,1] dimension of the same GP
@@ -164,6 +165,20 @@ class ParameterManager:
     # without error feedback (an optimizer-state concern the runtime
     # cannot provide) it trades too much gradient fidelity to auto-pick.
     COMPRESSION_CHOICES = ("none", "bf16", "int8")
+
+    # Overlap bucket-size categorical (backward-overlap scheduler,
+    # ops/overlap.py): 0 = bucketing off (the per-leaf barrier
+    # schedule), else the bucket size in bytes — log2-spaced because
+    # the overlap/launch-overhead trade is multiplicative.  Tuned
+    # jointly with fusion/cycle/compression: the schedule is
+    # value-invariant (bit parity); an explicit
+    # HVD_TPU_OVERLAP_BUCKET_BYTES pins the dimension.  Callers may
+    # restrict the grid via ``overlap_choices`` — the native controller
+    # excludes 0 on multi-rank jobs, because a live on<->off flip is
+    # rank-0-local and changes the eager collective NAME sequence
+    # (barrier auto-names vs the queue's leaf names), which would
+    # desync negotiation; bucket-SIZE flips are name-invariant.
+    OVERLAP_CHOICES = (0, 2 << 20, 8 << 20, 32 << 20)
 
     def __init__(self, apply_fn, max_samples: int = 20,
                  window_seconds: float = 2.0,
@@ -174,11 +189,15 @@ class ParameterManager:
                  (False, False, True),
                  tune_toggles: bool = True,
                  initial_compression: str = "none",
-                 tune_compression: bool = False):
+                 tune_compression: bool = False,
+                 initial_overlap: int = 0,
+                 tune_overlap: bool = False,
+                 overlap_choices=None):
         """apply_fn(fusion_bytes: int, cycle_ms: float, hierarchical_
         allreduce: bool, hierarchical_allgather: bool, cache_enabled:
-        bool, compression: str) applies parameters to the runtime
-        (native SetParams + SetTunedToggles + SetWireCompression).
+        bool, compression: str, overlap_bucket_bytes: int) applies
+        parameters to the runtime (native SetParams + SetTunedToggles +
+        SetWireCompression + the overlap engine's session bucket size).
 
         ``warmup_samples`` windows are discarded (not fed to the GP) to
         skip compile/cache-cold noise; ``steps_per_sample > 0`` closes a
@@ -192,7 +211,14 @@ class ParameterManager:
         capacity 0) would burn sample budget re-measuring an identical
         configuration.  ``initial_compression``/``tune_compression`` do
         the same for the wire-format categorical (COMPRESSION_CHOICES);
-        an explicitly-configured format stays pinned."""
+        an explicitly-configured format stays pinned.
+        ``initial_overlap``/``tune_overlap`` handle the overlap
+        bucket-size categorical (``overlap_choices``, default
+        OVERLAP_CHOICES, 0 = off): the bootstrap demonstrably tries
+        each choice (overlap OFF against each bucket size, when 0 is in
+        the grid) before EI takes over, and an explicitly-configured
+        size (HVD_TPU_OVERLAP_BUCKET_BYTES, or any off-grid value) pins
+        the dimension."""
         self._apply = apply_fn
         init_toggles = tuple(bool(t) for t in initial_toggles)
         if isinstance(tune_toggles, (tuple, list)):
@@ -205,12 +231,23 @@ class ParameterManager:
             tune_compression = False
         self._initial_compression = initial_compression
         self._tune_compression = bool(tune_compression)
+        self._overlap_choices = (tuple(int(c) for c in overlap_choices)
+                                 if overlap_choices else
+                                 self.OVERLAP_CHOICES)
+        initial_overlap = int(initial_overlap)
+        if initial_overlap not in self._overlap_choices:
+            # An explicit off-grid bucket size: respect by pinning.
+            tune_overlap = False
+        self._initial_overlap = initial_overlap
+        self._tune_overlap = bool(tune_overlap)
         # Pin the GP's candidate dims for non-tunable toggles (toggle
         # bounds are [0,1], so normalized == raw value).
         pinned = {2 + i: (1.0 if init_toggles[i] else 0.0)
                   for i in range(3) if not tunable[i]}
         if not self._tune_compression:
             pinned[5] = self._compression_x(initial_compression)
+        if not self._tune_overlap:
+            pinned[6] = self._overlap_x(initial_overlap)
         self._opt = BayesianOptimizer(
             self.BOUNDS, seed=seed, noise=gp_noise, pinned=pinned)
         self._max_samples = max_samples
@@ -227,18 +264,27 @@ class ParameterManager:
         # Deterministic categorical bootstrap (the reference's grids try
         # every categorical value; here: the configured combo, then each
         # TUNABLE toggle flipped once, then each non-initial wire format
-        # once).  Numeric dims stay GP-proposed.
-        if any(self._tunable) or self._tune_compression:
-            t0 = self._initial_toggles + (self._initial_compression,)
+        # once, then each non-initial overlap bucket size once — so
+        # "overlap off vs each bucket size" is a controlled comparison).
+        # Numeric dims stay GP-proposed.
+        if any(self._tunable) or self._tune_compression or \
+                self._tune_overlap:
+            t0 = self._initial_toggles + (self._initial_compression,
+                                          self._initial_overlap)
             self._toggle_plan = [t0] + [
                 tuple(not t0[j] if j == i else t0[j] for j in range(3))
-                + (self._initial_compression,)
+                + (self._initial_compression, self._initial_overlap)
                 for i in range(3) if self._tunable[i]]
             if self._tune_compression:
                 self._toggle_plan += [
-                    self._initial_toggles + (c,)
+                    self._initial_toggles + (c, self._initial_overlap)
                     for c in self.COMPRESSION_CHOICES
                     if c != self._initial_compression]
+            if self._tune_overlap:
+                self._toggle_plan += [
+                    self._initial_toggles + (self._initial_compression, o)
+                    for o in self._overlap_choices
+                    if o != self._initial_overlap]
         else:
             self._toggle_plan = []
         # The plan holds the numeric dims FIXED across the toggle flips:
@@ -279,7 +325,7 @@ class ParameterManager:
     @property
     def current(self):
         """(fusion_bytes, cycle_ms, hier_allreduce, hier_allgather,
-        cache_enabled, compression)"""
+        cache_enabled, compression, overlap_bucket_bytes)"""
         return self._current
 
     def _round_toggles(self, x) -> Tuple[bool, bool, bool]:
@@ -301,6 +347,20 @@ class ParameterManager:
         idx = min(int(float(x[5]) * n), n - 1)
         return self.COMPRESSION_CHOICES[idx]
 
+    def _overlap_x(self, overlap: int) -> float:
+        """Normalized GP coordinate of an overlap bucket size: the
+        center of its grid cell (stable rounding, like compression)."""
+        choices = self._overlap_choices
+        idx = choices.index(overlap) if overlap in choices else 0
+        return (idx + 0.5) / len(choices)
+
+    def _round_overlap(self, x) -> int:
+        if not self._tune_overlap:
+            return self._initial_overlap
+        n = len(self._overlap_choices)
+        idx = min(int(float(x[6]) * n), n - 1)
+        return self._overlap_choices[idx]
+
     def _propose(self):
         if self._toggle_plan:
             if self._plan_numeric is None:
@@ -311,7 +371,8 @@ class ParameterManager:
             x = self._opt.suggest()
             self._current = ((int(2 ** x[0]), float(x[1]))
                              + self._round_toggles(x)
-                             + (self._round_compression(x),))
+                             + (self._round_compression(x),)
+                             + (self._round_overlap(x),))
         self._apply(*self._current)
         self._record_applied()
 
@@ -344,10 +405,11 @@ class ParameterManager:
         return np.array(
             [math.log2(self._current[0]), self._current[1]]
             + [1.0 if t else 0.0 for t in self._current[2:5]]
-            # De-normalize the compression coordinate back into its raw
-            # [0,1] bound (observe() re-normalizes; toggle bounds are
-            # [0,1] so this is the identity for them too).
-            + [self._compression_x(self._current[5])])
+            # De-normalize the categorical coordinates back into their
+            # raw [0,1] bounds (observe() re-normalizes; toggle bounds
+            # are [0,1] so this is the identity for them too).
+            + [self._compression_x(self._current[5]),
+               self._overlap_x(self._current[6])])
 
     def _observe(self, score: float):
         if self._warmup_left > 0:
@@ -366,7 +428,8 @@ class ParameterManager:
             best_x, best_y = self._opt.best()
             self._current = ((int(2 ** best_x[0]), float(best_x[1]))
                              + tuple(self._round_toggles(best_x))
-                             + (self._round_compression(best_x),))
+                             + (self._round_compression(best_x),)
+                             + (self._round_overlap(best_x),))
             self._apply(*self._current)
             self._record_applied()
             self._frozen = True
@@ -383,6 +446,6 @@ class ParameterManager:
                 f.write(f"{tag},{self._current[0]},{self._current[1]:.3f},"
                         f"{int(self._current[2])},{int(self._current[3])},"
                         f"{int(self._current[4])},{self._current[5]},"
-                        f"{score:.1f}\n")
+                        f"{int(self._current[6])},{score:.1f}\n")
         except OSError:
             pass

@@ -42,6 +42,9 @@ DATA_STALL_TIMEOUT_SECONDS = "DATA_STALL_TIMEOUT_SECONDS"  # 0 = warn only
 # Quantized collective engine (horovod_tpu/ops/quantization.py).
 COMPRESSION = "COMPRESSION"                    # none|fp16|bf16|int8|int4
 QUANT_BLOCK = "QUANT_BLOCK"                    # elements per absmax scale
+# Backward-overlap bucketed gradient scheduler (horovod_tpu/ops/overlap.py).
+OVERLAP = "OVERLAP"                            # session default on/off
+OVERLAP_BUCKET_BYTES = "OVERLAP_BUCKET_BYTES"  # bucket size; pins autotune
 # Metrics subsystem (horovod_tpu/metrics/).
 METRICS_SYNC_STEPS = "METRICS_SYNC_STEPS"      # cross-rank cadence; 0 = off
 METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
@@ -176,6 +179,13 @@ class Config:
     # formats scale per ``quant_block`` elements (ops/quantization.py).
     compression: str = "none"
     quant_block: int = 256
+    # Backward-overlap bucketed gradient scheduler: the session default
+    # for optimizers called without an explicit ``overlap=`` argument
+    # (bit-parity with the barrier schedule, so an env default is safe),
+    # and the bucket size used when overlap is on.  Setting the bytes
+    # knob explicitly PINS the autotuner's bucket-size dimension.
+    overlap: bool = False
+    overlap_bucket_bytes: int = 8 * 1024 * 1024
     # Metrics: registry always records locally; cross-rank aggregation
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
@@ -271,6 +281,11 @@ class Config:
         cfg.compression = comp
         cfg.quant_block = max(2, get_int(QUANT_BLOCK, cfg.quant_block))
         cfg.quant_block -= cfg.quant_block % 2
+        cfg.overlap = get_bool(OVERLAP, cfg.overlap)
+        # Floor of 1 KB: a zero/garbage bucket size would put every leaf
+        # alone in a bucket — legal but never what anyone meant.
+        cfg.overlap_bucket_bytes = max(
+            1024, get_int(OVERLAP_BUCKET_BYTES, cfg.overlap_bucket_bytes))
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)

@@ -280,10 +280,31 @@ class NativeController:
                 tune_compression=(
                     _config.get_env(_config.COMPRESSION) is None and
                     os.environ.get("HVD_TPU_EAGER_DEVICE_PLANE",
-                                   "1") != "0"))
+                                   "1") != "0"),
+                initial_overlap=(cfg.overlap_bucket_bytes if cfg.overlap
+                                 else 0),
+                # The bucket-size dimension only takes effect for jobs
+                # that opted into overlap (HVD_TPU_OVERLAP or an
+                # optimizer overlap= argument reading the session
+                # value); an explicit HVD_TPU_OVERLAP_BUCKET_BYTES pins
+                # it — the operator chose, the tuner must not explore.
+                tune_overlap=(
+                    cfg.overlap and
+                    _config.get_env(_config.OVERLAP_BUCKET_BYTES)
+                    is None),
+                # Multi-rank jobs explore bucket SIZES only: the tuned
+                # session value is rank-0-local (not coordinated like
+                # the response-stream wire stamp), and an on<->off flip
+                # changes the eager collective NAME sequence (barrier
+                # auto-names vs the queue's leaf-indexed names) —
+                # rank 0 flipping alone would desync negotiation.
+                # Size flips are name-invariant, hence safe; a
+                # single-rank job may try off too.
+                overlap_choices=(None if size == 1 else tuple(
+                    c for c in ParameterManager.OVERLAP_CHOICES if c)))
 
     def _apply_tuned(self, fusion, cycle, hier_allreduce, hier_allgather,
-                     cache_enabled, compression="none"):
+                     cache_enabled, compression="none", overlap=None):
         from ..ops.compression import WIRE_CODES
         self._lib.hvd_native_set_params(int(fusion), float(cycle))
         self._lib.hvd_native_set_tuned_toggles(
@@ -293,6 +314,16 @@ class NativeController:
         # workers adopt the flip at the round boundary, never mid-batch.
         self._lib.hvd_native_set_wire_compression(
             WIRE_CODES.get(compression, 0))
+        if overlap is not None:
+            # Overlap bucket size (0 = bucketing off): applied to the
+            # overlap engine's session value — reaches EAGER dispatch at
+            # the next step (value-invariant, so mid-run flips are
+            # safe).  Compiled traces deliberately ignore it (a rank-
+            # local tuned value must not shape a cross-rank SPMD
+            # program; they read the env knobs), so this dimension's
+            # measured effect — like fusion/cycle — is native-plane.
+            from ..ops import overlap as _overlap_mod
+            _overlap_mod.set_session_bucket_bytes(int(overlap))
 
     def wire_compression(self) -> str:
         """The response-stream-adopted eager wire format ("none" until

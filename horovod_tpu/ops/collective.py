@@ -673,7 +673,48 @@ def _native_async(submit, finish) -> int:
 
 def allreduce_async(tensor, op: int = Average, name: Optional[str] = None,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> int:
+                    postscale_factor: float = 1.0,
+                    compression=None) -> int:
+    """``compression`` carries the eager quantized/cast wire semantics
+    onto the async path (the overlap scheduler's bucket dispatch rides
+    this): quantized formats round the contribution and the result to
+    the wire grid exactly like the synchronous ``allreduce``; cast
+    formats shrink the in-flight payload and restore the dtype at
+    ``synchronize``.  Explicit incompatible requests raise like the
+    sync path; the session default degrades silently."""
+    explicit = compression is not None
+    comp = _resolve_compression(compression) if explicit else None
+    if comp is not None and not _check_compressible(tensor, op, explicit):
+        comp = None
+    if comp is not None and (global_state.controller is None
+                             or _is_tracer(tensor)):
+        # Synchronous fallback: delegate to the sync compressed path
+        # wholesale — same code, so the fp32 accumulation of wire
+        # values survives (re-wrapping a plain async here would sum in
+        # the tensor dtype and diverge from allreduce(compression=…)
+        # for bf16/fp16 tensors).
+        result = allreduce(tensor, op=op, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=comp)
+        return _handles.handle_manager.allocate(
+            _handles.Handle(result=result))
+    if comp is not None and comp.bits is not None:
+        x = _eager_wire_emulate(comp, tensor)
+        inner = allreduce_async(x, op=op, name=name,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+        return _handles.handle_manager.allocate(_handles.Handle(
+            poll_fn=lambda: poll(inner),
+            wait_fn=lambda: _eager_wire_emulate(comp, synchronize(inner))))
+    if comp is not None:
+        cx, ctx = comp.compress(tensor)
+        inner = allreduce_async(cx, op=op, name=name,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+        return _handles.handle_manager.allocate(_handles.Handle(
+            poll_fn=lambda: poll(inner),
+            wait_fn=lambda: comp.decompress(synchronize(inner), ctx)))
     if global_state.controller is not None and not _is_tracer(tensor):
         return _native_async(
             lambda ctl: ctl.allreduce_submit(

@@ -762,6 +762,24 @@ def allreduce(tensor, op_fn, name: Optional[str] = None,
     return _run_global(op_fn, garr)
 
 
+def allreduce_device_async(tensor, op_code: int = 1,
+                           prescale: float = 1.0, postscale: float = 1.0,
+                           name: Optional[str] = None):
+    """Submit an HBM-resident tensor on the negotiated device plane and
+    return a zero-arg finisher (the overlap scheduler's bucket dispatch
+    rides this: submits stay on device, the background runtime
+    negotiates + fuses while the caller computes, ``finisher()`` blocks
+    and yields the on-device result).  Caller must have checked
+    ``_negotiated_device_ready`` — this function assumes a controller."""
+    ctl = _controller()
+    submitted = _ctl(ctl.allreduce_device_submit, tensor, op=int(op_code),
+                     prescale=prescale, postscale=postscale, name=name)
+
+    def fin(_s=submitted):
+        return _ctl(ctl.device_finish, *_s)
+    return fin
+
+
 def _flatten01(a):
     return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
 

@@ -1,0 +1,696 @@
+"""Backward-overlap bucketed gradient scheduler — hide the wire behind
+the math.
+
+Round-5 silicon showed the system is bandwidth-bound (~25-30 GB of step
+traffic); PR 5 shrank the bytes (quantized wire), this module overlaps
+them.  Instead of one synchronization after the full grad pytree, the
+pytree is partitioned into size-bounded **buckets in reverse-autodiff
+order** (the order gradients materialize during backward — the Horovod
+tensor-fusion idea, arXiv:1802.05799, taken to its limit) and each
+bucket's collective launches as soon as its gradients exist:
+
+* **Compiled plane** — :func:`sync_in_backward` wraps the params in
+  per-bucket ``jax.custom_vjp`` identities whose VJP *is* the bucket's
+  (optionally quantized) allreduce, so the collective is emitted inside
+  the backward computation and XLA's latency-hiding scheduler can
+  interleave it with the remaining backward compute.
+  :func:`bucketed_allreduce_tree` is the post-backward variant
+  (``DistributedOptimizer(overlap=…)``): one independent collective per
+  bucket instead of a per-leaf spray, still freely schedulable by XLA
+  against whatever compute the surrounding jit holds.
+* **Eager / negotiated plane** — :class:`EagerBucketQueue` dispatches
+  each bucket asynchronously (native-controller background runtime,
+  donated in-place buffers when the caller opts in, HBM-staged device
+  submits on the negotiated device plane) and measures how much of the
+  wire time the caller's interleaved compute actually hid
+  (``hvd_overlap_comm_hidden_ratio``).
+
+Bit-parity contract: every leaf is padded to a quantization-block
+multiple before entering a bucket's concatenated wire buffer, so block
+boundaries never straddle leaves and the per-element math — absmax
+blocks, fp32 accumulation order, requantization — is IDENTICAL to the
+per-leaf (barrier) schedule for fp32, cast (bf16/fp16) and quantized
+(int8/int4) wires.  ``tests/test_overlap.py`` asserts bitwise equality
+on the 8-way mesh, including error-feedback residual equivalence.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..debug import flight as _flight
+
+
+# ---------------------------------------------------------------------------
+# bucket planning
+# ---------------------------------------------------------------------------
+
+class BucketPlan(NamedTuple):
+    """Static partition of a flat leaf list into launch-ordered buckets.
+
+    ``buckets`` holds tuples of leaf indices, FIRST bucket = the leaves
+    whose gradients materialize first in reverse-mode AD (the tail of
+    the pytree).  Hashable — rides jit static arguments."""
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    bucket_bytes: int
+    n_leaves: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def _leaf_nbytes(leaf) -> int:
+    return int(getattr(leaf, "size", 0)) * np.dtype(leaf.dtype).itemsize
+
+
+def plan_buckets(leaves: Sequence, bucket_bytes: Optional[int] = None,
+                 record: bool = True) -> BucketPlan:
+    """Partition ``leaves`` into size-bounded buckets in reverse order.
+
+    Reverse order = reverse-autodiff order: the LAST parameters of the
+    pytree (the deepest layers, whose grads backward produces first)
+    land in the first bucket, so their collective can launch while the
+    rest of the backward still runs.  A bucket closes when adding the
+    next leaf would exceed ``bucket_bytes`` or change dtype (buckets
+    concatenate into one wire buffer — mixed dtypes cannot share it);
+    a leaf larger than the bound gets a bucket of its own; the LAST
+    bucket is the tail and may be arbitrarily small.
+    """
+    bb = int(default_bucket_bytes() if bucket_bytes is None
+             else bucket_bytes)
+    if bb <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bb}")
+    buckets: List[Tuple[int, ...]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i in reversed(range(len(leaves))):
+        nb = _leaf_nbytes(leaves[i])
+        dt = np.dtype(leaves[i].dtype)
+        if cur and (dt != cur_dtype or cur_bytes + nb > bb):
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = dt
+    if cur:
+        buckets.append(tuple(cur))
+    plan = BucketPlan(tuple(buckets), bb, len(leaves))
+    if record and buckets:
+        _, hist, _ = _overlap_metrics()
+        for idxs in buckets:
+            hist.observe(float(sum(_leaf_nbytes(leaves[i]) for i in idxs)))
+        _flight.record("overlap.plan", None, n_buckets=len(buckets),
+                       bucket_bytes=bb, n_leaves=len(leaves))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# knobs: session override (autotune) → Config (HVD_TPU_OVERLAP_*)
+# ---------------------------------------------------------------------------
+
+# The autotuner's live choice (``ParameterManager`` bucket-size
+# categorical, applied through the native controller): None = tuner has
+# not spoken, 0 = tuner chose overlap OFF, >0 = tuned bucket bytes.
+# Scope note: EAGER/NEGOTIATED dispatch only — the optimizer front-end
+# resolves compiled traces with ``compiled=True``, which ignores this
+# rank-local value (see resolve_bucket_bytes); compiled bucket
+# structure comes from the rank-consistent env knobs alone.
+_session_bucket_bytes: Optional[int] = None
+
+
+def set_session_bucket_bytes(n: Optional[int]) -> None:
+    """Autotuner hook: 0 = overlap off, >0 = bucket bytes, None = clear
+    back to the configured default."""
+    global _session_bucket_bytes
+    _session_bucket_bytes = None if n is None else max(0, int(n))
+
+
+def session_bucket_bytes() -> Optional[int]:
+    return _session_bucket_bytes
+
+
+def _config():
+    from ..core.state import global_state
+    cfg = getattr(global_state, "config", None)
+    if cfg is not None:
+        return cfg
+    from ..core.config import Config
+    return Config.from_env()
+
+
+def default_bucket_bytes() -> int:
+    """The session bucket size: the tuner's live choice if it picked a
+    size, else the HVD_TPU_OVERLAP_BUCKET_BYTES knob (core/config.py)."""
+    if _session_bucket_bytes:
+        return _session_bucket_bytes
+    return _config().overlap_bucket_bytes
+
+
+def resolve_bucket_bytes(overlap, compiled: bool = False) -> Optional[int]:
+    """Normalize an ``overlap=`` argument to bucket bytes, or None = off.
+
+    ``None`` defers to the session: the autotuner's live choice when it
+    has one, else the ``HVD_TPU_OVERLAP`` on/off knob with
+    ``HVD_TPU_OVERLAP_BUCKET_BYTES`` sizing.  ``True`` opts in at the
+    session bucket size; ``False``/``0`` forces off; an int is the
+    bucket size in bytes.
+
+    ``compiled=True`` (tracer gradients) ignores the autotuner's
+    rank-local session override and reads only the env-derived config:
+    the tuner runs on rank 0, and a compiled SPMD program whose bucket
+    structure diverged across ranks would emit mismatched collectives.
+    Env knobs are rank-consistent by the launcher's env contract, so
+    compiled traces stay aligned; the tuned value reaches the eager
+    plane, whose per-LEAF negotiation names are bucket-structure
+    invariant (see EagerBucketQueue)."""
+    session = None if compiled else _session_bucket_bytes
+    if overlap is None:
+        if session is not None:
+            return session or None
+        cfg = _config()
+        return cfg.overlap_bucket_bytes if cfg.overlap else None
+    if overlap is False:
+        return None
+    if overlap is True:
+        return session if session else _config().overlap_bucket_bytes
+    n = int(overlap)
+    return n if n > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+_metrics_rec = None
+
+
+def _overlap_metrics():
+    global _metrics_rec
+    if _metrics_rec is None:
+        from ..metrics.registry import DEFAULT_BYTE_BUCKETS, registry
+        reg = registry()
+        _metrics_rec = (
+            reg.counter("hvd_overlap_buckets_total",
+                        "Gradient buckets scheduled by the overlap "
+                        "engine (planned at trace time on the compiled "
+                        "plane, launched per step on the eager plane)"),
+            reg.histogram("hvd_overlap_bucket_bytes",
+                          "Payload bytes per scheduled gradient bucket",
+                          buckets=DEFAULT_BYTE_BUCKETS),
+            reg.gauge("hvd_overlap_comm_hidden_ratio",
+                      "Measured fraction of bucket wire time overlapped "
+                      "with compute (1.0 = fully hidden; eager plane "
+                      "measures per EagerBucketQueue.finish, the bench "
+                      "records its native-plane wall-clock figure)"),
+        )
+    return _metrics_rec
+
+
+def record_hidden_ratio(ratio: float) -> None:
+    """Publish a measured comm-hidden fraction (clamped to [0, 1]) —
+    used by ``bench.py --bench overlap`` to publish the wall-clock
+    figure from its native eager-plane arm, measured outside the step
+    (a running step cannot instrument itself from inside)."""
+    _overlap_metrics()[2].set(min(max(float(ratio), 0.0), 1.0))
+
+
+# ---------------------------------------------------------------------------
+# compiled plane: bucketed allreduce with per-leaf block alignment
+# ---------------------------------------------------------------------------
+
+def _reducible(leaf) -> bool:
+    import jax
+    return isinstance(leaf, (jax.Array, np.ndarray)) or \
+        (hasattr(leaf, "dtype") and hasattr(leaf, "shape"))
+
+
+def _active_comp(comp, leaf, op):
+    """The compressor that actually applies to this bucket (None when
+    the wire is 'none' or the dtype/op cannot carry a lossy wire)."""
+    from . import collective as C
+    if comp is None or getattr(comp, "wire", "none") == "none":
+        return None
+    return comp if C._compressible(leaf, op) else None
+
+
+def _concat_flat(leaves, align: int):
+    """Concatenate raveled leaves, each zero-padded to a multiple of
+    ``align`` — the block-boundary guarantee behind bit parity."""
+    import jax.numpy as jnp
+    parts = []
+    for x in leaves:
+        flat = jnp.ravel(x)
+        pad = (-flat.size) % align
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        parts.append(flat)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def _split_back(buf, leaves, align: int):
+    outs, off = [], 0
+    for x in leaves:
+        n = int(x.size)
+        outs.append(buf[off: off + n].reshape(x.shape).astype(x.dtype))
+        off += n + ((-n) % align)
+    return outs
+
+
+def _compiled_bucket_allreduce(leaves, op, axis_name, comp,
+                               prescale: float, postscale: float):
+    """One bucket = one collective: concatenate the (block-aligned)
+    leaf flats, reduce once, split back.  Bit-identical to reducing each
+    leaf separately — see the module docstring's parity argument."""
+    from . import collective as C
+    if op == C.Adasum:
+        # Adasum's reduction weights depend on whole-tensor norms:
+        # concatenating leaves would change the math, not just the
+        # schedule.  The optimizer front-end never routes Adasum here.
+        raise ValueError("bucketed overlap does not compose with "
+                         "op=Adasum (norm-weighted reduction is not "
+                         "concatenation-invariant)")
+    comp = _active_comp(comp, leaves[0], op)
+    if comp is None:
+        buf = _concat_flat(leaves, 1)
+        red = C.allreduce(buf, op=op, axis_name=axis_name,
+                          prescale_factor=prescale,
+                          postscale_factor=postscale)
+        return _split_back(red, leaves, 1)
+    from . import quantization as Q
+    spec = comp.spec()
+    align = spec.block if spec is not None else 1
+    buf = _concat_flat(leaves, align)
+    red = Q.compressed_allreduce(
+        buf, C._default_axis(axis_name), op, spec=spec,
+        wire_dtype=None if spec is not None else comp.wire_dtype,
+        prescale=prescale, postscale=postscale)
+    return _split_back(red, leaves, align)
+
+
+def _apply_per_bucket(red_leaves, plan, bucket_fn):
+    """Apply ``bucket_fn(bucket_leaves) -> reduced_leaves`` to every
+    bucket of ``plan``; returns the reduced leaves in ``red_leaves``
+    order."""
+    out: List[Any] = [None] * len(red_leaves)
+    for idxs in plan.buckets:
+        vals = bucket_fn([red_leaves[i] for i in idxs])
+        for j, i in enumerate(idxs):
+            out[i] = vals[j]
+    return out
+
+
+def _bucketed_tree_map(tree, bucket_bytes, reduce_all, skip_unreducible):
+    """Shared tree scaffolding for the bucketed entry points: flatten,
+    (optionally) leave non-array leaves untouched, plan buckets, hand
+    ``reduce_all(red_leaves, plan) -> reduced leaves in red order`` the
+    work, scatter results back, unflatten."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if skip_unreducible:
+        red_idx = [i for i, x in enumerate(leaves) if _reducible(x)]
+    else:
+        red_idx = list(range(len(leaves)))
+    out = list(leaves)
+    if red_idx:
+        red_leaves = [leaves[i] for i in red_idx]
+        plan = plan_buckets(red_leaves, bucket_bytes)
+        for i, v in zip(red_idx, reduce_all(red_leaves, plan)):
+            out[i] = v
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def bucketed_allreduce_tree(tree, op=None, axis_name=None, compression=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            bucket_bytes: Optional[int] = None,
+                            name: Optional[str] = None):
+    """Reduce a gradient pytree per-bucket instead of per-leaf.
+
+    Compiled path (tracer leaves): one independent collective per
+    bucket — XLA's scheduler can interleave them with surrounding
+    compute.  Eager path (concrete leaves): per-bucket async dispatch
+    through :class:`EagerBucketQueue` (native controller / negotiated
+    device plane when attached).  Values are bit-identical to the
+    per-leaf barrier schedule.
+    """
+    from . import collective as C
+    if op is None:
+        op = C.Average
+
+    def reduce_all(red_leaves, plan):
+        if C._is_tracer(red_leaves[0]):
+            _overlap_metrics()[0].inc(float(plan.n_buckets))
+            return _apply_per_bucket(
+                red_leaves, plan,
+                lambda xs: _compiled_bucket_allreduce(
+                    xs, op, axis_name, compression,
+                    prescale_factor, postscale_factor))
+        q = EagerBucketQueue(plan, op=op, compression=compression,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             name=name)
+        for bi, idxs in enumerate(plan.buckets):
+            q.launch(bi, [red_leaves[i] for i in idxs])
+        return q.finish()
+
+    return _bucketed_tree_map(tree, bucket_bytes, reduce_all,
+                              skip_unreducible=True)
+
+
+# ---------------------------------------------------------------------------
+# compiled plane: custom_vjp hooks — the collective INSIDE the backward
+# ---------------------------------------------------------------------------
+
+def _make_bucket_tag(op, axis_name, compression, prescale, postscale):
+    """An identity on a bucket's params whose VJP is the bucket's
+    allreduce.  Reverse-mode AD reaches this VJP exactly when every
+    cotangent of the bucket is complete — partway through the backward
+    for all but the first layers — so the emitted collective sits
+    INSIDE the backward computation and the latency-hiding scheduler
+    can run it under the remaining backward FLOPs."""
+    import jax
+
+    @jax.custom_vjp
+    def tag(*xs):
+        return xs
+
+    def fwd(*xs):
+        return xs, None
+
+    def bwd(_, cts):
+        return tuple(_compiled_bucket_allreduce(
+            list(cts), op, axis_name, compression, prescale, postscale))
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def sync_in_backward(params, op=None, axis_name=None, compression=None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     bucket_bytes: Optional[int] = None):
+    """Wrap ``params`` (inside the differentiated function, before first
+    use) so that differentiating through them yields gradients that are
+    ALREADY bucket-allreduced — each bucket's collective emitted inside
+    the backward pass.  ``hvd.grad(fn, overlap=…)`` /
+    ``hvd.value_and_grad(fn, overlap=…)`` apply this for you.
+
+    Compiled-plane only: the emitted collectives bind ``axis_name``
+    like every ``lax`` collective, so the enclosing computation must run
+    under ``shard_map``/``jit`` over that mesh axis."""
+    from . import collective as C
+    if op is None:
+        op = C.Average
+
+    def reduce_all(red_leaves, plan):
+        _overlap_metrics()[0].inc(float(plan.n_buckets))
+        # A fresh tag per bucket: each carries its own custom_vjp whose
+        # backward is that bucket's allreduce.
+        return _apply_per_bucket(
+            red_leaves, plan,
+            lambda xs: _make_bucket_tag(op, axis_name, compression,
+                                        prescale_factor,
+                                        postscale_factor)(*xs))
+
+    return _bucketed_tree_map(params, bucket_bytes, reduce_all,
+                              skip_unreducible=True)
+
+
+# ---------------------------------------------------------------------------
+# compiled plane: bucketed ZeRO gradient reduce-scatter
+# ---------------------------------------------------------------------------
+
+def _bucket_reducescatter(leaves, op, axis_name, world: int, comp):
+    """One bucket = one reduce-scatter exchange.  Per leaf, each rank
+    gets the flat shard ``[idx*k_i, (idx+1)*k_i)`` with
+    ``k_i = ceil(size_i/world)`` — the same shard, with the same
+    per-element math (per-leaf quantization rows, fp32 accumulation),
+    as ``ops.collective.reducescatter`` applied per leaf."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import collective as C
+    comp = _active_comp(comp, leaves[0], op)
+
+    def rows_of(x):
+        flat = jnp.ravel(x)
+        pad = (-flat.size) % world
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(world, -1)
+
+    if comp is None or comp.wire_dtype is not None:
+        wire_dtype = None if comp is None else comp.wire_dtype
+        rows = [rows_of(x) for x in leaves]
+        ks = [r.shape[1] for r in rows]
+        if wire_dtype is None:
+            cat = jnp.concatenate(rows, axis=1) if len(rows) > 1 else rows[0]
+            red = lax.psum_scatter(cat.reshape(-1), axis_name,
+                                   scatter_dimension=0, tiled=True)
+            if op == C.Average:
+                red = red / world
+        else:
+            # Cast wire, fp32 accumulation — the per-leaf
+            # compressed_reducescatter schedule, one exchange per bucket.
+            payload = jnp.concatenate(
+                [r.astype(jnp.float32).astype(wire_dtype) for r in rows],
+                axis=1)
+            payload = lax.all_to_all(payload, axis_name, split_axis=0,
+                                     concat_axis=0, tiled=True)
+            red = payload.astype(jnp.float32).sum(axis=0)
+            if op == C.Average:
+                red = red / world
+        outs, off = [], 0
+        for x, k in zip(leaves, ks):
+            outs.append(red[off: off + k].astype(x.dtype))
+            off += k
+        return outs
+
+    # Quantized wire: quantize each leaf's destination rows with its own
+    # block grid (blocks never straddle leaves OR rows — the same grid
+    # as the per-leaf compressed_reducescatter), exchange ONE payload +
+    # ONE scale tensor for the whole bucket, accumulate fp32.
+    from . import quantization as Q
+    spec = comp.spec()
+    payloads, scales, metas = [], [], []
+    for x in leaves:
+        rows = rows_of(x).astype(jnp.float32)
+        k = rows.shape[1]
+        pad = (-k) % spec.block
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        p, s = Q._rows_to_wire(rows, spec, None)
+        payloads.append(p)
+        scales.append(s)
+        metas.append((k, rows.shape[1], p.shape[1], s.shape[1]))
+    cat_p = jnp.concatenate(payloads, axis=1) if len(payloads) > 1 \
+        else payloads[0]
+    cat_s = jnp.concatenate(scales, axis=1) if len(scales) > 1 else scales[0]
+    cat_p = lax.all_to_all(cat_p, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    cat_s = lax.all_to_all(cat_s, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    outs, poff, soff = [], 0, 0
+    for x, (k, k_pad, pk, nb) in zip(leaves, metas):
+        contrib = Q._wire_to_f32(cat_p[:, poff: poff + pk],
+                                 cat_s[:, soff: soff + nb], spec, k_pad)
+        acc = contrib.sum(axis=0)[:k]
+        if op == C.Average:
+            acc = acc / world
+        outs.append(acc.astype(x.dtype))
+        poff += pk
+        soff += nb
+    return outs
+
+
+def bucketed_reducescatter_tree(grads, op=None, axis_name=None,
+                                compression=None,
+                                bucket_bytes: Optional[int] = None):
+    """ZeRO's gradient reduce-scatter, bucketed: returns a pytree of
+    per-rank flat shards (length ``ceil(size/world)`` per leaf),
+    bit-identical to mapping ``ops.collective.reducescatter`` over the
+    padded leaves but with one wire exchange per bucket.  Must run
+    inside ``shard_map``/``jit`` over ``axis_name``."""
+    from ..compat import axis_size
+    from . import collective as C
+    if op is None:
+        op = C.Average
+    if op not in (C.Sum, C.Average):
+        # Same contract as the per-leaf ops.collective.reducescatter —
+        # anything else would silently degrade to a plain Sum here.
+        raise ValueError("bucketed reducescatter supports Sum/Average")
+    ax = C._default_axis(axis_name)
+    world = axis_size(ax)
+
+    def reduce_all(red_leaves, plan):
+        _overlap_metrics()[0].inc(float(plan.n_buckets))
+        return _apply_per_bucket(
+            red_leaves, plan,
+            lambda xs: _bucket_reducescatter(xs, op, ax, world,
+                                             compression))
+
+    return _bucketed_tree_map(grads, bucket_bytes, reduce_all,
+                              skip_unreducible=False)
+
+
+# ---------------------------------------------------------------------------
+# eager / negotiated plane: async bucket queue
+# ---------------------------------------------------------------------------
+
+class EagerBucketQueue:
+    """Launch per-bucket asynchronous allreduces as buckets materialize;
+    collect them in launch order.
+
+    The caller drives the interleave::
+
+        q = EagerBucketQueue(plan, op=hvd.Average, name=f"step{i%2}")
+        for bi, idxs in enumerate(plan.buckets):
+            grads = compute_bucket(bi)          # backward slice
+            q.launch(bi, grads)                 # wire starts NOW
+        reduced = q.finish()                    # flat list, leaf order
+
+    With the native controller attached the background runtime
+    negotiates and streams each bucket while the caller computes the
+    next one; members of one bucket enqueue together so the runtime's
+    fusion buffer batches them into shared ring launches (HBM-staged
+    device submits on the negotiated device plane).  ``donate=True``
+    additionally reduces C-contiguous numpy buffers IN PLACE — no copy,
+    the caller's buffer is the wire buffer.  ``finish`` records the
+    measured comm-hidden ratio (wire wall time the caller did NOT spend
+    blocked) in ``hvd_overlap_comm_hidden_ratio``.
+
+    Names follow the collective naming contract: identical call order
+    across ranks; pass a distinct ``name`` per step if two queues can be
+    in flight at once."""
+
+    def __init__(self, plan: BucketPlan, op=None, compression=None,
+                 prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0,
+                 name: Optional[str] = None, donate: bool = False):
+        from . import collective as C
+        self._plan = plan
+        self._op = C.Average if op is None else op
+        self._comp = compression
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._base = name or "overlap"
+        self._donate = donate
+        # bucket index -> (list of finishers, submit_seconds, wall_launched)
+        self._inflight = {}
+        self._launch_order: List[int] = []
+
+    def _submit_one(self, tensor, name: str):
+        """Returns a zero-arg finisher for one leaf's async allreduce."""
+        from ..core.state import global_state
+        from . import collective as C
+        from . import eager as E
+        comp = self._comp
+        if comp is None or getattr(comp, "wire", "none") == "none":
+            # Eager-plane scope: the barrier schedule's per-leaf sync
+            # C.allreduce resolves the HVD_TPU_COMPRESSION session
+            # default — the bucketed schedule must apply the SAME wire
+            # format or flipping overlap would change gradient VALUES,
+            # not just the schedule.
+            comp = C._resolve_compression(None)
+        comp = _active_comp(comp, tensor, self._op)
+        ctl = global_state.controller
+        if comp is None and ctl is not None and \
+                E._is_device_array(tensor) and \
+                E._negotiated_device_ready(ctl):
+            # HBM-resident tensor + negotiated device plane: stage on
+            # device, never copy through the host.
+            return E.allreduce_device_async(
+                tensor, op_code=int(self._op), prescale=self._prescale,
+                postscale=self._postscale, name=name)
+        if comp is None and self._donate and ctl is not None and \
+                isinstance(tensor, np.ndarray) and \
+                tensor.flags["C_CONTIGUOUS"] and \
+                tensor.dtype in (np.float32, np.float64):
+            # Donated buffer: the caller's array IS the wire buffer —
+            # reduced in place, zero staging copies.
+            h = ctl.allreduce_async_(tensor, tensor, op=int(self._op),
+                                     prescale=self._prescale,
+                                     postscale=self._postscale, name=name)
+
+            def fin(_h=h, _t=tensor):
+                from .eager import _ctl as _ctl_call
+                _ctl_call(ctl.wait, _h)
+                return _t
+            return fin
+        h = C.allreduce_async(tensor, op=self._op, name=name,
+                              prescale_factor=self._prescale,
+                              postscale_factor=self._postscale,
+                              compression=comp)
+        return lambda _h=h: C.synchronize(_h)
+
+    def launch(self, bucket: int, leaves: Sequence) -> None:
+        """Submit bucket ``bucket``'s leaves (plan order within the
+        bucket).  Returns immediately once the transfers are in flight."""
+        idxs = self._plan.buckets[bucket]
+        if len(leaves) != len(idxs):
+            raise ValueError(
+                f"bucket {bucket} holds {len(idxs)} leaves, "
+                f"got {len(leaves)}")
+        nbytes = sum(_leaf_nbytes(x) for x in leaves)
+        _overlap_metrics()[0].inc()
+        _flight.record("overlap.bucket_launch", f"{self._base}.b{bucket}",
+                       bucket=bucket, bytes=nbytes, tensors=len(leaves))
+        # Names carry the LEAF index, not the bucket index: every rank
+        # submits the same name sequence in the same (reverse-leaf)
+        # order whatever its bucket size, so a mid-run tuner flip that
+        # has not reached every rank yet cannot desync the controller's
+        # name-based negotiation — bucket boundaries only change when
+        # each name enters flight.
+        t0 = time.perf_counter()
+        fins = [self._submit_one(x, f"{self._base}.{idxs[j]}")
+                for j, x in enumerate(leaves)]
+        submit_s = time.perf_counter() - t0
+        self._inflight[bucket] = (fins, submit_s, time.perf_counter())
+        self._launch_order.append(bucket)
+
+    def finish(self) -> List[Any]:
+        """Wait for every launched bucket (launch order), record the
+        measured comm-hidden ratio, and return the reduced leaves as a
+        flat list aligned with the planner's input order (unlaunched
+        leaves are None)."""
+        out: List[Any] = [None] * self._plan.n_leaves
+        submit_total, blocked = 0.0, 0.0
+        spans: List[Tuple[float, float]] = []
+        for bucket in self._launch_order:
+            fins, submit_s, launched = self._inflight.pop(bucket)
+            t0 = time.perf_counter()
+            vals = [f() for f in fins]
+            now = time.perf_counter()
+            blocked += now - t0
+            spans.append((launched - submit_s, now))
+            submit_total += submit_s
+            for j, i in enumerate(self._plan.buckets[bucket]):
+                out[i] = vals[j]
+            _flight.record("overlap.bucket_done",
+                           f"{self._base}.b{bucket}", bucket=bucket,
+                           dur_s=now - launched)
+        self._launch_order = []
+        # In-flight wall = the UNION of the per-bucket [submit-start,
+        # collected] intervals (they overlap — summing them would credit
+        # N back-to-back buckets with (N-1)/N hiding the caller never
+        # got).  Exposed = submission time (the whole op, on the
+        # synchronous fallback) + time spent blocked collecting; the
+        # rest of the union is wall the caller spent computing while
+        # buckets flew.
+        union, cursor = 0.0, None
+        for start, end in spans:
+            if cursor is None or start > cursor:
+                union += end - start
+            elif end > cursor:
+                union += end - cursor
+            cursor = end if cursor is None else max(cursor, end)
+        if union > 0:
+            hidden = max(0.0, 1.0 - (submit_total + blocked) / union)
+            _overlap_metrics()[2].set(hidden)
+        return out

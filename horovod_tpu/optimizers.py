@@ -31,11 +31,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops import collective as C
+from .ops import overlap as _overlap
 from .ops.compression import Compression, NoneCompressor
 
 
 def _allreduce_tree(tree, op, axis_name, compression,
-                    prescale_factor=1.0, postscale_factor=1.0):
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    bucket_bytes=None):
+    if bucket_bytes:
+        # Backward-overlap bucketed schedule (ops/overlap.py): one
+        # collective per size-bounded bucket in reverse-autodiff order
+        # instead of a per-leaf spray — bit-identical values, but XLA
+        # (compiled) / the native background runtime (eager) can run
+        # each bucket's wire under the remaining compute.
+        return _overlap.bucketed_allreduce_tree(
+            tree, op=op, axis_name=axis_name, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, bucket_bytes=bucket_bytes)
     comp = compression or NoneCompressor
 
     def _one(x):
@@ -89,7 +101,8 @@ def DistributedOptimizer(optimizer,
                          backward_passes_per_step: int = 1,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         average_aggregated_gradients: bool = True):
+                         average_aggregated_gradients: bool = True,
+                         overlap=None):
     """Wrap an optax ``GradientTransformation`` for data-parallel training.
 
     Use inside ``jit``/``shard_map`` with gradients computed per-shard; the
@@ -97,6 +110,15 @@ def DistributedOptimizer(optimizer,
     ``op=Adasum`` the inner update is computed from local gradients and the
     resulting *delta* is Adasum-reduced (reference delta model,
     torch/optimizer.py:335-503).
+
+    ``overlap`` selects the backward-overlap bucketed communication
+    schedule (``ops/overlap.py``): ``True`` buckets at the session size
+    (``HVD_TPU_OVERLAP_BUCKET_BYTES`` or the autotuner's choice), an int
+    is the bucket size in bytes, ``None`` defers to the
+    ``HVD_TPU_OVERLAP`` session default, ``False`` forces the per-leaf
+    barrier schedule.  Values are bit-identical either way (error
+    feedback included); only the wire schedule changes.  Not applied to
+    ``op=Adasum`` (its delta reduction is not concatenation-invariant).
     """
     import optax
 
@@ -131,8 +153,15 @@ def DistributedOptimizer(optimizer,
     def _communicate(grads):
         if op == C.Adasum:
             return grads  # Adasum reduces the delta after the inner update.
+        # Resolved per call: the autotuner's bucket-size choice reaches
+        # eager dispatch immediately; compiled traces read only the
+        # rank-consistent env knobs (see overlap.resolve_bucket_bytes).
+        leaves = jax.tree_util.tree_leaves(grads)
+        compiled = bool(leaves) and C._is_tracer(leaves[0])
         return _allreduce_tree(grads, op, axis_name, compression,
-                               prescale_factor, postscale_factor)
+                               prescale_factor, postscale_factor,
+                               bucket_bytes=_overlap.resolve_bucket_bytes(
+                                   overlap, compiled=compiled))
 
     def _with_feedback(grads, residual):
         """(grads + residual, new residual): EF-corrected communicate
@@ -215,7 +244,7 @@ class ZeroGradientTransformation(NamedTuple):
 
 def ZeroShardedOptimizer(optimizer, op: int = C.Average,
                          axis_name: Optional[str] = None,
-                         compression=None):
+                         compression=None, overlap=None):
     """ZeRO-1 optimizer-state sharding over the data-parallel axis — a
     TPU-native capability beyond the reference (Horovod replicates
     optimizer state on every rank; here each dp rank owns 1/N of it,
@@ -243,6 +272,11 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     stays full-precision — updates feed ``optax.apply_updates`` directly
     and, unlike gradients, have no error-feedback channel to absorb
     quantization loss.
+
+    ``overlap`` (same semantics as ``DistributedOptimizer``) buckets the
+    gradient reduce-scatter: one wire exchange per size-bounded bucket
+    in reverse-autodiff order instead of one per leaf, bit-identical
+    shards, schedulable by XLA against the surrounding backward.
     """
     import optax
     from jax import lax
@@ -278,11 +312,17 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
         world = axis_size(ax)
         idx = lax.axis_index(ax)
 
-        g_shards = jax.tree_util.tree_map(
-            lambda g: C.reducescatter(
-                _pad_flat(g, world), op=op, axis_name=ax,
-                compression=(compression if C._compressible(g, op)
-                             else None)), grads)
+        bucket_bytes = _overlap.resolve_bucket_bytes(overlap, compiled=True)
+        if bucket_bytes:
+            g_shards = _overlap.bucketed_reducescatter_tree(
+                grads, op=op, axis_name=ax, compression=compression,
+                bucket_bytes=bucket_bytes)
+        else:
+            g_shards = jax.tree_util.tree_map(
+                lambda g: C.reducescatter(
+                    _pad_flat(g, world), op=op, axis_name=ax,
+                    compression=(compression if C._compressible(g, op)
+                                 else None)), grads)
         p_shards = None if params is None else jax.tree_util.tree_map(
             lambda p: _my_shard(p, world, idx), params)
         upd_shards, inner = optimizer.update(g_shards, state.inner,
@@ -319,11 +359,43 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
 # Gradient-tape analog: functional transforms
 # ---------------------------------------------------------------------------
 
+def _overlap_fun(fun: Callable, op, axis_name, compression, bucket_bytes,
+                 grad_kwargs) -> Callable:
+    """``fun`` with its first argument routed through the overlap
+    engine's per-bucket ``custom_vjp`` identities: differentiating the
+    result yields cotangents that are ALREADY bucket-allreduced, each
+    bucket's collective emitted INSIDE the backward pass (compiled
+    plane; must run under jit/shard_map over ``axis_name``)."""
+    if grad_kwargs.get("argnums", 0) != 0:
+        raise ValueError(
+            "overlap= composes with argnums=0 only (the tagged pytree "
+            "is the differentiated argument)")
+
+    def tagged(params, *args, **kwargs):
+        return fun(_overlap.sync_in_backward(
+            params, op=op, axis_name=axis_name, compression=compression,
+            bucket_bytes=bucket_bytes), *args, **kwargs)
+
+    return tagged
+
+
 def grad(fun: Callable, op: int = C.Average,
          axis_name: Optional[str] = None, compression=None,
-         **grad_kwargs) -> Callable:
+         overlap=None, **grad_kwargs) -> Callable:
     """``jax.grad`` that allreduces the result — the functional equivalent of
-    ``DistributedGradientTape`` (reference tensorflow/__init__.py:723-814)."""
+    ``DistributedGradientTape`` (reference tensorflow/__init__.py:723-814).
+
+    ``overlap`` (explicit opt-in: ``True`` or bucket bytes) emits each
+    bucket's collective inside the backward via ``jax.custom_vjp``
+    instead of reducing after it — compiled-plane (jit/shard_map) only,
+    so unlike the optimizer front-end it does NOT follow the
+    ``HVD_TPU_OVERLAP`` session default (this transform also serves
+    eager callers, where the tagged collectives cannot bind an axis)."""
+    if overlap:
+        return jax.grad(_overlap_fun(
+            fun, op, axis_name, compression,
+            _overlap.resolve_bucket_bytes(overlap, compiled=True),
+            grad_kwargs), **grad_kwargs)
     gfun = jax.grad(fun, **grad_kwargs)
 
     def wrapped(*args, **kwargs):
@@ -335,7 +407,13 @@ def grad(fun: Callable, op: int = C.Average,
 
 def value_and_grad(fun: Callable, op: int = C.Average,
                    axis_name: Optional[str] = None, compression=None,
-                   **grad_kwargs) -> Callable:
+                   overlap=None, **grad_kwargs) -> Callable:
+    if overlap:
+        return jax.value_and_grad(
+            _overlap_fun(fun, op, axis_name, compression,
+                         _overlap.resolve_bucket_bytes(overlap,
+                                                       compiled=True),
+                         grad_kwargs), **grad_kwargs)
     vgfun = jax.value_and_grad(fun, **grad_kwargs)
 
     def wrapped(*args, **kwargs):

@@ -31,6 +31,46 @@ def test_jax_mnist_single_proc():
 
 
 @pytest.mark.timeout(300)
+def test_jax_mnist_overlap_identical_losses():
+    """--overlap switches the optimizer to the bucketed backward-overlap
+    schedule (docs/overlap.md) — bit parity means the printed losses
+    must be IDENTICAL, not merely close."""
+    base = _run([os.path.join(EXAMPLES, "jax_mnist.py"), "--epochs", "2"])
+    over = _run([os.path.join(EXAMPLES, "jax_mnist.py"), "--epochs", "2",
+                 "--overlap"])
+    assert base.returncode == 0, base.stderr[-2000:]
+    assert over.returncode == 0, over.stderr[-2000:]
+    base_losses = [ln for ln in base.stdout.splitlines() if "loss" in ln]
+    over_losses = [ln for ln in over.stdout.splitlines() if "loss" in ln]
+    assert base_losses and base_losses == over_losses, \
+        (base_losses, over_losses)
+
+
+@pytest.mark.timeout(300)
+def test_jax_transformer_lm_overlap_identical_losses():
+    """--overlap feeds the bucketed DistributedOptimizer path (explicit
+    dp shard_map step) — same math as the AD-transpose baseline step, so
+    losses at world 1 must match (tiny float tolerance only for the
+    different step structure XLA compiles)."""
+    args = ["--layers", "1", "--d-model", "64", "--seq", "32",
+            "--batch", "4", "--steps", "3"]
+    base = _run([os.path.join(EXAMPLES, "jax_transformer_lm.py")] + args)
+    over = _run([os.path.join(EXAMPLES, "jax_transformer_lm.py")] + args +
+                ["--overlap"])
+    assert base.returncode == 0, base.stderr[-2000:]
+    assert over.returncode == 0, over.stderr[-2000:]
+
+    def losses(r):
+        return [float(ln.split("loss")[-1]) for ln in r.stdout.splitlines()
+                if "loss" in ln]
+
+    lb, lo = losses(base), losses(over)
+    assert len(lb) == 3 and len(lo) == 3, (base.stdout, over.stdout)
+    # Printed at 4 decimals; allow one ulp of the print rounding.
+    assert all(abs(a - b) <= 2e-4 for a, b in zip(lb, lo)), (lb, lo)
+
+
+@pytest.mark.timeout(300)
 def test_pytorch_synthetic_benchmark_single_proc():
     pytest.importorskip("torch")
     r = _run([os.path.join(EXAMPLES, "pytorch_synthetic_benchmark.py"),

@@ -104,6 +104,9 @@ def test_parameter_manager_warmup_and_steps():
         pm.record_bytes(1000)
     assert pm.frozen                 # max_samples=2 reached → frozen
     assert len(applied) >= 3         # proposals + final best applied
+    # Applied tuples carry the full 7-wide parameter vector: (fusion,
+    # cycle, har, hag, cache, compression, overlap_bucket_bytes).
+    assert all(len(p) == 7 for p in applied), applied
 
 
 def test_elastic_timeout_waits_for_capacity(monkeypatch):
