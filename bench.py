@@ -38,36 +38,20 @@ BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0
 # ResNet-101 fwd ~7.83 GFLOP/img @224; train ~3x fwd.
 BASELINE_ACHIEVED_FLOPS = BASELINE_IMG_PER_SEC_PER_DEVICE * 3 * 7.83e9
 
-# Per-chip peak bf16 FLOP/s by device kind substring (public spec sheets).
-_PEAK_FLOPS = [
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12), ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
-
-# fwd GFLOP/img @224x224, width 64 (standard torchvision counts).
-_RESNET_FWD_GFLOP = {18: 1.82, 34: 3.68, 50: 4.09, 101: 7.83, 152: 11.53}
-
-
 def _peak_flops_per_chip():
-    import jax
-    d = jax.devices()[0]
-    if d.platform != "tpu":
-        return None
-    kind = d.device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    """The MFU ceiling — delegates to metrics/attribution.py (the single
+    home of the per-chip peak table AND the HVD_TPU_PEAK_TFLOPS
+    calibration override), so bench MFU and live hvd_mfu_ratio always
+    grade against the same number."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from horovod_tpu.metrics.attribution import peak_flops
+    return peak_flops()
 
 
 def _resnet_train_flops_per_img(depth, image_size, width):
-    fwd = _RESNET_FWD_GFLOP.get(depth, 4.09) * 1e9
-    fwd *= (image_size / 224.0) ** 2 * (width / 64.0) ** 2
-    return 3.0 * fwd  # fwd + bwd ~= 3x fwd
+    from horovod_tpu.models import resnet
+    return resnet.train_flops_per_image(
+        resnet.ResNetConfig(depth=depth, width=width), image_size)
 
 
 def _param_count(params):
@@ -76,33 +60,13 @@ def _param_count(params):
 
 
 def _bert_train_flops_per_seq(cfg, n_pred=None):
-    """Exact matmul-FLOPs accounting for the BERT step (train = 3x fwd).
-
-    Encoder: per token per layer qkv 6d^2 + proj 2d^2 + mlp 4*d*ff;
-    attention 4*S^2*d per layer per seq (scores + AV).  MLM head: the
-    transform (2d^2) and tied-vocab projection (2dV) run per predicted
-    position — S positions on the dense path, n_pred on the gathered
-    path (real-BERT max_predictions_per_seq semantics), so the gathered
-    step's reported MFU counts only the FLOPs it actually executes."""
-    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
-                      cfg.vocab_size)
-    enc = s * L * (8.0 * d * d + 4.0 * d * ff)
-    attn = L * 4.0 * s * s * d
-    pos = s if n_pred is None else n_pred
-    head = pos * (2.0 * d * d + 2.0 * d * v)
-    return 3.0 * (enc + attn + head)
+    from horovod_tpu.models import bert
+    return bert.train_flops_per_seq(cfg, n_pred=n_pred)
 
 
 def _longctx_train_flops_per_seq(cfg):
-    """Matmul-FLOPs for one causal-LM sequence (train = 3x fwd): dense
-    per token 8d^2 (qkv+proj) + 4*d*ff (mlp) per layer + 2dV vocab head;
-    causal attention 2*S^2*d per layer per seq (half the bidirectional
-    4*S^2*d — the mask zeroes the upper triangle)."""
-    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
-                      cfg.vocab_size)
-    dense = s * (L * (8.0 * d * d + 4.0 * d * ff) + 2.0 * d * v)
-    attn = L * 2.0 * s * s * d
-    return 3.0 * (dense + attn)
+    from horovod_tpu.models import transformer
+    return transformer.train_flops_per_seq(cfg)
 
 
 def _host_sync(x):
@@ -1568,6 +1532,131 @@ def bench_flight_overhead():
     })
 
 
+def bench_attribution():
+    """Performance-observatory tax + evidence: steps/sec with the
+    per-step attribution + drift detector ON vs OFF, at the production
+    per-step shape (data-wait span, N collective records, a
+    compute_span, set_step_flops, step_end) around a simulated step
+    cost (5 ms, the metrics_overhead shape) — the observatory's <1%
+    acceptance bar — plus the live numbers it produces: the last step's
+    component shares and the MFU grade (vs HVD_TPU_PEAK_TFLOPS, seeded
+    here with the round-5 calibrated 171 TFLOP/s when unset), recorded
+    into the BENCH_*.json trajectory.  Pure host-side: no accelerator.
+    Select with `bench.py --bench attribution`."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+    from horovod_tpu import metrics
+    from horovod_tpu.metrics.attribution import (
+        attribution as attr_engine, set_enabled as set_attr_enabled)
+    from horovod_tpu.metrics.baseline import (
+        drift_detector, reset_drift_detector)
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.utils import profiler
+
+    import tempfile
+
+    step_ms = float(os.environ.get("BENCH_ATTR_STEP_MS", "5"))
+    steps = int(os.environ.get("BENCH_ITERS", "300"))
+    n_coll = int(os.environ.get("BENCH_ATTR_COLLECTIVES", "4"))
+    os.environ.setdefault("HVD_TPU_PEAK_TFLOPS", "171")
+    # A drift fire (possible in the bare-hooks arm: ~0.1 ms steps, so
+    # scheduler jitter is a real relative excursion) writes a regression
+    # report — keep it out of the working tree.
+    os.environ.setdefault("HVD_TPU_FLIGHT_DIR", tempfile.mkdtemp(
+        prefix="hvd_bench_attr_"))
+    payload = np.ones((64, 1024), dtype=np.float32)  # 256 KB "gradient"
+    agg = metrics.Aggregator()
+    step_s = step_ms / 1e3
+    # Declared model FLOPs sized for ~35% MFU at the nominal step time:
+    # the bench proves the ACCOUNTING (declared flops / measured wall /
+    # calibrated peak), not a real model's arithmetic.
+    flops_per_step = 0.35 * float(os.environ["HVD_TPU_PEAK_TFLOPS"]) \
+        * 1e12 * step_s
+    eng = attr_engine()
+    counter = {"step": 0}
+
+    def one_step(sleep_s):
+        with profiler.data_wait():
+            if sleep_s:
+                time.sleep(sleep_s * 0.2)  # input 20% of the step
+        for _ in range(n_coll):
+            with C._op_range("allreduce", "grad", payload):
+                pass
+        with eng.compute_span():
+            if sleep_s:
+                time.sleep(sleep_s * 0.8)
+        counter["step"] += 1
+        agg.step_end(step=counter["step"])
+
+    def run(observatory_on, sleep_s, n, fire_guard=False):
+        set_attr_enabled(observatory_on)
+        eng.reset()
+        eng.set_step_flops(flops_per_step)
+        # Hook-only arms run ~0.1 ms steps, where scheduler jitter is a
+        # REAL relative excursion — pin the fire ratio out of reach so
+        # the per-step delta prices the detector's update math, not a
+        # rare fire's report build.  Fresh baseline per arm either way.
+        if fire_guard:
+            os.environ["HVD_TPU_PERF_DRIFT_MIN_PCT"] = "1e9"
+            reset_drift_detector()
+        else:
+            drift_detector().reset()
+        one_step(0)  # warm: children + sinks created, marks anchored
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one_step(sleep_s)
+        return time.perf_counter() - t0
+
+    guard_prev = os.environ.get("HVD_TPU_PERF_DRIFT_MIN_PCT")
+    try:
+        t_on = run(True, step_s, steps)
+        shares = (metrics.last_attribution() or {}).get("shares", {})
+        mfu = (metrics.last_attribution() or {}).get("mfu")
+        drift_events = len(drift_detector().events())
+        t_off = run(False, step_s, steps)
+        # Hook-only delta at 20x the iterations: isolates close_step +
+        # detector cost from sleep-granularity noise.
+        hooks_on = run(True, 0, steps * 20, fire_guard=True)
+        hooks_off = run(False, 0, steps * 20, fire_guard=True)
+    finally:
+        set_attr_enabled(None)  # back to the env knob
+        if guard_prev is None:
+            os.environ.pop("HVD_TPU_PERF_DRIFT_MIN_PCT", None)
+        else:
+            os.environ["HVD_TPU_PERF_DRIFT_MIN_PCT"] = guard_prev
+        reset_drift_detector()
+    sps_on = steps / t_on
+    sps_off = steps / t_off
+    hook_us = max(hooks_on - hooks_off, 0.0) / (steps * 20) * 1e6
+    # The acceptance figure: observatory hook seconds as % of the step.
+    # Measured from the 20x bare-hooks delta, NOT the sleeping arms'
+    # steps/sec ratio — two ~1.5s sleep loops differ by O(1%) from
+    # scheduler jitter alone, which would drown a 30 us/step signal.
+    overhead_pct = hook_us / (step_ms * 1e3) * 100.0
+    _emit({
+        "metric": "attribution_observatory_overhead",
+        "value": round(overhead_pct, 3),
+        "unit": f"% of a {step_ms:g}ms step spent in the observatory "
+                f"hooks ({n_coll} collectives + data-wait + "
+                "compute_span + step_end, attribution+drift on vs off)",
+        # Baseline = the same step with the observatory disabled.
+        "vs_baseline": round(sps_on / sps_off, 4),
+        "steps_per_sec_observed": round(sps_on, 2),
+        "steps_per_sec_bare": round(sps_off, 2),
+        "hook_cost_us_per_step": round(hook_us, 2),
+        "bar_pct": 1.0,
+        "within_bar": bool(overhead_pct < 1.0),
+        "mfu": None if mfu is None else round(mfu, 4),
+        "peak_tflops": float(os.environ["HVD_TPU_PEAK_TFLOPS"]),
+        "component_shares": {k: round(v, 4)
+                             for k, v in sorted(shares.items())},
+        # From the timed steady arm: a drift here would mean the
+        # detector false-fires on a stationary workload.
+        "drift_events": drift_events,
+        "steps": steps,
+    })
+
+
 def bench_recovery():
     """Peer-to-peer hot recovery: (a) restore latency of the SAME
     committed ZeRO state through the in-memory replica tier vs the disk
@@ -2358,6 +2447,8 @@ def main():
         return bench_data()  # host-only; never touches the accelerator
     if mode == "metrics_overhead":
         return bench_metrics_overhead()  # host-only
+    if mode == "attribution":
+        return bench_attribution()  # host-only
     if mode == "compression":
         return bench_compression()  # CPU mesh; never touches the chip
     if mode == "overlap":

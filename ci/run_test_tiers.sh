@@ -48,7 +48,12 @@ TIER_FAST=(
   test_net_resilience.py
   test_optimizers.py
   test_overlap.py
-  test_parallel.py test_probe_rendezvous.py
+  test_parallel.py
+  # Perf-observatory drill: injected input slowdown must fire the drift
+  # detector with data-component attribution; steady runs stay silent
+  # (`bench.py --bench attribution` prices the hooks for the trajectory).
+  test_perf_observatory.py
+  test_probe_rendezvous.py
   test_quantization.py
   test_recovery.py
   test_resnet.py test_response_cache.py test_timeline.py

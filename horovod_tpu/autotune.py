@@ -380,6 +380,22 @@ class ParameterManager:
         self._m_decisions.inc()
         self._m_fusion.set(self._current[0])
         self._m_cycle.set(self._current[1])
+        # Flight event: autotune decisions were metrics-only, invisible
+        # to the drift diagnoser — a regression that starts right after
+        # a parameter application should name the tuner as the suspect
+        # (debug/regression.py correlates perf.drift onsets against
+        # these).
+        from .debug import flight as _flight
+        _flight.record(
+            "autotune.decision", None,
+            fusion_bytes=int(self._current[0]),
+            cycle_ms=round(float(self._current[1]), 3),
+            hierarchical_allreduce=bool(self._current[2]),
+            hierarchical_allgather=bool(self._current[3]),
+            cache_enabled=bool(self._current[4]),
+            compression=self._current[5],
+            overlap_bucket_bytes=int(self._current[6]),
+            frozen=self._frozen)
 
     def record_bytes(self, nbytes: int):
         """Feed data-plane traffic; closes a window when enough time passed

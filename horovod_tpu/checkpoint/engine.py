@@ -18,9 +18,11 @@ self-describing so richer backends can be layered on.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import shutil
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -30,6 +32,33 @@ from . import manifest as M
 from . import reshard as R
 
 _ckpt_metrics = None
+
+# Foreground/background discrimination for the step attribution: the
+# async committer's flushes run on a daemon thread and overlap training,
+# so their wall time must NOT land in the blocking-seconds counter the
+# per-step decomposition reads (metrics/attribution.py) — it would be
+# charged to a step that never waited for it.
+_io_context = threading.local()
+
+
+@contextlib.contextmanager
+def background_io():
+    """Mark this thread's engine calls as background (async commit):
+    save/restore durations still feed the ``hvd_checkpoint_*_seconds``
+    histograms, but are excluded from
+    ``hvd_checkpoint_blocking_seconds_total``."""
+    prev = getattr(_io_context, "background", False)
+    _io_context.background = True
+    try:
+        yield
+    finally:
+        _io_context.background = prev
+
+
+def _record_io_seconds(hist, seconds: float) -> None:
+    hist.observe(seconds)
+    if not getattr(_io_context, "background", False):
+        _metrics()[6].inc(max(seconds, 0.0))
 
 
 def _metrics():
@@ -52,6 +81,10 @@ def _metrics():
                           "save_leaves wall time", buckets=buckets),
             reg.histogram("hvd_checkpoint_restore_seconds",
                           "restore_leaves wall time", buckets=buckets),
+            reg.counter("hvd_checkpoint_blocking_seconds_total",
+                        "Save/restore wall seconds paid on the calling "
+                        "thread (async-committer flushes excluded) — "
+                        "the step attribution's checkpoint component"),
         )
     return _ckpt_metrics
 
@@ -253,7 +286,7 @@ def save_leaves(root: str, step: int, specs: List[M.LeafSpec],
         commit(root, step, manifest)
     m = _metrics()
     m[2].inc()
-    m[4].observe(time.perf_counter() - t0)
+    _record_io_seconds(m[4], time.perf_counter() - t0)
     return manifest
 
 
@@ -271,7 +304,7 @@ def restore_leaves(root: str, step: int,
               for r in range(manifest.world_size)]
     m = _metrics()
     m[3].inc()
-    m[5].observe(time.perf_counter() - t0)
+    _record_io_seconds(m[5], time.perf_counter() - t0)
     return RestoredStep(manifest, shards, new_world_size)
 
 

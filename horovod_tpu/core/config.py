@@ -51,6 +51,17 @@ METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
 METRICS_STRAGGLER_FACTOR = "METRICS_STRAGGLER_FACTOR"
 METRICS_STRAGGLER_MIN_SECONDS = "METRICS_STRAGGLER_MIN_SECONDS"
 METRICS_STRAGGLER_PATIENCE = "METRICS_STRAGGLER_PATIENCE"
+# Performance observatory (horovod_tpu/metrics/attribution.py +
+# baseline.py): per-step time attribution, live MFU, drift detection.
+ATTRIBUTION = "ATTRIBUTION"                    # per-step attribution on/off
+ATTRIBUTION_JSONL = "ATTRIBUTION_JSONL"        # per-step JSONL sink path
+PEAK_TFLOPS = "PEAK_TFLOPS"                    # calibrated chip peak; 0 = spec
+PERF_DRIFT = "PERF_DRIFT"                      # drift detector on/off
+PERF_DRIFT_WARMUP = "PERF_DRIFT_WARMUP"        # baseline steps before arming
+PERF_DRIFT_THRESHOLD = "PERF_DRIFT_THRESHOLD"  # CUSUM trip level (sigmas)
+PERF_DRIFT_MIN_PCT = "PERF_DRIFT_MIN_PCT"      # min % slowdown to fire
+PERF_DRIFT_COOLDOWN = "PERF_DRIFT_COOLDOWN"    # steps muted after a fire
+PERF_DRIFT_LOOKBACK_S = "PERF_DRIFT_LOOKBACK_S"  # event-correlation window
 # Flight recorder / hang diagnosis (horovod_tpu/debug/).
 FLIGHT_DISABLE = "FLIGHT_DISABLE"              # recorder off entirely
 FLIGHT_CAPACITY = "FLIGHT_CAPACITY"            # ring-buffer events
@@ -70,6 +81,7 @@ CHAOS_KILL_STEPS = "CHAOS_KILL_STEPS"          # "rank@step,..." kill schedule
 CHAOS_COMMIT_CRASH = "CHAOS_COMMIT_CRASH"      # "<point>[@step]" crash point
 CHAOS_SLOW_PEER_MS = "CHAOS_SLOW_PEER_MS"      # peer-serving latency injection
 CHAOS_TORN_RANKS = "CHAOS_TORN_RANKS"          # corrupt these ranks' replicas
+CHAOS_INPUT_DELAY_MS = "CHAOS_INPUT_DELAY_MS"  # input-pipeline slowdown drill
 # Self-healing wire fabric (horovod_tpu/net/ + native/src/net.cc).  The
 # native knobs are parsed in C (net.cc NetResilience/NetChaos); they are
 # listed here so the knob table has one home and launch.py exports them.
@@ -190,6 +202,23 @@ class Config:
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
     metrics_port: int = 0
+    # Performance observatory: step_end() closes a per-step attribution
+    # record (compute / exposed comm / hidden comm / input / checkpoint /
+    # host gap) and feeds the EWMA/CUSUM drift detector; both default on
+    # (the per-step cost is a handful of cached metric reads — bench.py
+    # --bench attribution pins it under the 1% bar).  peak_tflops grades
+    # hvd_mfu_ratio: 0 = the chip's spec-sheet peak by device kind; set
+    # it to a CALIBRATED ceiling instead (round-5 silicon measured 171
+    # TFLOP/s steady matmul on the 197-peak v5e — docs/mfu_readiness.md).
+    attribution: bool = True
+    attribution_jsonl: str = ""
+    peak_tflops: float = 0.0
+    perf_drift: bool = True
+    perf_drift_warmup: int = 30
+    perf_drift_threshold: float = 8.0
+    perf_drift_min_pct: float = 10.0
+    perf_drift_cooldown: int = 50
+    perf_drift_lookback_s: float = 120.0
     # Flight recorder: always-on ring buffer (cost is unmeasurable —
     # bench.py --bench flight_overhead pins it under 1%); the stall →
     # hang-report escalation runs wherever the native controller does.
@@ -289,6 +318,21 @@ class Config:
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)
+        cfg.attribution = get_bool(ATTRIBUTION, cfg.attribution)
+        cfg.attribution_jsonl = get_env(
+            ATTRIBUTION_JSONL, cfg.attribution_jsonl) or ""
+        cfg.peak_tflops = max(0.0, get_float(PEAK_TFLOPS, cfg.peak_tflops))
+        cfg.perf_drift = get_bool(PERF_DRIFT, cfg.perf_drift)
+        cfg.perf_drift_warmup = max(
+            1, get_int(PERF_DRIFT_WARMUP, cfg.perf_drift_warmup))
+        cfg.perf_drift_threshold = max(0.5, get_float(
+            PERF_DRIFT_THRESHOLD, cfg.perf_drift_threshold))
+        cfg.perf_drift_min_pct = max(0.0, get_float(
+            PERF_DRIFT_MIN_PCT, cfg.perf_drift_min_pct))
+        cfg.perf_drift_cooldown = max(
+            0, get_int(PERF_DRIFT_COOLDOWN, cfg.perf_drift_cooldown))
+        cfg.perf_drift_lookback_s = max(1.0, get_float(
+            PERF_DRIFT_LOOKBACK_S, cfg.perf_drift_lookback_s))
         cfg.flight_disable = get_bool(FLIGHT_DISABLE, cfg.flight_disable)
         cfg.flight_capacity = max(
             1, get_int(FLIGHT_CAPACITY, cfg.flight_capacity))

@@ -43,6 +43,18 @@ _END = "end"
 _ERROR = "error"
 
 
+def _chaos_input_delay_s() -> float:
+    """Deterministic input-pipeline slowdown injection
+    (``HVD_TPU_CHAOS_INPUT_DELAY_MS``, read at iterator construction
+    like the recovery chaos knobs): every batch pays this extra host
+    latency in the producer (prefetch) / inside the wait span (inline).
+    The perf-observatory drill (ci/run_test_tiers.sh,
+    tests/test_perf_observatory.py) uses it to prove the drift detector
+    attributes an input-pipeline regression to the data component."""
+    from ..core.config import get_float
+    return max(0.0, get_float("CHAOS_INPUT_DELAY_MS", 0.0)) / 1e3
+
+
 class InlineIterator:
     """The prefetch-off twin: same interface, no thread.
 
@@ -63,7 +75,11 @@ class InlineIterator:
         self._last_state: Any = None
         self._finished = False
         self._closed = False
+        self._chaos_delay_s = _chaos_input_delay_s()
         self.consumed = 0
+        if self._chaos_delay_s:
+            _flight.record("data.chaos_delay", "inline",
+                           delay_ms=self._chaos_delay_s * 1e3)
 
     def __iter__(self):
         return self
@@ -78,6 +94,8 @@ class InlineIterator:
             # refuses identically).
             raise RuntimeError("inline data iterator is closed")
         with profiler.data_wait():
+            if self._chaos_delay_s:
+                time.sleep(self._chaos_delay_s)
             try:
                 item = next(self._it)
             except StopIteration:
@@ -125,8 +143,12 @@ class PrefetchIterator:
         self._closed = False
         self._finished = False
         self._last_state: Any = None
+        self._chaos_delay_s = _chaos_input_delay_s()
         self.consumed = 0
         self.max_queued = 0  # high-water mark, for overlap diagnostics
+        if self._chaos_delay_s:
+            _flight.record("data.chaos_delay", name,
+                           delay_ms=self._chaos_delay_s * 1e3)
         self._thread = threading.Thread(
             target=self._produce, name=f"hvd-tpu-{name}", daemon=True)
         self._thread.start()
@@ -146,6 +168,8 @@ class PrefetchIterator:
     def _produce(self) -> None:
         try:
             for item in self._it:
+                if self._chaos_delay_s:
+                    time.sleep(self._chaos_delay_s)
                 state = self._state_fn() \
                     if self._state_fn is not None else None
                 if self._transfer is not None:

@@ -16,6 +16,13 @@ The diagnosis half of observability (``hvd.metrics`` is the live half):
 * :mod:`~horovod_tpu.debug.merge` — ``python -m horovod_tpu.debug.merge``
   merges per-rank dumps (+ the native Chrome timeline) into one
   clock-aligned trace with a process row per rank.
+* :mod:`~horovod_tpu.debug.regression` — drift-triggered regression
+  diagnosis: when the metrics plane's drift detector confirms a
+  sustained step-time regression, ``perf_regression_step<N>.json``
+  correlates the onset against the flight-recorded causal event stream
+  (autotune decisions, elastic rounds, fleet preemptions, net recovery)
+  and names the suspect subsystem.  Read the latest via
+  :func:`last_regression_report`.
 
 See docs/debugging.md for the worked hang-triage example.
 """
@@ -55,9 +62,24 @@ def stop_stall_watchdog():
     _hang.stop_stall_watchdog()
 
 
+def last_regression_report():
+    """The most recent drift-triggered regression report (None before
+    the first confirmed drift)."""
+    from . import regression as _regression
+    return _regression.last_report()
+
+
+def build_regression_report(event, **kwargs):
+    """Assemble a regression report for a DriftEvent (normally invoked
+    by the drift detector; exposed for tooling and tests)."""
+    from . import regression as _regression
+    return _regression.build_regression_report(event, **kwargs)
+
+
 __all__ = [
     "flight", "FlightRecorder", "record", "recorder", "snapshot", "dump",
     "set_enabled", "install_signal_handler", "estimate_clock_offset",
     "serve", "serve_and_publish", "stop_serving",
     "start_stall_watchdog", "stop_stall_watchdog",
+    "last_regression_report", "build_regression_report",
 ]

@@ -212,7 +212,13 @@ class _AsyncCommitter:
 
         def _run():
             try:
-                fn()
+                # Background marker: the flush's engine I/O must not be
+                # charged to the training step's checkpoint component
+                # (metrics/attribution.py) — it overlaps compute by
+                # design.
+                from ..checkpoint.engine import background_io
+                with background_io():
+                    fn()
             except BaseException as e:  # noqa: BLE001 — surfaced at wait()
                 self._exc = e
 
@@ -846,6 +852,16 @@ def run(func: Callable) -> Callable:
                 _elastic_counter("hvd_elastic_syncs_total",
                                  "Elastic state syncs").inc()
                 sync_gauge.set(_time.perf_counter() - t0)
+                # The sync's restore/broadcast work (peer or disk
+                # restore, state broadcast) happened BETWEEN runs: re-
+                # anchor the attribution marks now, after it, so those
+                # checkpoint/comm seconds are never charged to the
+                # first step of the new round (_reset's re-anchor runs
+                # before sync and cannot cover it).
+                from ..metrics.attribution import (
+                    attribution as _attr_engine, enabled as _attr_enabled)
+                if _attr_enabled():
+                    _attr_engine().reanchor()
                 try:
                     return func(state, *args, **kwargs)
                 except HorovodInternalError as e:

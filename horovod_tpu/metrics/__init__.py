@@ -20,6 +20,15 @@ elastic events.  Layers:
 * :mod:`.exporters` — Prometheus text-format at ``/metrics`` (served
   from the rendezvous HTTP scaffold; auto-started by ``init()`` when
   ``HVD_TPU_METRICS_PORT`` is set) and a rotating JSONL sink.
+* :mod:`.attribution` — the performance observatory's interpretation
+  layer: every ``step_end`` decomposes the step's wall time into
+  compute / exposed comm / hidden comm / input / checkpoint / host gap
+  (``hvd_step_attribution_seconds{component}``) and grades live MFU
+  (``set_step_flops`` → ``hvd_mfu_ratio`` vs ``HVD_TPU_PEAK_TFLOPS``).
+* :mod:`.baseline` — EWMA/CUSUM drift detection over step time and
+  component shares; a sustained regression emits a ``perf.drift``
+  flight event and a suspect-naming regression report
+  (``debug/regression.py``).  See ``docs/observability.md``.
 
 Instrumented out of the box: eager collectives (ops/bytes/latency per
 kind), the negotiated device plane (fusion batch size, response-
@@ -49,6 +58,19 @@ from .health import (
 from .exporters import (
     JsonlSink, MetricsServer, render_prometheus, serve, stop_serving,
 )
+# NB: the engine accessor `attribution()` is deliberately NOT
+# re-exported here — binding it onto the package would shadow the
+# `metrics.attribution` SUBMODULE (`import horovod_tpu.metrics.
+# attribution as am` would silently hand back the function).  Reach the
+# accessor via the submodule: `from horovod_tpu.metrics.attribution
+# import attribution`.
+from .attribution import (
+    COMPONENTS, WALL_COMPONENTS, StepAttribution, compute_span,
+    last_attribution, peak_flops, set_step_flops,
+)
+from .baseline import (
+    DriftDetector, DriftEvent, drift_detector, last_drift_event,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -59,4 +81,7 @@ __all__ = [
     "straggler_report",
     "JsonlSink", "MetricsServer", "render_prometheus", "serve",
     "stop_serving",
+    "COMPONENTS", "WALL_COMPONENTS", "StepAttribution", "compute_span",
+    "last_attribution", "peak_flops", "set_step_flops",
+    "DriftDetector", "DriftEvent", "drift_detector", "last_drift_event",
 ]

@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import attribution as _attr
+from . import baseline as _baseline
 from .health import detector as _detector
 from .registry import registry as _registry
 
@@ -79,22 +81,50 @@ class Aggregator:
         self._last_step_ts: Optional[float] = None
         self._fleet: Optional[List[dict]] = None
         self._fleet_step = -1
+        # Idempotency latch: the last explicitly-indexed step_end(step=)
+        # absorbed.  A user loop and an elastic commit hook both closing
+        # the same step index must count it once (double-counting halves
+        # every derived step time and desyncs the sync cadence).
+        self._last_explicit_step: Optional[int] = None
 
     # -- per-step hook -----------------------------------------------------
 
-    def step_end(self, step_time_s: Optional[float] = None) -> None:
+    def step_end(self, step_time_s: Optional[float] = None,
+                 step: Optional[int] = None) -> None:
         """Record one training step.  ``step_time_s`` omitted → derived
         from the wall clock between consecutive calls (first call only
         counts the step, it has no interval yet).  Runs a cross-rank
-        sync when the cadence divides the step index."""
+        sync when the cadence divides the step index.
+
+        ``step`` (optional) is the caller's own step index, making the
+        call IDEMPOTENT per index: a repeat close of the same index
+        (user loop + an elastic-commit hook firing in the same step) is
+        absorbed, so step counting, the derived wall interval, the
+        attribution window and the sync cadence each see the step once.
+        Closing the step also drives the performance observatory: the
+        per-step attribution record (metrics/attribution.py) and the
+        drift detector (metrics/baseline.py), unless disabled."""
         now = time.perf_counter()
         reg = _registry()
         with self._lock:
+            if step is not None:
+                s = int(step)
+                if self._last_explicit_step is not None and \
+                        s <= self._last_explicit_step:
+                    # Duplicate close of an already-counted index —
+                    # including a LAGGING one (a hook closing step N
+                    # after the loop already closed N+1 would otherwise
+                    # count a phantom near-zero step into the histogram
+                    # and the drift baseline).  Explicit indices only
+                    # move forward within a run; reset() clears the
+                    # latch for the next run.
+                    return
+                self._last_explicit_step = s
             if step_time_s is None and self._last_step_ts is not None:
                 step_time_s = now - self._last_step_ts
             self._last_step_ts = now
             self._step += 1
-            step = self._step
+            cur_step = self._step
             if step_time_s is not None:
                 self._step_sum += step_time_s
                 self._step_count += 1
@@ -103,8 +133,15 @@ class Aggregator:
             reg.histogram("hvd_step_time_seconds",
                           "Training step wall time",
                           buckets=_STEP_TIME_BUCKETS).observe(step_time_s)
+            if _attr.enabled():
+                record = _attr.attribution().close_step(
+                    step if step is not None else cur_step, step_time_s)
+                if record is not None and _baseline.drift_enabled():
+                    _baseline.drift_detector().update(
+                        record["step"], step_time_s,
+                        shares=record.get("shares"))
         cadence = _sync_cadence()
-        if cadence > 0 and step % cadence == 0:
+        if cadence > 0 and cur_step % cadence == 0:
             self.sync()
 
     # -- cross-rank sync ---------------------------------------------------
@@ -131,6 +168,11 @@ class Aggregator:
                 "data_wait_sum": dw_sum,
                 "data_wait_count": dw_count,
             }
+        if _attr.enabled():
+            # Windowed per-component seconds + declared FLOPs: the
+            # straggler detector attributes a flagged rank BY COMPONENT
+            # from these (health.py), and sync() grades fleet-wide MFU.
+            snap["attr"] = _attr.attribution().window_components()
         snap["scalars"] = _registry().scalars()
         return snap
 
@@ -142,6 +184,8 @@ class Aggregator:
             self._mark_wait_sum = wait_sum
             self._mark_wait_count = wait_count
             self._mark_wait_gen = wait_gen
+        if _attr.enabled():
+            _attr.attribution().advance_window()
 
     def sync(self) -> List[dict]:
         """Allgather every rank's snapshot; evaluate rank health.  A
@@ -166,6 +210,7 @@ class Aggregator:
         _detector().evaluate(
             gathered, warn=global_state.process_rank == 0)
         reg = _registry()
+        self._fleet_mfu_gauges(gathered, reg)
         reg.counter("hvd_metrics_syncs_total",
                     "Cross-rank metric aggregations").inc()
         reg.gauge("hvd_metrics_sync_seconds",
@@ -176,6 +221,36 @@ class Aggregator:
             self._fleet = gathered
             self._fleet_step = snap["step"]
         return gathered
+
+    @staticmethod
+    def _fleet_mfu_gauges(gathered: List[dict], reg) -> None:
+        """Cross-rank MFU: per-rank windowed ``flops_sum / step_time``
+        against the chip peak → fleet min/mean gauges, so one
+        low-utilization rank is visible without scraping every rank."""
+        peak = _attr.peak_flops()
+        if not peak:
+            return
+        ratios = []
+        for snap in gathered:
+            attr = snap.get("attr") or {}
+            # The attribution window's own wall-time sum: flops
+            # accumulate only on record-producing closes (the anchoring
+            # close and reset-skipped steps contribute neither), so
+            # dividing by the aggregate step_time_sum — which counts
+            # every timed step — would bias MFU low after every
+            # reanchor.  Older snapshots without "wall" fall back.
+            flops = attr.get("flops", 0.0)
+            t = attr.get("wall", 0.0) or snap.get("step_time_sum", 0.0)
+            if flops > 0 and t > 0:
+                ratios.append(flops / (t * peak))
+        if not ratios:
+            return
+        reg.gauge("hvd_mfu_fleet_min",
+                  "Lowest per-rank MFU in the last aggregation window"
+                  ).set(min(ratios))
+        reg.gauge("hvd_mfu_fleet_mean",
+                  "Mean per-rank MFU in the last aggregation window"
+                  ).set(sum(ratios) / len(ratios))
 
     # -- read side ---------------------------------------------------------
 
@@ -208,6 +283,16 @@ class Aggregator:
             self._last_step_ts = None
             self._fleet = None
             self._fleet_step = -1
+            self._last_explicit_step = None
+        if _attr.enabled():
+            # Re-anchor the attribution marks at the counters' current
+            # values (the elastic run() loop re-anchors AGAIN after the
+            # post-reset state.sync(), which is what keeps restore work
+            # done between runs off the first post-reset step).  The
+            # drift detector deliberately survives the reset —
+            # "steps/sec regressed after an elastic round" is exactly
+            # the drift it exists to catch.
+            _attr.attribution().reanchor()
 
 
 _aggregator: Optional[Aggregator] = None
@@ -222,10 +307,12 @@ def aggregator() -> Aggregator:
         return _aggregator
 
 
-def step_end(step_time_s: Optional[float] = None) -> None:
+def step_end(step_time_s: Optional[float] = None,
+             step: Optional[int] = None) -> None:
     """Module-level convenience: ``hvd.metrics.step_end()`` once per
-    training step."""
-    aggregator().step_end(step_time_s)
+    training step.  Pass ``step=`` (your loop's own index) to make
+    duplicate closes of the same step idempotent."""
+    aggregator().step_end(step_time_s, step=step)
 
 
 def sync() -> List[dict]:

@@ -57,15 +57,18 @@ def _fmt_labels(labels, extra=None) -> str:
 
 def render_prometheus(reg: Optional[MetricsRegistry] = None) -> str:
     """The registry as Prometheus text exposition (name-sorted, series
-    label-sorted — deterministic, so goldens can compare exactly)."""
+    label-sorted — deterministic, so goldens can compare exactly).
+    Iterates a ``collect()`` snapshot, never the live children dicts:
+    a scrape races instrument creation (new label children appear from
+    the native background thread mid-render) and a live dict iteration
+    would raise mid-response."""
     reg = reg or _default_registry()
     lines = []
-    for fam in reg.families():
+    for fam, children in reg.collect():
         if fam.help:
             lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
-        for key in sorted(fam.children):
-            child = fam.children[key]
+        for key, child in children:
             if fam.kind == "histogram":
                 cum = child.cumulative_counts()
                 for bound, c in zip(child.buckets, cum):

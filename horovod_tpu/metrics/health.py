@@ -37,6 +37,7 @@ Every evaluation also:
 from __future__ import annotations
 
 import dataclasses
+import statistics
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -62,8 +63,41 @@ class RankHealth:
     data_wait_mean: float        # seconds, windowed
     score: float                 # step_time_mean / fleet median
     flagged: bool
-    cause: str                   # "input" | "compute" | "" (healthy)
+    cause: str                   # component name | "input" | "compute" | ""
     steps: int                   # window sample count
+    # Per-step component means (attribution window, when the rank's
+    # snapshot carried one) — the by-component straggler evidence.
+    components: Optional[Dict[str, float]] = None
+
+
+# Wall components a straggler can be attributed to (comm_hidden is
+# informational overlapped wire time and never *costs* a step) —
+# single-homed in attribution.py with the drift detector's list.
+from .attribution import WALL_COMPONENTS as _CAUSE_COMPONENTS
+
+
+def _component_means(entry: dict) -> Optional[Dict[str, float]]:
+    """Per-step component means from a snapshot's windowed ``attr``
+    sums, or None when the snapshot predates (or disabled) the
+    attribution plane."""
+    attr = entry.get("attr")
+    if not attr:
+        return None
+    steps = float(attr.get("steps", 0.0))
+    if steps <= 0:
+        return None
+    return {k: float(attr.get(k, 0.0)) / steps for k in _CAUSE_COMPONENTS}
+
+
+def _fleet_component_medians(
+        per_rank: Sequence[Dict[str, float]]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k in _CAUSE_COMPONENTS:
+        vals = [c.get(k, 0.0) for c in per_rank]
+        if not vals:
+            continue
+        out[k] = statistics.median(vals)
+    return out
 
 
 class StragglerDetector:
@@ -85,36 +119,63 @@ class StragglerDetector:
     def score_ranks(self, per_rank: Sequence[dict]) -> List[RankHealth]:
         """Score windowed per-rank stats.  ``per_rank`` entries:
         ``{"rank", "step_time_sum", "step_count", "data_wait_sum"[,
-        "data_wait_count"]}`` (the aggregate wire shape).  Ranks with an
-        empty window score 1.0 and are never flagged (no evidence)."""
+        "data_wait_count", "attr"]}`` (the aggregate wire shape).  Ranks
+        with an empty window score 1.0 and are never flagged (no
+        evidence).
+
+        Cause attribution prefers the attribution plane: when snapshots
+        carry per-component window sums (``attr``,
+        metrics/attribution.py), a flagged rank's cause is the wall
+        component with the largest excess over the fleet's median
+        per-component mean — "rank 3 is 2.1x slower and it's the
+        checkpoint component", not just "slower".  Snapshots without
+        ``attr`` fall back to the original data-wait heuristic."""
         stats = []
         for entry in per_rank:
             n = int(entry.get("step_count", 0))
             mean = (float(entry.get("step_time_sum", 0.0)) / n) if n else 0.0
             wait = (float(entry.get("data_wait_sum", 0.0)) / n) if n else 0.0
-            stats.append((int(entry["rank"]), mean, wait, n))
-        with_data = sorted(m for _, m, _, n in stats if n > 0)
+            stats.append((int(entry["rank"]), mean, wait, n,
+                          _component_means(entry)))
+        with_data = [m for _, m, _, n, _c in stats if n > 0]
         if not with_data:
-            return [RankHealth(r, m, w, 1.0, False, "", n)
-                    for r, m, w, n in stats]
-        k = len(with_data)
-        median = (with_data[k // 2] if k % 2 else
-                  0.5 * (with_data[k // 2 - 1] + with_data[k // 2]))
+            return [RankHealth(r, m, w, 1.0, False, "", n, c)
+                    for r, m, w, n, c in stats]
+        median = statistics.median(with_data)
+        comp_medians = _fleet_component_medians(
+            [c for _, _, _, n, c in stats if n > 0 and c])
         out = []
-        for r, mean, wait, n in stats:
+        for r, mean, wait, n, comps in stats:
             if n == 0 or median <= 0.0:
-                out.append(RankHealth(r, mean, wait, 1.0, False, "", n))
+                out.append(RankHealth(r, mean, wait, 1.0, False, "", n,
+                                      comps))
                 continue
             score = mean / median
             excess = mean - median
             flagged = score >= self.factor and excess >= self.min_seconds
             cause = ""
             if flagged:
-                # Input-bound when the rank's data-wait covers most of
-                # what it is slower by; otherwise compute/comm-bound.
-                cause = "input" if wait >= 0.5 * excess else "compute"
-            out.append(RankHealth(r, mean, wait, score, flagged, cause, n))
+                cause = self._attribute_cause(comps, comp_medians,
+                                              wait, excess)
+            out.append(RankHealth(r, mean, wait, score, flagged, cause, n,
+                                  comps))
         return out
+
+    @staticmethod
+    def _attribute_cause(comps: Optional[Dict[str, float]],
+                         comp_medians: Dict[str, float],
+                         wait: float, excess: float) -> str:
+        if comps:
+            best, best_excess = None, 0.0
+            for name, mean in comps.items():
+                ce = mean - comp_medians.get(name, 0.0)
+                if ce > best_excess:
+                    best, best_excess = name, ce
+            if best is not None and best_excess >= 0.25 * excess:
+                return best
+        # Attribution absent (or no single component explains the
+        # slowdown): the original input-vs-compute split.
+        return "input" if wait >= 0.5 * excess else "compute"
 
     # -- stateful evaluation ----------------------------------------------
 

@@ -279,18 +279,45 @@ class MetricsRegistry:
         return self._get(name, "histogram", help, buckets, labels)
 
     def families(self) -> List[_Family]:
-        """Stable (name-sorted) view for exporters."""
+        """Stable (name-sorted) view for exporters.  The family objects
+        are LIVE — iterate their ``children`` dicts via :meth:`collect`
+        instead, or a concurrent instrument creation (``_get`` inserting
+        a child mid-scrape) raises ``RuntimeError: dictionary changed
+        size during iteration``."""
         with self._lock:
             return [self._families[n] for n in sorted(self._families)]
+
+    def collect(self) -> List[Tuple[_Family, List[Tuple[tuple, object]]]]:
+        """Point-in-time ``[(family, [(label_key, child), ...])]`` with
+        every children list copied UNDER the registry lock — the one
+        safe way to iterate series while other threads create
+        instruments (exporters scrape from HTTP threads; collectives
+        register children from the native background thread).  The child
+        objects themselves are thread-safe to read."""
+        with self._lock:
+            return [(fam, sorted(fam.children.items()))
+                    for fam in (self._families[n]
+                                for n in sorted(self._families))]
+
+    def children_of(self, name: str) -> List[object]:
+        """Read-only: the live children of family ``name`` (label-key
+        order), or ``[]`` when the family does not exist yet.  Never
+        creates the family — callers that must not pre-empt another
+        subsystem's registration (e.g. histogram bucket choices) read
+        through this."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return []
+            return [fam.children[k] for k in sorted(fam.children)]
 
     def snapshot(self) -> Dict[str, dict]:
         """Full point-in-time read: {name: {kind, help, series: [...]}}.
         Histogram series carry cumulative bucket counts + sum + count."""
         out: Dict[str, dict] = {}
-        for fam in self.families():
+        for fam, children in self.collect():
             series = []
-            for key in sorted(fam.children):
-                child = fam.children[key]
+            for key, child in children:
                 entry: dict = {"labels": dict(key)}
                 if fam.kind == "histogram":
                     entry["buckets"] = list(child.buckets)
@@ -309,8 +336,8 @@ class MetricsRegistry:
         ``name_sum``/``name_count``) — the cross-rank snapshot wire
         format.  Keys: ``name`` or ``name{k=v,...}``."""
         out: Dict[str, float] = {}
-        for fam in self.families():
-            for key, child in sorted(fam.children.items()):
+        for fam, children in self.collect():
+            for key, child in children:
                 suffix = "" if not key else \
                     "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
                 if fam.kind == "histogram":
@@ -323,8 +350,8 @@ class MetricsRegistry:
     def reset(self) -> None:
         """Zero every metric (families and children stay registered —
         cached child references at call sites remain valid)."""
-        for fam in self.families():
-            for child in fam.children.values():
+        for _fam, children in self.collect():
+            for _key, child in children:
                 child.reset()
 
 

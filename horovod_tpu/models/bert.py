@@ -14,7 +14,7 @@ sequences.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -354,3 +354,25 @@ def synthetic_mlm_batch(key, cfg: BertConfig, batch: int,
                               inplace=False)
     inputs = jnp.where(mask, 0, tokens)
     return inputs, positions, labels
+
+
+def train_flops_per_seq(cfg: BertConfig, n_pred: Optional[int] = None
+                        ) -> float:
+    """Exact matmul-FLOPs accounting for one BERT MLM training sequence
+    (train = 3x fwd) — the bench's audited accounting, importable so
+    training loops can feed ``hvd.metrics.set_step_flops()`` with the
+    same figure MFU reports use.
+
+    Encoder: per token per layer qkv 6d^2 + proj 2d^2 + mlp 4*d*ff;
+    attention 4*S^2*d per layer per seq (scores + AV).  MLM head: the
+    transform (2d^2) and tied-vocab projection (2dV) run per predicted
+    position — S positions on the dense path, ``n_pred`` on the gathered
+    path (real-BERT max_predictions_per_seq semantics), so the gathered
+    step's reported MFU counts only the FLOPs it actually executes."""
+    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
+                      cfg.vocab_size)
+    enc = s * L * (8.0 * d * d + 4.0 * d * ff)
+    attn = L * 4.0 * s * s * d
+    pos = s if n_pred is None else n_pred
+    head = pos * (2.0 * d * d + 2.0 * d * v)
+    return 3.0 * (enc + attn + head)

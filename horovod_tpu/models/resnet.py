@@ -235,3 +235,22 @@ def synthetic_batch(key, batch: int, image_size: int = 224,
     labels = jax.random.randint(kl, (batch,), 0, num_classes,
                                 dtype=jnp.int32)
     return images, labels
+
+
+# fwd GFLOP/img @224x224, width 64 (standard torchvision counts) — the
+# bench's audited accounting, importable so training loops can feed
+# hvd.metrics.set_step_flops() with the same figure MFU reports use.
+_FWD_GFLOP_PER_IMG = {18: 1.82, 34: 3.68, 50: 4.09, 101: 7.83, 152: 11.53}
+
+
+def train_flops_per_image(cfg: ResNetConfig, image_size: int = 224) -> float:
+    """Model FLOPs ONE training image executes (fwd + bwd ~= 3x fwd),
+    scaled quadratically with image size and width from the standard
+    @224/width-64 counts.  The live-MFU input::
+
+        hvd.metrics.set_step_flops(
+            per_chip_batch * resnet.train_flops_per_image(cfg))
+    """
+    fwd = _FWD_GFLOP_PER_IMG.get(cfg.depth, 4.09) * 1e9
+    fwd *= (image_size / 224.0) ** 2 * (cfg.width / 64.0) ** 2
+    return 3.0 * fwd

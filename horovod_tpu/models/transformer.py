@@ -352,3 +352,18 @@ def synthetic_batch(key, cfg: TransformerConfig, batch: int):
                                 dtype=jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
     return tokens, labels
+
+
+def train_flops_per_seq(cfg: TransformerConfig) -> float:
+    """Matmul-FLOPs for one causal-LM training sequence (train = 3x
+    fwd) — the bench's audited accounting, importable so training loops
+    can feed ``hvd.metrics.set_step_flops()`` with the same figure MFU
+    reports use.  Dense per token 8d^2 (qkv+proj) + 4*d*ff (mlp) per
+    layer + 2dV vocab head; causal attention 2*S^2*d per layer per seq
+    (half the bidirectional 4*S^2*d — the mask zeroes the upper
+    triangle)."""
+    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
+                      cfg.vocab_size)
+    dense = s * (L * (8.0 * d * d + 4.0 * d * ff) + 2.0 * d * v)
+    attn = L * 2.0 * s * s * d
+    return 3.0 * (dense + attn)

@@ -49,6 +49,7 @@ def sync_native_metrics() -> Optional[Dict[str, int]]:
     from ..metrics.registry import registry as _registry
     reg = _registry()
     with _sync_lock:
+        ladder_new: Dict[str, int] = {}
         for field, metric in (
                 ("retries", "hvd_net_retries_total"),
                 ("reconnects", "hvd_net_reconnects_total"),
@@ -67,7 +68,17 @@ def sync_native_metrics() -> Optional[Dict[str, int]]:
                 elif field == "renegotiations":
                     _flight.record("net.renegotiate", None,
                                    total=cur, new=cur - prev)
+                elif field != "chaos_injected":
+                    # Rung-1 retries and resets-avoided were
+                    # metrics-only: fold new activity into one
+                    # net.recovery flight event so the drift diagnoser
+                    # (debug/regression.py) can correlate a step-time
+                    # regression onset against native ladder activity
+                    # that never escalated to a reconnect.
+                    ladder_new[field] = cur - prev
             _last_synced[field] = cur
+        if ladder_new:
+            _flight.record("net.recovery", None, **ladder_new)
         reg.gauge("hvd_net_recovering_now",
                   "Channels currently mid-recovery").set(
             float(counters.get("recovering_now", 0)))

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -80,6 +81,44 @@ def _collective_metrics(kind: str):
     return rec
 
 
+# Overlap-submission marker: the bucket queue's sync-fallback submits
+# (no controller / tracer input: allreduce_async degrades to the sync
+# allreduce inside _op_range) land in BOTH the latency histogram and
+# the queue's own exposed-seconds counter.  The native/device async
+# paths never touch the histogram, so the step attribution cannot just
+# subtract the full exposed total from the histogram delta — this scope
+# prices exactly the overlap-managed share that doubled into the
+# histogram (hvd_overlap_fallback_latency_seconds_total), and
+# metrics/attribution.py subtracts that.
+_overlap_submit = threading.local()
+_overlap_fallback_lat = None
+
+
+@contextlib.contextmanager
+def overlap_submit_scope():
+    """Mark this thread as inside the overlap scheduler's bucket
+    submission (ops/overlap.py EagerBucketQueue.launch)."""
+    prev = getattr(_overlap_submit, "active", False)
+    _overlap_submit.active = True
+    try:
+        yield
+    finally:
+        _overlap_submit.active = prev
+
+
+def _overlap_fallback_metric():
+    global _overlap_fallback_lat
+    if _overlap_fallback_lat is None:
+        from ..metrics.registry import registry
+        _overlap_fallback_lat = registry().counter(
+            "hvd_overlap_fallback_latency_seconds_total",
+            "Latency-histogram seconds recorded by overlap-submitted "
+            "sync-fallback collectives — the overlap share the step "
+            "attribution subtracts from the histogram delta so "
+            "overlap-managed wire time is counted once")
+    return _overlap_fallback_lat
+
+
 def _wire_sent_bytes(tensor, comp) -> Optional[int]:
     """Bytes the EAGER transport actually moves for ``tensor`` (None
     when unknown).  Cast compressors genuinely shrink the payload before
@@ -136,6 +175,8 @@ def _op_range(kind: str, name, tensor, comp=None):
                 ratio_g.set(nbytes / sent)
         dt = time.perf_counter() - t0
         lat.observe(dt)
+        if getattr(_overlap_submit, "active", False):
+            _overlap_fallback_metric().inc(dt)
         _flight.record("collective.done", name, op=kind, dur_s=dt)
 
 

@@ -102,7 +102,7 @@ def plan_buckets(leaves: Sequence, bucket_bytes: Optional[int] = None,
         buckets.append(tuple(cur))
     plan = BucketPlan(tuple(buckets), bb, len(leaves))
     if record and buckets:
-        _, hist, _ = _overlap_metrics()
+        hist = _overlap_metrics()[1]
         for idxs in buckets:
             hist.observe(float(sum(_leaf_nbytes(leaves[i]) for i in idxs)))
         _flight.record("overlap.plan", None, n_buckets=len(buckets),
@@ -208,6 +208,15 @@ def _overlap_metrics():
                       "with compute (1.0 = fully hidden; eager plane "
                       "measures per EagerBucketQueue.finish, the bench "
                       "records its native-plane wall-clock figure)"),
+            reg.counter("hvd_overlap_comm_exposed_seconds_total",
+                        "Wire seconds the caller PAID (submission + "
+                        "blocked collection) across EagerBucketQueue "
+                        "finishes — the step attribution's overlap-"
+                        "managed exposed-comm source"),
+            reg.counter("hvd_overlap_comm_hidden_seconds_total",
+                        "Wire seconds hidden behind caller compute "
+                        "(in-flight union minus exposed) across "
+                        "EagerBucketQueue finishes"),
         )
     return _metrics_rec
 
@@ -647,9 +656,15 @@ class EagerBucketQueue:
         # has not reached every rank yet cannot desync the controller's
         # name-based negotiation — bucket boundaries only change when
         # each name enters flight.
+        from . import collective as C
         t0 = time.perf_counter()
-        fins = [self._submit_one(x, f"{self._base}.{idxs[j]}")
-                for j, x in enumerate(leaves)]
+        # The scope marks sync-fallback submits so their histogram
+        # latency is separable from non-overlap collectives
+        # (hvd_overlap_fallback_latency_seconds_total — the step
+        # attribution subtracts exactly that share, never more).
+        with C.overlap_submit_scope():
+            fins = [self._submit_one(x, f"{self._base}.{idxs[j]}")
+                    for j, x in enumerate(leaves)]
         submit_s = time.perf_counter() - t0
         self._inflight[bucket] = (fins, submit_s, time.perf_counter())
         self._launch_order.append(bucket)
@@ -691,6 +706,12 @@ class EagerBucketQueue:
                 union += end - cursor
             cursor = end if cursor is None else max(cursor, end)
         if union > 0:
-            hidden = max(0.0, 1.0 - (submit_total + blocked) / union)
-            _overlap_metrics()[2].set(hidden)
+            exposed = submit_total + blocked
+            mets = _overlap_metrics()
+            mets[2].set(max(0.0, 1.0 - exposed / union))
+            # Seconds, not just the ratio: the per-step attribution
+            # (metrics/attribution.py) diffs these counters to split a
+            # step's comm into paid vs hidden wall time.
+            mets[3].inc(min(exposed, union))
+            mets[4].inc(max(union - exposed, 0.0))
         return out

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Set
 from . import exec as exec_mod
 from .hosts import HostInfo, SlotInfo, get_host_assignments, parse_hosts
 from .rendezvous import RendezvousServer
+from ..debug import flight as _flight
 
 # Exit status a preempted job reports from run(): distinct from worker
 # failure codes (and from ssh's 255) so a scheduler — the fleet gateway —
@@ -295,6 +296,16 @@ class ElasticDriver:
                 return True  # already at the requested shape
             self._metric("hvd_elastic_resize_requests_total",
                          "External resize requests (fleet scheduler)").inc()
+            # Flight event (was metrics-only): a scheduler-driven shrink
+            # is a preemption the drift diagnoser must see — a job that
+            # slows down right after losing slots should name the fleet
+            # layer, not read as an unexplained regression.  Grows land
+            # as elastic.resize (same correlation table).
+            shrinking = sum(new.values()) < sum(cur.values())
+            _flight.record(
+                "fleet.preempt" if shrinking else "elastic.resize", None,
+                mode="shrink" if shrinking else "grow", np=np,
+                reason=reason or None)
             if self._verbose:
                 print(f"[elastic] resize to {np} slots requested"
                       f"{' (' + reason + ')' if reason else ''}: "
@@ -333,6 +344,8 @@ class ElasticDriver:
             self._preempted = True
             self._metric("hvd_elastic_preemptions_total",
                          "Jobs suspended by an external preempt()").inc()
+            _flight.record("fleet.preempt", None, mode="suspend",
+                           reason=reason or None)
             if self._verbose:
                 print(f"[elastic] preempted"
                       f"{' (' + reason + ')' if reason else ''}; "

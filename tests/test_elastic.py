@@ -166,6 +166,7 @@ DEVICE_ELASTIC_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(300)
 def test_elastic_recovery_with_device_plane_engaged(tmp_path):
     """VERDICT r3 #3: kill a worker while negotiated DEVICE tensors are in

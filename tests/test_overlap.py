@@ -508,6 +508,33 @@ def test_eager_bucket_queue_metrics_and_hidden_gauge():
     assert 0.0 <= hidden_g.value < 0.5
 
 
+def test_overlap_fallback_latency_priced_only_inside_submit_scope():
+    """Sync-fallback bucket submits double into the latency histogram;
+    the submit-scope counter prices exactly that share so the step
+    attribution (metrics/attribution.py) can subtract it.  A plain
+    sync collective outside the scope must NOT grow the counter."""
+    hvd.init()
+    from horovod_tpu.metrics.registry import registry
+    from horovod_tpu.ops import collective as C
+    fb = registry().counter(
+        "hvd_overlap_fallback_latency_seconds_total", "")
+    before = fb.value
+    hvd.allreduce(np.ones((64,), np.float32))  # not overlap-managed
+    assert fb.value == before
+    leaves = [np.ones((256,), np.float32) for _ in range(2)]
+    plan = ov.plan_buckets(leaves, bucket_bytes=1 << 20)
+    q = ov.EagerBucketQueue(plan, op=hvd.Sum)
+    q.launch(0, leaves)
+    q.finish()
+    # No controller in this test: every submit took the sync fallback,
+    # so the fallback share grew (by the ops' histogram latency).
+    assert fb.value > before
+    # The scope is not sticky: later sync ops count as plain again.
+    after_queue = fb.value
+    hvd.allreduce(np.ones((64,), np.float32))
+    assert fb.value == after_queue
+
+
 def test_eager_bucket_queue_launch_arity_checked():
     plan = ov.plan_buckets([_Leaf(10), _Leaf(10)], bucket_bytes=1 << 20)
     q = ov.EagerBucketQueue(plan)
