@@ -41,6 +41,7 @@ TIER_FAST=(
   test_basics.py test_bert.py test_checkpoint_engine.py test_chips.py
   test_ci_tiers.py
   test_collectives.py test_data_pipeline.py test_debug_flight.py
+  test_dispatch.py
   test_flash_attention.py
   test_fleet.py
   test_launch_flags.py

@@ -180,6 +180,11 @@ class ParameterManager:
     # desync negotiation; bucket-SIZE flips are name-invariant.
     OVERLAP_CHOICES = (0, 2 << 20, 8 << 20, 32 << 20)
 
+    # Crossover-shift grid for dispatch mode (see ``dispatch_shifts``):
+    # the probe-seeded table is the warm start (shift 0); ±1 moves every
+    # crossover boundary of that op kind by one payload bucket.
+    SHIFT_CHOICES = (-1, 0, 1)
+
     def __init__(self, apply_fn, max_samples: int = 20,
                  window_seconds: float = 2.0,
                  log_file: Optional[str] = None, seed: int = 0,
@@ -192,7 +197,8 @@ class ParameterManager:
                  tune_compression: bool = False,
                  initial_overlap: int = 0,
                  tune_overlap: bool = False,
-                 overlap_choices=None):
+                 overlap_choices=None,
+                 dispatch_shifts: bool = False):
         """apply_fn(fusion_bytes: int, cycle_ms: float, hierarchical_
         allreduce: bool, hierarchical_allgather: bool, cache_enabled:
         bool, compression: str, overlap_bucket_bytes: int) applies
@@ -218,9 +224,24 @@ class ParameterManager:
         each choice (overlap OFF against each bucket size, when 0 is in
         the grid) before EI takes over, and an explicitly-configured
         size (HVD_TPU_OVERLAP_BUCKET_BYTES, or any off-grid value) pins
-        the dimension."""
+        the dimension.
+
+        ``dispatch_shifts``: once a topology-probed dispatch table is
+        installed (ops/dispatch.py), the two hierarchical dims stop
+        being blind whole-range booleans and become bounded crossover
+        SHIFTS in {-1, 0, +1} over that table — the probe result is the
+        warm start, the GP only refines where the flat/hier boundary
+        sits.  ``initial_toggles[0:2]`` are then initial shifts (ints)
+        and apply_fn receives shift ints in those positions."""
         self._apply = apply_fn
-        init_toggles = tuple(bool(t) for t in initial_toggles)
+        self._dispatch_shifts = bool(dispatch_shifts)
+        if self._dispatch_shifts:
+            init_toggles = (
+                min(max(int(initial_toggles[0]), -1), 1),
+                min(max(int(initial_toggles[1]), -1), 1),
+                bool(initial_toggles[2]))
+        else:
+            init_toggles = tuple(bool(t) for t in initial_toggles)
         if isinstance(tune_toggles, (tuple, list)):
             tunable = tuple(bool(t) for t in tune_toggles)
         else:
@@ -241,8 +262,9 @@ class ParameterManager:
         self._initial_overlap = initial_overlap
         self._tune_overlap = bool(tune_overlap)
         # Pin the GP's candidate dims for non-tunable toggles (toggle
-        # bounds are [0,1], so normalized == raw value).
-        pinned = {2 + i: (1.0 if init_toggles[i] else 0.0)
+        # bounds are [0,1], so normalized == raw value; shift dims pin
+        # at the center of their third).
+        pinned = {2 + i: self._toggle_coord(i, init_toggles[i])
                   for i in range(3) if not tunable[i]}
         if not self._tune_compression:
             pinned[5] = self._compression_x(initial_compression)
@@ -271,10 +293,22 @@ class ParameterManager:
                 self._tune_overlap:
             t0 = self._initial_toggles + (self._initial_compression,
                                           self._initial_overlap)
-            self._toggle_plan = [t0] + [
-                tuple(not t0[j] if j == i else t0[j] for j in range(3))
-                + (self._initial_compression, self._initial_overlap)
-                for i in range(3) if self._tunable[i]]
+            self._toggle_plan = [t0]
+            for i in range(3):
+                if not self._tunable[i]:
+                    continue
+                # Alternatives per dim: a boolean flips once; a
+                # dispatch-mode shift dim tries each other crossover
+                # shift (so ±1 are both demonstrably measured against
+                # the probe's warm start before EI takes over).
+                if self._dispatch_shifts and i < 2:
+                    alts = [s for s in self.SHIFT_CHOICES if s != t0[i]]
+                else:
+                    alts = [not t0[i]]
+                self._toggle_plan += [
+                    tuple(a if j == i else t0[j] for j in range(3))
+                    + (self._initial_compression, self._initial_overlap)
+                    for a in alts]
             if self._tune_compression:
                 self._toggle_plan += [
                     self._initial_toggles + (c, self._initial_overlap)
@@ -328,9 +362,24 @@ class ParameterManager:
         cache_enabled, compression, overlap_bucket_bytes)"""
         return self._current
 
-    def _round_toggles(self, x) -> Tuple[bool, bool, bool]:
-        return tuple(bool(x[2 + i] >= 0.5) if self._tunable[i]
-                     else self._initial_toggles[i] for i in range(3))
+    def _toggle_coord(self, i: int, v) -> float:
+        """Normalized GP coordinate of one toggle value: booleans sit at
+        the interval ends; dispatch-mode shift dims at the center of
+        their third (stable rounding, like compression)."""
+        if self._dispatch_shifts and i < 2:
+            return (min(max(int(v), -1), 1) + 1 + 0.5) / 3.0
+        return 1.0 if v else 0.0
+
+    def _round_toggles(self, x) -> Tuple:
+        out = []
+        for i in range(3):
+            if not self._tunable[i]:
+                out.append(self._initial_toggles[i])
+            elif self._dispatch_shifts and i < 2:
+                out.append(min(int(float(x[2 + i]) * 3), 2) - 1)
+            else:
+                out.append(bool(x[2 + i] >= 0.5))
+        return tuple(out)
 
     @classmethod
     def _compression_x(cls, comp: str) -> float:
@@ -386,12 +435,20 @@ class ParameterManager:
         # (debug/regression.py correlates perf.drift onsets against
         # these).
         from .debug import flight as _flight
+        # In dispatch mode slots 2/3 are crossover SHIFTS (ints) over
+        # the probe-seeded table, not whole-range booleans — record the
+        # raw value either way so the drift diagnoser quotes what was
+        # actually applied.
         _flight.record(
             "autotune.decision", None,
             fusion_bytes=int(self._current[0]),
             cycle_ms=round(float(self._current[1]), 3),
-            hierarchical_allreduce=bool(self._current[2]),
-            hierarchical_allgather=bool(self._current[3]),
+            hierarchical_allreduce=(int(self._current[2])
+                                    if self._dispatch_shifts
+                                    else bool(self._current[2])),
+            hierarchical_allgather=(int(self._current[3])
+                                    if self._dispatch_shifts
+                                    else bool(self._current[3])),
             cache_enabled=bool(self._current[4]),
             compression=self._current[5],
             overlap_bucket_bytes=int(self._current[6]),
@@ -420,7 +477,8 @@ class ParameterManager:
     def _x_of_current(self) -> np.ndarray:
         return np.array(
             [math.log2(self._current[0]), self._current[1]]
-            + [1.0 if t else 0.0 for t in self._current[2:5]]
+            + [self._toggle_coord(i, self._current[2 + i])
+               for i in range(3)]
             # De-normalize the categorical coordinates back into their
             # raw [0,1] bounds (observe() re-normalizes; toggle bounds
             # are [0,1] so this is the identity for them too).

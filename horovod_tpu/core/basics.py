@@ -174,6 +174,21 @@ def init(mesh=None,
         else:
             global_state.controller = native_runtime.attach()
 
+    # --- per-payload collective schedule dispatch -------------------------
+    # Topology probe + dispatch-table install (ops/dispatch.py): a short
+    # seeded probe (only on topologies where hierarchical schedules can
+    # actually run — 1 < local_size < world dividing evenly) builds the
+    # per-(op kind, payload bucket) table every subsequent collective is
+    # stamped from.  Probe collectives ride the controller like any
+    # other op, so a transport failure surfaces exactly like one
+    # (elastic jobs: HorovodInternalError -> reset); the decision inputs
+    # are env-derived and rank-consistent, so every rank enqueues the
+    # identical probe sequence.
+    if global_state.controller is not None:
+        from ..ops import dispatch as _dispatch
+        _dispatch.bootstrap(global_state.controller, global_state.config,
+                            global_state.local_size)
+
     # --- metrics ----------------------------------------------------------
     # Topology gauges + (opt-in) the Prometheus scrape endpoint.  serve()
     # is idempotent, so elastic re-inits keep the one server alive across
@@ -305,6 +320,14 @@ def shutdown() -> None:
         from .. import debug as _debug
         _debug.stop_stall_watchdog()
         _debug.flight.record("shutdown", None)
+    except Exception:  # noqa: BLE001 - best-effort teardown
+        pass
+    try:
+        # Drop the dispatch-table mirror: a fresh init() re-probes (the
+        # topology may have changed), and annotation must not quote a
+        # dead world's table in between.
+        from ..ops import dispatch as _dispatch
+        _dispatch.reset()
     except Exception:  # noqa: BLE001 - best-effort teardown
         pass
     if global_state.controller is not None:

@@ -31,6 +31,17 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
 HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
+# Topology-probed per-payload schedule dispatch (ops/dispatch.py): a
+# short seeded probe at init() measures flat vs hierarchical per payload
+# size and installs a per-(op kind, payload bucket) dispatch table the
+# coordinator stamps into every response.  An EXPLICIT
+# HVD_TPU_HIERARCHICAL_ALLREDUCE/_ALLGATHER pins that op kind to the
+# given schedule for the whole payload range and bypasses its probe
+# (the blind-global semantics those knobs had before the dispatch plane
+# — kept as pins, deprecated as defaults).
+SCHEDULE_PROBE = "SCHEDULE_PROBE"              # probe + dispatch on/off
+SCHEDULE_PROBE_SEED = "SCHEDULE_PROBE_SEED"    # payload-content seed
+SCHEDULE_PROBE_REPS = "SCHEDULE_PROBE_REPS"    # timed reps per arm
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 ELASTIC = "ELASTIC"
 MESH_AXES = "MESH_AXES"                        # TPU-only: mesh axis spec
@@ -177,6 +188,19 @@ class Config:
     stall_shutdown_time_seconds: float = 0.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Tri-state pins for the dispatch plane: None = the knob was not set
+    # (the probe decides per payload), True/False = the operator
+    # explicitly pinned the schedule — the probe is bypassed for that op
+    # kind and the whole payload range uses the pinned choice.
+    hierarchical_allreduce_pin: Optional[bool] = None
+    hierarchical_allgather_pin: Optional[bool] = None
+    # Topology probe: a few seeded payload sizes x {flat, hierarchical}
+    # over the native collective path at init() (<1s at world <= 8; runs
+    # only when the topology has a real hierarchy to choose, i.e.
+    # 1 < local_size < world dividing evenly).
+    schedule_probe: bool = True
+    schedule_probe_seed: int = 0
+    schedule_probe_reps: int = 2
     elastic: bool = False
     mesh_axes: str = ""
     compile_cache_dir: str = ""
@@ -290,6 +314,19 @@ class Config:
             STALL_SHUTDOWN_TIME_SECONDS, cfg.stall_shutdown_time_seconds)
         cfg.hierarchical_allreduce = get_bool(HIERARCHICAL_ALLREDUCE)
         cfg.hierarchical_allgather = get_bool(HIERARCHICAL_ALLGATHER)
+        # Presence (not value) of the legacy knobs is what pins: an
+        # unset knob means "let the probe decide per payload".
+        cfg.hierarchical_allreduce_pin = (
+            None if get_env(HIERARCHICAL_ALLREDUCE) is None
+            else cfg.hierarchical_allreduce)
+        cfg.hierarchical_allgather_pin = (
+            None if get_env(HIERARCHICAL_ALLGATHER) is None
+            else cfg.hierarchical_allgather)
+        cfg.schedule_probe = get_bool(SCHEDULE_PROBE, cfg.schedule_probe)
+        cfg.schedule_probe_seed = get_int(SCHEDULE_PROBE_SEED,
+                                          cfg.schedule_probe_seed)
+        cfg.schedule_probe_reps = max(
+            1, get_int(SCHEDULE_PROBE_REPS, cfg.schedule_probe_reps))
         cfg.elastic = get_bool(ELASTIC)
         cfg.mesh_axes = get_env(MESH_AXES, "") or ""
         cfg.compile_cache_dir = get_env(COMPILE_CACHE_DIR, "") or ""

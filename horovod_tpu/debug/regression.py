@@ -47,6 +47,11 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     "recovery.restore.done": "recovery", "recovery.replicate": "recovery",
     "recovery.evict": "recovery",
     "overlap.plan": "overlap",
+    # Per-payload schedule dispatch (ops/dispatch.py): a table install
+    # or probe is a discrete event that changes every subsequent
+    # collective's schedule — exactly the kind of cause a comm-exposed
+    # drift should be able to name.
+    "dispatch.table": "dispatch", "dispatch.probe": "dispatch",
     "checkpoint.save.begin": "checkpoint",
     "checkpoint.save.commit": "checkpoint",
     "checkpoint.restore.begin": "checkpoint",
@@ -64,14 +69,15 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     # perf. (the diagnoser's own output).
     "autotune.": "autotune", "elastic.": "elastic", "fleet.": "fleet",
     "net.": "net", "recovery.": "recovery", "checkpoint.": "checkpoint",
-    "data.": "data",
+    "data.": "data", "dispatch.": "dispatch",
 }
 
 # Subsystems that can plausibly explain a given drifting component —
 # used to prefer a *consistent* suspect over merely the latest event.
 COMPONENT_SUBSYSTEMS: Dict[str, tuple] = {
     "input": ("data", "fleet", "elastic"),
-    "comm_exposed": ("net", "autotune", "overlap", "elastic", "fleet"),
+    "comm_exposed": ("dispatch", "net", "autotune", "overlap", "elastic",
+                     "fleet"),
     "checkpoint": ("checkpoint", "recovery", "elastic_commit"),
     "compute": ("autotune", "overlap", "fleet", "elastic"),
     "host": ("autotune", "data", "recovery"),

@@ -8,6 +8,7 @@ level; async handles are layered above in ops/collective.py.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
@@ -126,11 +127,16 @@ def load_library():
     lib.hvd_native_set_params.argtypes = [ctypes.c_int64, ctypes.c_double]
     lib.hvd_native_set_tuned_toggles.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_set_schedule_table.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    lib.hvd_native_set_cache_enabled.argtypes = [ctypes.c_int]
     lib.hvd_native_set_wire_compression.argtypes = [ctypes.c_int]
     lib.hvd_native_wire_compression.restype = ctypes.c_int
     lib.hvd_native_set_topology.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_native_last_allgather_schedule.restype = ctypes.c_int
+    lib.hvd_native_last_allreduce_schedule.restype = ctypes.c_int
     lib.hvd_native_last_allreduce_fanout.restype = ctypes.c_int
     lib.hvd_native_last_bcast_schedule.restype = ctypes.c_int
     lib.hvd_native_adasum_scratch_peak.restype = ctypes.c_int64
@@ -255,9 +261,17 @@ class NativeController:
         # (the coordinator stamps each Response so every rank executes
         # the same schedule mid-flip).
         self._autotune = None
+        self._autotune_pause = False
+        # Per-payload dispatch table (ops/dispatch.py): installed by the
+        # init()-time topology probe; once present, the tuner's two
+        # hierarchical dims become bounded crossover shifts over it and
+        # the coordinator stamps every response from the table.
+        self._dispatch_table = None
+        self._local_size = local_size
+        self._autotune_kwargs = None
         if cfg.autotune and rank == 0:
             from ..autotune import ParameterManager
-            self._autotune = ParameterManager(
+            self._autotune_kwargs = dict(
                 apply_fn=self._apply_tuned,
                 log_file=cfg.autotune_log or None,
                 max_samples=cfg.autotune_bayes_opt_max_samples,
@@ -302,14 +316,89 @@ class NativeController:
                 # single-rank job may try off too.
                 overlap_choices=(None if size == 1 else tuple(
                     c for c in ParameterManager.OVERLAP_CHOICES if c)))
+            # Built NOW (worker scripts assert the tuner engaged right
+            # after init); a probing job's bootstrap rebuilds it once in
+            # shift mode before any window is scored (probe traffic is
+            # excluded via autotune_paused, so no warmup is lost).
+            self._autotune = ParameterManager(**self._autotune_kwargs)
+
+    @contextlib.contextmanager
+    def autotune_paused(self):
+        """Suppress autotune ticks (and the lazy tuner build) for ops
+        inside the scope — the dispatch probe's traffic is pinned-arm
+        measurement, not a workload the tuner should score or warm up
+        on."""
+        prev = self._autotune_pause
+        self._autotune_pause = True
+        try:
+            yield
+        finally:
+            self._autotune_pause = prev
+
+    def adopt_dispatch_table(self, table) -> None:
+        """Install a probe-built dispatch table (ops/dispatch.py
+        DispatchTable): native coordinator tables on rank 0, and rebase
+        the autotuner's two hierarchical booleans into bounded crossover
+        SHIFTS over this table (the probe result is the warm start; the
+        tuner may move each kind's crossover by one bucket per unit of
+        shift, never flip the whole range blind)."""
+        self._dispatch_table = table
+        if self.rank() != 0:
+            return
+        from ..ops import dispatch as _dispatch
+        for kind in _dispatch.KINDS:
+            bounds, choices = table.to_native(kind)
+            self.set_schedule_table(kind, bounds, choices)
+        if self._autotune_kwargs is None:
+            return
+        if self._autotune is not None and (
+                self._autotune.frozen or self._autotune._samples > 0):
+            # A live mid-run tuner (elastic re-probe): keep its state —
+            # its proposals now apply through the dispatch branch of
+            # _apply_tuned, bounded by the fresh table.
+            return
+        # A kind the operator pinned (explicit HVD_TPU_HIERARCHICAL_*)
+        # stays pinned at shift 0: the tuner must refine measurements,
+        # not overrule an explicit operator decision.
+        tunable = tuple(
+            _config.get_env(knob) is None and self._local_size > 1
+            for knob in (_config.HIERARCHICAL_ALLREDUCE,
+                         _config.HIERARCHICAL_ALLGATHER))
+        old_tune = self._autotune_kwargs.get("tune_toggles", True)
+        cache_tunable = old_tune[2] if isinstance(old_tune, (tuple, list)) \
+            else bool(old_tune)
+        self._autotune_kwargs.update(
+            dispatch_shifts=True,
+            initial_toggles=(0, 0,
+                             self._autotune_kwargs["initial_toggles"][2]),
+            tune_toggles=tunable + (cache_tunable,))
+        from ..autotune import ParameterManager
+        self._autotune = ParameterManager(**self._autotune_kwargs)
 
     def _apply_tuned(self, fusion, cycle, hier_allreduce, hier_allgather,
                      cache_enabled, compression="none", overlap=None):
         from ..ops.compression import WIRE_CODES
         self._lib.hvd_native_set_params(int(fusion), float(cycle))
-        self._lib.hvd_native_set_tuned_toggles(
-            1 if hier_allreduce else 0, 1 if hier_allgather else 0,
-            1 if cache_enabled else 0)
+        if self._dispatch_table is not None:
+            # Dispatch mode: the two hierarchical dims are crossover
+            # SHIFTS over the probe-seeded table — applied as fresh
+            # per-bucket tables so the cache flip below can never
+            # clobber the dispatch plane the way the whole-range
+            # set_tuned_toggles reinstall would.
+            from ..ops import dispatch as _dispatch
+            shifted = self._dispatch_table.shifted(
+                {"allreduce": int(hier_allreduce),
+                 "allgather": int(hier_allgather)})
+            for kind in _dispatch.KINDS:
+                bounds, choices = shifted.to_native(kind)
+                self.set_schedule_table(kind, bounds, choices)
+            _dispatch.set_active(shifted, reason="autotune")
+            self._lib.hvd_native_set_cache_enabled(
+                1 if cache_enabled else 0)
+        else:
+            self._lib.hvd_native_set_tuned_toggles(
+                1 if hier_allreduce else 0, 1 if hier_allgather else 0,
+                1 if cache_enabled else 0)
         # Coordinator-stamped per round (ResponseList::wire_compression):
         # workers adopt the flip at the round boundary, never mid-batch.
         self._lib.hvd_native_set_wire_compression(
@@ -369,7 +458,7 @@ class NativeController:
         self._autotune_tick()
 
     def _autotune_tick(self):
-        if self._autotune is None:
+        if self._autotune is None or self._autotune_pause:
             return
         nbytes = ctypes.c_int64()
         secs = ctypes.c_double()
@@ -775,10 +864,48 @@ class NativeController:
             # "nothing stalled" and let the next poll see stable state.
             return []
 
+    def set_schedule_table(self, kind, max_bytes, hierarchical) -> None:
+        """Install one op kind's per-payload dispatch table on the
+        coordinator (``hvd_native_set_schedule_table``): payloads up to
+        ``max_bytes[i]`` use the hierarchical schedule iff
+        ``hierarchical[i]``.  ``max_bytes`` must be ascending and end
+        with INT64_MAX (ops/dispatch.py DispatchTable.to_native emits
+        this shape).  Coordinator-only effect, like the wire stamp."""
+        if isinstance(kind, int):
+            code = kind
+        else:
+            # Single home of the name -> native ScheduleKind mapping.
+            from ..ops.dispatch import KIND_CODES
+            code = KIND_CODES[kind]
+        n = len(max_bytes)
+        mb = (ctypes.c_int64 * n)(*[int(b) for b in max_bytes])
+        ch = (ctypes.c_int32 * n)(*[1 if c else 0 for c in hierarchical])
+        self._lib.hvd_native_set_schedule_table(code, mb, ch, n)
+
+    def set_cache_enabled(self, enabled: bool) -> None:
+        """Response-cache toggle alone (does not touch the dispatch
+        tables the way ``hvd_native_set_tuned_toggles`` would)."""
+        self._lib.hvd_native_set_cache_enabled(1 if enabled else 0)
+
     def last_allgather_schedule(self) -> int:
         """0 = flat ring, 1 = hierarchical (chain fan-out),
         2 = hierarchical (CMA star fan-out) — most recent allgather."""
         return self._lib.hvd_native_last_allgather_schedule()
+
+    def last_allreduce_schedule(self) -> int:
+        """0 = flat ring / flat VHDD, 1 = hierarchical — schedule of
+        this process's most recent allreduce/Adasum (the allreduce
+        analog of ``last_allgather_schedule``)."""
+        return self._lib.hvd_native_last_allreduce_schedule()
+
+    def schedules(self) -> dict:
+        """Most recent schedule per op kind, one dict for dashboards and
+        drill assertions: allreduce/allgather report flat (0) vs
+        hierarchical (1, or 2 for the allgather CMA-star fan-out);
+        broadcast reports its fan-out (1 chain, 2 CMA star)."""
+        return {"allreduce": self.last_allreduce_schedule(),
+                "allgather": self.last_allgather_schedule(),
+                "broadcast": self.last_bcast_schedule()}
 
     def last_allreduce_fanout(self) -> int:
         """0 = flat/none, 1 = chain, 2 = zero-copy CMA star — phase-3

@@ -210,6 +210,12 @@ int hvd_native_last_allgather_schedule() {
   return LastAllgatherSchedule();
 }
 
+// 0 = flat ring / flat VHDD, 1 = hierarchical (this process's most
+// recent allreduce/Adasum) — the allreduce analog of the hook above.
+int hvd_native_last_allreduce_schedule() {
+  return LastAllreduceSchedule();
+}
+
 // 0 = flat/none, 1 = pipelined chain, 2 = zero-copy CMA star.
 int hvd_native_last_allreduce_fanout() { return LastAllreduceFanout(); }
 int hvd_native_last_bcast_schedule() { return LastBroadcastSchedule(); }
@@ -238,6 +244,29 @@ void hvd_native_set_tuned_toggles(int hierarchical_allreduce,
   Runtime::Get().SetTunedToggles(hierarchical_allreduce != 0,
                                  hierarchical_allgather != 0,
                                  cache_enabled != 0);
+}
+
+// Per-payload schedule dispatch table (topology-probed): rank 0
+// installs a piecewise-constant payload_bytes -> {flat(0), hier(1)}
+// map per op kind (0 = allreduce, 1 = allgather); the coordinator
+// stamps each response's choice from its FINAL fused payload, so
+// table swaps stay rank-consistent like every other stream stamp.
+// max_bytes must be ascending with the last entry == INT64_MAX;
+// malformed tables are ignored.
+void hvd_native_set_schedule_table(int kind, const int64_t* max_bytes,
+                                   const int32_t* hierarchical, int n) {
+  std::vector<ScheduleSegment> segs;
+  segs.reserve(n > 0 ? n : 0);
+  for (int i = 0; i < n; ++i)
+    segs.push_back({max_bytes[i], hierarchical[i] != 0});
+  Runtime::Get().SetScheduleTable(kind, std::move(segs));
+}
+
+// Response-cache toggle alone (the dispatch plane owns the schedule
+// choice once a table is installed; flipping the cache must not
+// clobber it the way set_tuned_toggles' whole-range reinstall would).
+void hvd_native_set_cache_enabled(int cache_enabled) {
+  Runtime::Get().SetCacheOn(cache_enabled != 0);
 }
 
 // Eager wire compression (quantized collective engine): rank 0's

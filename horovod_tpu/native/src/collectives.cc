@@ -795,32 +795,46 @@ Status RunResilientRing(
 
 }  // namespace
 
+namespace {
+// Schedule marker for tests/observability (0 = flat ring / flat VHDD,
+// 1 = hierarchical) — the allreduce analog of g_allgather_schedule;
+// stored only by COMPLETED top-level entry points (RingAllreduceGroup
+// runs inside hierarchical phases and must not clobber it).
+std::atomic<int> g_allreduce_schedule{0};
+}  // namespace
+
+int LastAllreduceSchedule() { return g_allreduce_schedule.load(); }
+
 Status RingAllreduce(Network& net, void* vbuf, int64_t count, DataType dtype,
                      ReduceOp op, const std::function<void()>* restore) {
   const size_t nbytes = count * DataTypeSize(dtype);
   uint8_t* buf = static_cast<uint8_t*>(vbuf);
+  Status st;
   if (restore != nullptr && *restore) {
     // The caller can rebuild buf from still-intact inputs: no
     // pre-collective snapshot copy on the clean path at all.
-    return RunResilientRing(
+    st = RunResilientRing(
         net, nullptr, *restore, [&](const std::vector<int>& members) {
           return RingAllreduceGroup(net, vbuf, count, dtype, op, members);
         });
+  } else {
+    // Fallback (true in-place aliasing, hierarchical degenerate paths):
+    // the ring mutates buf, so a renegotiated retry needs the original
+    // addends back — one memcpy per collective when resilience is on.
+    thread_local std::vector<uint8_t> snap;
+    st = RunResilientRing(
+        net,
+        [&] {
+          if (snap.size() < nbytes) snap.resize(nbytes);
+          memcpy(snap.data(), buf, nbytes);
+        },
+        [&] { memcpy(buf, snap.data(), nbytes); },
+        [&](const std::vector<int>& members) {
+          return RingAllreduceGroup(net, vbuf, count, dtype, op, members);
+        });
   }
-  // Fallback (true in-place aliasing, hierarchical degenerate paths):
-  // the ring mutates buf, so a renegotiated retry needs the original
-  // addends back — one memcpy per collective when resilience is on.
-  thread_local std::vector<uint8_t> snap;
-  return RunResilientRing(
-      net,
-      [&] {
-        if (snap.size() < nbytes) snap.resize(nbytes);
-        memcpy(snap.data(), buf, nbytes);
-      },
-      [&] { memcpy(buf, snap.data(), nbytes); },
-      [&](const std::vector<int>& members) {
-        return RingAllreduceGroup(net, vbuf, count, dtype, op, members);
-      });
+  if (st.ok()) g_allreduce_schedule.store(0);
+  return st;
 }
 
 namespace {
@@ -902,8 +916,10 @@ Status HierarchicalAllreduce(Network& net, void* vbuf, int64_t count,
   // chain otherwise (HVD_TPU_AR_FANOUT=chain forces the chain for
   // benchmarking).  Markers record only schedules that COMPLETED — a
   // failed fan-out must not read as the schedule that never ran.
-  return StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
-                             rank, leader, local_members, local_size);
+  st = StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
+                           rank, leader, local_members, local_size);
+  if (st.ok()) g_allreduce_schedule.store(1);
+  return st;
 }
 
 namespace {
@@ -1451,22 +1467,28 @@ Status AdasumAllreduce(Network& net, void* vbuf, int64_t count,
                        DataType dtype) {
   const int size = net.size();
   if (size == 1 || count == 0) return Status::OK();
+  Status st;
   switch (dtype) {
     case DataType::FLOAT64:
-      return AdasumTyped<double>(net, static_cast<double*>(vbuf), count);
+      st = AdasumTyped<double>(net, static_cast<double*>(vbuf), count);
+      break;
     case DataType::FLOAT32:
-      return AdasumTyped<float>(net, static_cast<float*>(vbuf), count);
+      st = AdasumTyped<float>(net, static_cast<float*>(vbuf), count);
+      break;
     case DataType::FLOAT16:
     case DataType::BFLOAT16:
       // fp32 accumulation for 16-bit wires (reference fp16 Adasum kernels,
       // adasum.h AVX/F16C specializations — portable here).
-      return With16BitAsFloat(vbuf, count, dtype, [&](float* w) {
+      st = With16BitAsFloat(vbuf, count, dtype, [&](float* w) {
         return AdasumTyped<float>(net, w, count);
       });
+      break;
     default:
       return Status::InvalidArgument(
           "eager Adasum supports float16/bfloat16/float32/float64");
   }
+  if (st.ok()) g_allreduce_schedule.store(0);
+  return st;
 }
 
 namespace {
@@ -1540,8 +1562,10 @@ Status HierarchicalAdasumImpl(Network& net, void* vbuf, int64_t count,
   // Phase 3: leaders deliver the result within their node (same star-
   // or-chain schedule as HierarchicalAllreduce phase 3; markers record
   // only completed schedules).
-  return StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
-                             rank, leader, local_members, local_size);
+  st = StarOrChainArFanout(net, vbuf, count * DataTypeSize(dtype),
+                           rank, leader, local_members, local_size);
+  if (st.ok()) g_allreduce_schedule.store(1);
+  return st;
 }
 
 }  // namespace

@@ -64,6 +64,10 @@ Status HierarchicalAllgatherv(Network& net, uint8_t* buf,
 // this process (0 = flat ring, 1 = hierarchical with chain
 // fan-out, 2 = hierarchical with CMA star fan-out).
 int LastAllgatherSchedule();
+// Schedule of the most recent allreduce/Adasum on this process (0 =
+// flat ring / flat VHDD, 1 = hierarchical) — the allreduce analog of
+// the allgather hook above; stored only for schedules that COMPLETED.
+int LastAllreduceSchedule();
 // Most recent hierarchical allreduce/Adasum fan-out and most recent
 // broadcast schedule (0 = flat/none, 1 = chain, 2 = zero-copy CMA star).
 int LastAllreduceFanout();

@@ -181,10 +181,6 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
   std::lock_guard<std::mutex> table_lk(table_mu_);
   const int size = net_->size();
   ResponseList rl;
-  // Snapshot the tuned toggles once per round so every response of the
-  // round (and the distributed cache_on) reflects one consistent choice.
-  const bool hier_ar = hier_allreduce_.load();
-  const bool hier_ag = hier_allgather_.load();
   const bool cache_on = cache_on_.load();
   rl.cache_on = cache_on;
   rl.wire_compression = wire_compression_.load();
@@ -281,7 +277,6 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
         resp.prescale = q.prescale;
         resp.postscale = q.postscale;
         resp.device = q.device;
-        resp.hierarchical = hier_ar;
         resp.sizes = {NumElements(q.shape)};
         resp.cache_bits = {cache_bit};
         rl.responses.push_back(resp);
@@ -306,7 +301,6 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
       for (size_t d = 1; d < q.shape.size(); ++d) row_elems *= q.shape[d];
       resp.sizes.push_back(row_elems);
       resp.device = q.device;
-      resp.hierarchical = hier_ag;
       resp.cache_bits = {cache_bit};
       rl.responses.push_back(resp);
       open_fusion = nullptr;
@@ -367,8 +361,52 @@ ResponseList Controller::Coordinate(std::vector<RequestList>& lists) {
   // Shutdown once every rank asked for it.
   if (static_cast<int>(shutdown_.size()) == size) rl.shutdown = true;
 
+  StampSchedules(rl);
   CheckStalls(rl);
   return rl;
+}
+
+void Controller::SetScheduleTable(int kind,
+                                  std::vector<ScheduleSegment> segs) {
+  if (kind < 0 || kind >= kNumScheduleKinds || segs.empty()) return;
+  // Reject malformed tables (unsorted, or not covering the full payload
+  // range) instead of stamping from them: a bad install must not make
+  // the dispatch undefined for some payload size.
+  for (size_t i = 1; i < segs.size(); ++i)
+    if (segs[i].max_bytes <= segs[i - 1].max_bytes) return;
+  if (segs.back().max_bytes != INT64_MAX) return;
+  std::lock_guard<std::mutex> lk(sched_mu_);
+  sched_[kind] = std::move(segs);
+}
+
+void Controller::StampSchedules(ResponseList& rl) {
+  // Per-payload dispatch: stamp each response's schedule choice once
+  // its FINAL (post-fusion) payload is known.  The stamp — not any
+  // rank-local state — is what execution consults, so a mid-run table
+  // swap can never split the fleet across schedules for one Response.
+  std::lock_guard<std::mutex> lk(sched_mu_);
+  auto choose = [this](int kind, int64_t bytes) {
+    for (const auto& seg : sched_[kind])
+      if (bytes <= seg.max_bytes) return seg.hierarchical;
+    return false;  // unreachable: last segment is INT64_MAX
+  };
+  for (auto& resp : rl.responses) {
+    if (!resp.error.empty()) continue;
+    const int64_t elem = DataTypeSize(resp.dtype);
+    if (resp.type == RequestType::ALLREDUCE) {
+      int64_t elems = 0;
+      for (auto n : resp.sizes) elems += n;
+      resp.hierarchical = choose(kScheduleAllreduce, elems * elem);
+    } else if (resp.type == RequestType::ALLGATHER) {
+      // sizes = per-rank first dims + trailing row_elems: the wire
+      // payload is the FULL gathered result every rank ends up holding.
+      int64_t dims = 0;
+      for (size_t i = 0; i + 1 < resp.sizes.size(); ++i)
+        dims += resp.sizes[i];
+      resp.hierarchical =
+          choose(kScheduleAllgather, dims * resp.sizes.back() * elem);
+    }
+  }
 }
 
 void Controller::RecordReady(const std::string& name, int32_t rank) {

@@ -14,6 +14,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <cstdint>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -35,6 +36,20 @@ struct ControllerConfig {
   size_t cache_capacity = 1024;   // response cache entries (0 = disabled)
 };
 
+// One segment of a per-payload schedule dispatch table: payloads up to
+// max_bytes (inclusive) use the hierarchical schedule iff hierarchical.
+// A table is a sorted (ascending max_bytes) list whose last segment has
+// max_bytes == INT64_MAX, so every payload maps to exactly one choice.
+struct ScheduleSegment {
+  int64_t max_bytes;
+  bool hierarchical;
+};
+
+// Op kinds with a flat/hierarchical schedule choice (indices into the
+// coordinator's table array; broadcast/alltoall have no such choice).
+enum ScheduleKind { kScheduleAllreduce = 0, kScheduleAllgather = 1 };
+constexpr int kNumScheduleKinds = 2;
+
 class Controller {
  public:
   Controller(Network* net, const ControllerConfig& cfg)
@@ -50,14 +65,29 @@ class Controller {
     fusion_threshold_.store(bytes);
   }
 
-  // Categorical autotune toggles (reference parameter_manager.h:91-93):
-  // the coordinator stamps each Response's algorithm choice
-  // (Response::hierarchical) and distributes the cache toggle
-  // (ResponseList::cache_on), so flips stay rank-consistent mid-run.
+  // Per-payload schedule dispatch (topology-probed): a piecewise-
+  // constant map payload bytes -> {flat, hierarchical} per op kind.
+  // The coordinator consults it once each response's FINAL (fused)
+  // payload is known and stamps the choice into Response::hierarchical,
+  // so mid-run table swaps (probe install, tuner crossover shifts) stay
+  // rank-consistent exactly like the wire_compression stamp.  An empty
+  // or unsorted segment list is rejected (table unchanged).
+  void SetScheduleTable(int kind, std::vector<ScheduleSegment> segs);
+
+  // Response-cache toggle alone (the dispatch plane owns the schedule
+  // choice; the cache categorical is still a plain global).
+  void SetCacheOn(bool cache_on) { cache_on_.store(cache_on); }
+
+  // Legacy global toggles (reference parameter_manager.h:91-93): now a
+  // degenerate single-segment table per kind — the whole payload range
+  // maps to one schedule.  Kept as the config/tuner entry point for
+  // jobs without a probe-seeded table.
   void SetAlgoToggles(bool hier_allreduce, bool hier_allgather,
                       bool cache_on) {
-    hier_allreduce_.store(hier_allreduce);
-    hier_allgather_.store(hier_allgather);
+    SetScheduleTable(kScheduleAllreduce,
+                     {{INT64_MAX, hier_allreduce}});
+    SetScheduleTable(kScheduleAllgather,
+                     {{INT64_MAX, hier_allgather}});
     cache_on_.store(cache_on);
   }
 
@@ -87,6 +117,7 @@ class Controller {
   void AbsorbCacheHits(const std::vector<RequestList>& lists,
                        ResponseList& rl);
   void CheckStalls(ResponseList& rl);
+  void StampSchedules(ResponseList& rl);
 
   struct PendingTensor {
     Request first;                       // first-reported metadata
@@ -101,10 +132,14 @@ class Controller {
   ControllerConfig cfg_;
   Timeline* timeline_ = nullptr;
   std::atomic<int64_t> fusion_threshold_{0};  // 0 -> use cfg_ value
-  std::atomic<bool> hier_allreduce_{false};
-  std::atomic<bool> hier_allgather_{false};
   std::atomic<bool> cache_on_{true};
   std::atomic<int> wire_compression_{0};
+  // Per-kind dispatch tables (default: everything flat — the seed
+  // repo's pre-probe behavior).  sched_mu_ guards installs from the
+  // application/probe thread against the background loop's stamping.
+  std::mutex sched_mu_;
+  std::vector<ScheduleSegment> sched_[kNumScheduleKinds] = {
+      {{INT64_MAX, false}}, {{INT64_MAX, false}}};
   // Missing (non-joined, not-yet-reported) ranks for one pending tensor.
   std::vector<int32_t> MissingRanks(const PendingTensor& pt) const;
 

@@ -837,6 +837,17 @@ void Runtime::SetTunedToggles(bool hierarchical_allreduce,
                                 hierarchical_allgather, cache_enabled);
 }
 
+void Runtime::SetScheduleTable(int kind, std::vector<ScheduleSegment> segs) {
+  // Coordinator-only effect (workers adopt the per-response stamp from
+  // the response stream), mirroring SetWireCompression.
+  if (controller_) controller_->SetScheduleTable(kind, std::move(segs));
+}
+
+void Runtime::SetCacheOn(bool cache_enabled) {
+  tuned_cache_on_ = cache_enabled;
+  if (controller_) controller_->SetCacheOn(cache_enabled);
+}
+
 void Runtime::SetWireCompression(int code) {
   // Coordinator-only effect: workers (and rank 0's own executor) adopt
   // the choice from the response stream, so setting it here on a

@@ -106,6 +106,12 @@ class Runtime {
   // RESPONSE, never local state, so mid-run flips stay rank-consistent.
   void SetTunedToggles(bool hierarchical_allreduce,
                        bool hierarchical_allgather, bool cache_enabled);
+  // Per-payload schedule dispatch table (topology probe / tuner
+  // refinement): forwarded to the coordinator, which stamps each
+  // response's schedule from its FINAL fused payload size.  Coordinator-
+  // only effect, like SetWireCompression.
+  void SetScheduleTable(int kind, std::vector<ScheduleSegment> segs);
+  void SetCacheOn(bool cache_enabled);
   void SetDeviceExecutor(DeviceExecutorFn fn) { device_executor_ = fn; }
   void StartTimeline(const std::string& filename);
   void StopTimeline();

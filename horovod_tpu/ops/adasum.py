@@ -162,7 +162,8 @@ def adasum_allreduce(tensor: jax.Array, axis_name: str,
 
 
 def adasum_allreduce_hierarchical(tensor: jax.Array, local_axis: str,
-                                  cross_axis: str) -> jax.Array:
+                                  cross_axis: str, spec=None,
+                                  wire_dtype=None) -> jax.Array:
     """Hierarchical Adasum over a 2-axis mesh (reference
     adasum_gpu_operations.cc:38-…): intra-``local_axis`` reduce-scatter
     (sum — the ICI-cheap phase), cross-``cross_axis`` VHDD on the shards
@@ -173,14 +174,32 @@ def adasum_allreduce_hierarchical(tensor: jax.Array, local_axis: str,
 
     Numerics: equals ``adasum_tree`` over the per-node means — asserted
     against that oracle on a 2x4 virtual mesh in tests/test_collectives.py.
-    """
+
+    ``spec`` (a ``QuantSpec``) or ``wire_dtype`` (bf16/fp16) puts the
+    quantized/cast wire under the INTRA-node phases — the reduce-scatter
+    moves compressed destination rows and the final fan-out gathers a
+    compressed shard, both with fp32 accumulation — so this is
+    Adasum-on-top-of-compressed-hierarchical-reduction: the adaptive
+    coefficients are computed from the (de)quantized node sums, and the
+    cross-node VHDD stays fp32 (its payload is already 1/L of the
+    tensor; the coefficient dot/norm partials must not be re-rounded).
+    Convergence parity vs plain fp32 Adasum on the toy quadratic is
+    asserted in tests/test_dispatch.py (within the PR 5 error bar)."""
     L = axis_size(local_axis)
     crossP = axis_size(cross_axis)
+    compressed = spec is not None or wire_dtype is not None
+    if spec is not None and wire_dtype is not None:
+        raise ValueError("pass at most one of spec/wire_dtype")
     if L == 1:
         return adasum_allreduce(tensor, cross_axis)
     if crossP == 1:
         return lax.pmean(tensor, local_axis)
     if crossP & (crossP - 1):
+        if compressed:
+            raise ValueError(
+                "compressed hierarchical Adasum requires a power-of-two "
+                "cross axis (the tree fallback combines whole vectors — "
+                "there is no intra-node wire for the compression to ride)")
         # Tree fallback needs whole vectors: combine node means directly.
         node_mean = lax.pmean(tensor, local_axis)
         return adasum_tree(
@@ -188,12 +207,46 @@ def adasum_allreduce_hierarchical(tensor: jax.Array, local_axis: str,
     shape, dtype = tensor.shape, tensor.dtype
     x = tensor.astype(jnp.float32).reshape(-1)
     n = x.shape[0]
-    pad = (-n) % L
+    if not compressed:
+        pad = (-n) % L
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+        shard = lax.psum_scatter(x, local_axis, scatter_dimension=0,
+                                 tiled=True)
+        shard = adasum_allreduce(shard, cross_axis, shard_axis=local_axis)
+        full = lax.all_gather(shard, local_axis, tiled=True)
+        if pad:
+            full = full[:n]
+        return (full / L).reshape(shape).astype(dtype)
+    # Compressed intra-node phases (ops/quantization.py wire kernels):
+    # pad so destination rows are block-aligned — blocks never straddle
+    # rows, the same grid as the compressed reducescatter.
+    from . import quantization as Q
+    align = L * (spec.block if spec is not None else 1)
+    pad = (-n) % align
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
-    shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+    rows = x.reshape(L, -1)
+    payload, scales = Q._rows_to_wire(rows, spec, wire_dtype)
+    payload = lax.all_to_all(payload, local_axis, split_axis=0,
+                             concat_axis=0, tiled=True)
+    if scales is not None:
+        scales = lax.all_to_all(scales, local_axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+    shard = Q._wire_to_f32(payload, scales, spec,
+                           rows.shape[1]).sum(axis=0)
+    # Cross-node VHDD on the (compressed-then-accumulated) node-sum
+    # shards, full-vector coefficients via the shard axis — fp32.
     shard = adasum_allreduce(shard, cross_axis, shard_axis=local_axis)
-    full = lax.all_gather(shard, local_axis, tiled=True)
+    # Compressed intra-node fan-out of the result shard.
+    if spec is None:
+        full = lax.all_gather(shard.astype(wire_dtype), local_axis,
+                              tiled=True).astype(jnp.float32)
+    else:
+        q2, s2 = Q.quantize(shard, spec)
+        q2 = lax.all_gather(q2, local_axis, tiled=True)
+        s2 = lax.all_gather(s2, local_axis, tiled=True)
+        full = Q.dequantize(q2, s2, spec, L * shard.size)
     if pad:
         full = full[:n]
     return (full / L).reshape(shape).astype(dtype)

@@ -52,6 +52,24 @@ Product = ReduceOp(5)
 # costs a lock + dict walk; the cached child is a straight attribute).
 _coll_metrics = {}
 
+# Per-(kind, schedule) exposed-comm seconds — the dispatch-plane
+# attribution surface: a drift report can say WHICH schedule's wire
+# time grew, so a bad dispatch decision is a nameable suspect.
+_sched_metrics = {}
+
+
+def _schedule_seconds(kind: str, schedule: str):
+    rec = _sched_metrics.get((kind, schedule))
+    if rec is None:
+        from ..metrics.registry import registry
+        rec = registry().counter(
+            "hvd_collective_schedule_seconds_total",
+            "Eager collective wall seconds by the dispatch table's "
+            "schedule choice (flat vs hier) — exposed-comm attribution "
+            "per schedule", kind=kind, schedule=schedule)
+        _sched_metrics[(kind, schedule)] = rec
+    return rec
+
 
 def _collective_metrics(kind: str):
     rec = _coll_metrics.get(kind)
@@ -150,16 +168,30 @@ def _op_range(kind: str, name, tensor, comp=None):
     registry; ``comp`` (a Compressor class) annotates the chosen wire
     format on the flight event and prices the sent bytes."""
     from ..utils.profiler import op_range
+    from . import dispatch as _dispatch
     nbytes = getattr(tensor, "nbytes", None)
     ops, bts, lat, raw_c, sent_c, ratio_g = _collective_metrics(kind)
+    # Dispatch annotation (advisory mirror of the coordinator's
+    # response-stream stamp): which schedule the active table picks for
+    # this payload — the hang-report evidence of which path a stuck
+    # collective took, like PR 5's wire= annotation.  The table keys on
+    # the payload the COORDINATOR stamps from: for allgather that is
+    # the FULL gathered result, not this rank's contribution (equal
+    # first dims assumed — the local estimate; uneven gathers may sit
+    # one bucket off near a crossover).
+    ann_bytes = nbytes
+    if ann_bytes is not None and kind == "allgather":
+        ann_bytes = ann_bytes * communicator_size()
+    sched = _dispatch.annotate(kind, ann_bytes)
     # Flight recorder: the enqueue event is what a hang report quotes —
     # an op stuck inside the yield never reaches the done event, so the
     # dangling enqueue IS the evidence of where the rank blocked.
+    fields = {"op": kind, "bytes": nbytes}
     if comp is not None:
-        _flight.record("collective.enqueue", name, op=kind, bytes=nbytes,
-                       wire=comp.wire)
-    else:
-        _flight.record("collective.enqueue", name, op=kind, bytes=nbytes)
+        fields["wire"] = comp.wire
+    if sched is not None:
+        fields["schedule"] = sched
+    _flight.record("collective.enqueue", name, **fields)
     t0 = time.perf_counter()
     try:
         with op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes):
@@ -175,6 +207,8 @@ def _op_range(kind: str, name, tensor, comp=None):
                 ratio_g.set(nbytes / sent)
         dt = time.perf_counter() - t0
         lat.observe(dt)
+        if sched is not None:
+            _schedule_seconds(kind, sched).inc(dt)
         if getattr(_overlap_submit, "active", False):
             _overlap_fallback_metric().inc(dt)
         _flight.record("collective.done", name, op=kind, dur_s=dt)
@@ -283,7 +317,8 @@ def _eager_rs_wire_emulate(comp, tensor):
 # ---------------------------------------------------------------------------
 
 def _compiled_allreduce(tensor, op: int, axis_name: str,
-                        prescale_factor: float, postscale_factor: float):
+                        prescale_factor: float, postscale_factor: float,
+                        comp=None):
     import jax.numpy as jnp
     from jax import lax
 
@@ -320,10 +355,21 @@ def _compiled_allreduce(tensor, op: int, axis_name: str,
             # Hierarchical Adasum over (local, cross) mesh axes
             # (reference adasum_gpu_operations.cc:38-…): intra-axis
             # reduce-scatter, cross-axis VHDD, intra-axis all-gather.
+            # ``comp`` (quantized/cast wire) rides the intra-node
+            # phases — Adasum on top of compressed hierarchical
+            # reduction (ops/adasum.py).
             from .adasum import adasum_allreduce_hierarchical
-            out = adasum_allreduce_hierarchical(tensor, axis_name[0],
-                                                axis_name[1])
+            spec = comp.spec() if comp is not None else None
+            out = adasum_allreduce_hierarchical(
+                tensor, axis_name[0], axis_name[1], spec=spec,
+                wire_dtype=(comp.wire_dtype if comp is not None and
+                            spec is None else None))
         else:
+            if comp is not None:
+                raise ValueError(
+                    "compression with op=Adasum requires a (local, "
+                    "cross) axis_name pair — the compressed wire rides "
+                    "the hierarchical schedule's intra-node phases")
             out = adasum_allreduce(tensor, axis_name)
     else:
         raise ValueError(f"unknown reduce op {op}")
@@ -430,9 +476,29 @@ def allreduce(tensor,
         # optimizer's error-feedback residual silently degrades
         # convergence — the env var must not do that behind a jit.
         comp = _resolve_compression(compression) if explicit else None
+        hier2 = isinstance(axis_name, (tuple, list)) and len(axis_name) == 2
+        if comp is not None and op == Adasum:
+            # Adasum-on-compressed-hierarchical-reduction: the wire
+            # rides the intra-node phases; _compiled_allreduce threads
+            # the compressor through (and raises on a flat axis, where
+            # there is no intra-node wire to compress).
+            return _compiled_allreduce(tensor, op, axis_name,
+                                       prescale_factor, postscale_factor,
+                                       comp=comp)
         if comp is not None and _check_compressible(tensor, op, explicit):
             from . import quantization as Q
             spec = comp.spec()
+            if hier2:
+                # Two-level compressed schedule over (local, cross)
+                # axes: cross-node bytes shrink by the local world size
+                # AND the wire format (Q.compressed_allreduce_
+                # hierarchical).
+                return Q.compressed_allreduce_hierarchical(
+                    tensor, axis_name[0], axis_name[1], op, spec=spec,
+                    wire_dtype=None if spec is not None
+                    else comp.wire_dtype,
+                    prescale=prescale_factor,
+                    postscale=postscale_factor)
             return Q.compressed_allreduce(
                 tensor, _default_axis(axis_name), op, spec=spec,
                 wire_dtype=None if spec is not None else comp.wire_dtype,

@@ -648,8 +648,17 @@ class EagerBucketQueue:
                 f"got {len(leaves)}")
         nbytes = sum(_leaf_nbytes(x) for x in leaves)
         _overlap_metrics()[0].inc()
+        # Per-bucket schedule dispatch: the coordinator stamps each
+        # bucket's (fused) response from its payload size, so a small
+        # early bucket and a large late bucket may legitimately ride
+        # different schedules — annotate the expected choice so traces
+        # and hang reports show the per-bucket decision.
+        from . import dispatch as _dispatch
+        sched = _dispatch.annotate("allreduce", nbytes)
+        extra = {"schedule": sched} if sched is not None else {}
         _flight.record("overlap.bucket_launch", f"{self._base}.b{bucket}",
-                       bucket=bucket, bytes=nbytes, tensors=len(leaves))
+                       bucket=bucket, bytes=nbytes, tensors=len(leaves),
+                       **extra)
         # Names carry the LEAF index, not the bucket index: every rank
         # submits the same name sequence in the same (reverse-leaf)
         # order whatever its bucket size, so a mid-run tuner flip that

@@ -269,6 +269,14 @@ def test_autotune_disables_hierarchical_on_single_host(tmp_path, monkeypatch):
     script.write_text(HIER_AUTOTUNE_WORKER.format(repo=REPO,
                                                   outfile=outfile))
     monkeypatch.setenv("HVD_TPU_COMPRESSION", "none")
+    # Legacy plane: with the ISSUE 11 dispatch plane active (default),
+    # an explicit --hierarchical-allreduce is a PIN the tuner must not
+    # explore, and the probe-seeded table owns the schedule instead.
+    # This test exercises the legacy blind-global toggle the escape
+    # hatch preserves (docs/collectives.md); the dispatch regime's
+    # probe/shift behavior is covered in tests/test_dispatch.py and
+    # tests/test_hierarchical.py.
+    monkeypatch.setenv("HVD_TPU_SCHEDULE_PROBE", "0")
     rc = main([
         "-np", "4", "-H", "localhost:2,127.0.0.1:2",
         "--autotune", "--hierarchical-allreduce",

@@ -197,6 +197,130 @@ def test_hierarchical_allgather_4proc(hierarchical):
         assert p.exitcode == 0
 
 
+def _dispatch_worker(rank, size, port, q):
+    """Per-payload schedule dispatch (ISSUE 11): rank 0 installs a
+    table with a 1MB crossover; the coordinator must stamp each
+    response from its OWN payload — one job, two schedules."""
+    sys.path.insert(0, REPO)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"
+    from horovod_tpu.native.controller import NativeController
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    try:
+        if rank == 0:
+            ctl.set_schedule_table("allreduce", [1 << 20, (1 << 63) - 1],
+                                   [0, 1])
+            ctl.set_schedule_table("allgather", [1 << 20, (1 << 63) - 1],
+                                   [0, 1])
+        ctl.barrier()  # fence: the table is live before the timed ops
+        small = np.ones(1024, dtype=np.float32)               # 4KB
+        out = ctl.allreduce(small, op=1, name="d.small")
+        np.testing.assert_allclose(out, size)
+        s_small = ctl.last_allreduce_schedule()
+        big = np.ones((4 << 20) // 4, dtype=np.float32)       # 4MB
+        out = ctl.allreduce(big, op=1, name="d.big")
+        np.testing.assert_allclose(out[:4], size)
+        s_big = ctl.last_allreduce_schedule()
+        # Allgather: 4 ranks x 64KB = 256KB total -> flat; x 512KB =
+        # 2MB total -> hierarchical (the stamp keys on the FULL
+        # gathered payload).
+        ag_small = ctl.allgather(
+            np.ones((64 << 10) // 4, dtype=np.float32), name="d.ag0")
+        assert ag_small.shape[0] == size * (64 << 10) // 4
+        g_small = ctl.last_allgather_schedule()
+        ctl.allgather(np.ones((512 << 10) // 4, dtype=np.float32),
+                      name="d.ag1")
+        g_big = ctl.last_allgather_schedule()
+        q.put((rank, "ok", (s_small, s_big, g_small, g_big,
+                            ctl.schedules()["allreduce"])))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "error", repr(e)))
+    finally:
+        ctl.shutdown()
+
+
+def test_per_payload_dispatch_4proc():
+    """One job, one table, two schedules: payloads under the installed
+    crossover ride the flat ring, payloads above it ride the
+    hierarchical schedule — per-response stamping, not a global."""
+    size = 4
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_dispatch_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=120)
+        assert status == "ok", f"rank {rank}: {payload}"
+        s_small, s_big, g_small, g_big, last = payload
+        assert s_small == 0, payload       # 4KB -> flat
+        assert s_big == 1, payload         # 4MB -> hierarchical
+        assert g_small == 0, payload       # 256KB gathered -> flat
+        assert g_big in (1, 2), payload    # 2MB gathered -> hierarchical
+        assert last == s_big               # schedules() surfaces it
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+
+def _probe_worker(rank, size, port, q):
+    """End-to-end init-style bootstrap: probe, table broadcast, install
+    — every rank must end with the identical table and stamps that
+    match it."""
+    sys.path.insert(0, REPO)
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"
+    import time
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.native.controller import NativeController
+    from horovod_tpu.ops import dispatch
+    ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+    try:
+        t0 = time.perf_counter()
+        table = dispatch.bootstrap(ctl, Config.from_env(), local_size=2)
+        dur = time.perf_counter() - t0
+        assert table is not None and table.source == "probe"
+        # Stamps agree with the table on a post-probe payload.
+        x = np.ones((2 << 20) // 4, dtype=np.float32)
+        ctl.allreduce(x, op=1, name="pp.check")
+        want = table.choose("allreduce", x.nbytes)
+        got = ctl.last_allreduce_schedule()
+        assert got == (1 if want == "hier" else 0), (want, got)
+        q.put((rank, "ok", (dur, tuple(table.encode().tolist()))))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "error", repr(e)))
+    finally:
+        ctl.shutdown()
+
+
+def test_probe_bootstrap_4proc():
+    """The acceptance shape: probe runs once, is cheap (<1s of
+    collective time at world 4 on this host — asserted loosely at <10s
+    for sandbox swings), and every rank holds the identical
+    broadcast table."""
+    size = 4
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_probe_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    tables = set()
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=120)
+        assert status == "ok", f"rank {rank}: {payload}"
+        dur, enc = payload
+        assert dur < 10.0, f"probe took {dur:.1f}s"
+        tables.add(enc)
+    assert len(tables) == 1, tables   # identical on every rank
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+
 @pytest.mark.timeout(240)
 @pytest.mark.parametrize("fanout", ["star", "chain"])
 def test_hierarchical_allgather_3member_nodes(fanout):
