@@ -1835,6 +1835,124 @@ def bench_attribution():
     })
 
 
+def bench_warmstart():
+    """Tuning-memory warm start: time-to-best-config of a cold GP
+    autotune run vs the same job warm-started from the persistent
+    tuned-config store (fleet/tuning.py) — ISSUE 12's acceptance
+    figure.  A deterministic synthetic oracle maps each 7-wide config to
+    a steady-state score (int8 wire + mid fusion + 8MB overlap buckets
+    win; hierarchical loses, the single-host regime); the COLD run pays
+    the full bootstrap sweep + EI search before it first applies a
+    config within 5%% of the grid best, the WARM run starts from the
+    stored record and must land there at window 0.  The store round
+    trip is the real LocalTuningStore (tmp+fsync+rename) including the
+    gp-dims guard.  Disclosed: scores come from the oracle, not wall
+    time — the bench prices the DECISION plane (windows of sample
+    budget), which is what warm start saves; each window costs real
+    step time in production.  Select with `bench.py --bench warmstart`.
+    Host-only: no accelerator."""
+    import itertools
+    import math as _math
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from horovod_tpu.autotune import ParameterManager
+    from horovod_tpu.fleet import tuning as T
+
+    def oracle(cfg):
+        fusion, cycle, har, hag, cache, comp, overlap = cfg
+        score = 1e9
+        score *= {"none": 1.0, "bf16": 1.18, "int8": 1.34}[comp]
+        score *= 0.80 if har else 1.0      # single-host hier penalty
+        score *= 0.95 if hag else 1.0
+        score *= 1.05 if cache else 1.0
+        score *= {0: 1.0, 2 << 20: 1.06, 8 << 20: 1.12,
+                  32 << 20: 1.03}[overlap]
+        score *= 1.0 - 0.01 * (_math.log2(fusion) - 26.0) ** 2
+        score *= 1.0 - 0.002 * abs(cycle - 3.0)
+        return score
+
+    kwargs = dict(max_samples=24, window_seconds=0.0, warmup_samples=0,
+                  seed=7, initial_toggles=(True, False, True),
+                  initial_compression="none", tune_compression=True,
+                  initial_overlap=0, tune_overlap=True)
+
+    # The grid best over the categorical space at the numeric optimum —
+    # context for how close either run's frozen config lands.
+    grid_best = max(
+        oracle((2 ** 26, 3.0, har, hag, cache, comp, ov))
+        for har, hag, cache in itertools.product((False, True), repeat=3)
+        for comp in ParameterManager.COMPRESSION_CHOICES
+        for ov in ParameterManager.OVERLAP_CHOICES)
+
+    def drive(pm):
+        """Feed oracle scores until freeze; returns (per-window applied
+        scores, the frozen config's score)."""
+        history = []
+        while not pm.frozen:
+            s = oracle(pm.current)
+            history.append(s)
+            pm._observe(s)
+        return history, oracle(pm.current)
+
+    def windows_to(history, bar):
+        """First window whose APPLIED config scores >= bar (len(history)
+        = the freeze itself when only the final best reaches it)."""
+        for i, s in enumerate(history):
+            if s >= bar:
+                return i
+        return len(history)
+
+    store_dir = tempfile.mkdtemp(prefix="hvd_bench_warmstart_")
+    store = T.LocalTuningStore(store_dir)
+    key = T.config_key("bench-synthetic-model", 1, "flat")
+
+    pm_cold = ParameterManager(apply_fn=lambda *p: None, **kwargs)
+    cold_hist, cold_final = drive(pm_cold)
+    store.put(key, T.make_record(pm_cold.config_dict(),
+                                 score=pm_cold._frozen_score,
+                                 dims=pm_cold.gp_dims()))
+    # "Best config" = the cold run's own frozen score: time-to-best is
+    # how many sample windows pass before the applied config first
+    # scores within 2% of it.  The warm run starts FROM that config, so
+    # window 0 is the honest target.
+    bar = 0.98 * cold_final
+    cold_to_best = windows_to(cold_hist, bar)
+    cold_windows = len(cold_hist)
+
+    pm_warm = ParameterManager(apply_fn=lambda *p: None, **kwargs)
+    rec = store.get(key, dims=pm_warm.gp_dims())  # dims guard exercised
+    assert pm_warm.warm_start(rec)
+    warm_first = oracle(pm_warm.current)  # applied before any window
+    warm_hist, warm_final = drive(pm_warm)
+    warm_to_best = 0 if warm_first >= bar else windows_to(warm_hist, bar)
+
+    speedup = (cold_to_best + 1) / (warm_to_best + 1)
+    _emit({
+        "metric": "autotune_warm_start_time_to_best",
+        "value": round(speedup, 2),
+        "unit": "x fewer sample windows until the applied config is "
+                "within 2% of the cold run's frozen best score "
+                "((cold+1)/(warm+1))",
+        "vs_baseline": round(speedup, 2),
+        "windows_to_best_cold": cold_to_best,
+        "windows_to_best_warm": warm_to_best,
+        "windows_to_freeze": cold_windows,
+        "cold_final_score": round(cold_final, 1),
+        "warm_first_score": round(warm_first, 1),
+        "warm_final_score": round(warm_final, 1),
+        "grid_best_score": round(grid_best, 1),
+        "warm_final_at_least_cold": bool(warm_final >= cold_final * 0.999),
+        "bar_x": 2.0,
+        "within_bar": bool(speedup >= 2.0),
+        "disclosed": "deterministic synthetic oracle over the real "
+                     "GP/bootstrap/store code path; windows of sample "
+                     "budget, not wall seconds — each window costs "
+                     "HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE real steps in "
+                     "production",
+    })
+
+
 def bench_recovery():
     """Peer-to-peer hot recovery: (a) restore latency of the SAME
     committed ZeRO state through the in-memory replica tier vs the disk
@@ -2629,6 +2747,8 @@ def main():
         return bench_metrics_overhead()  # host-only
     if mode == "attribution":
         return bench_attribution()  # host-only
+    if mode == "warmstart":
+        return bench_warmstart()  # host-only
     if mode == "compression":
         return bench_compression()  # CPU mesh; never touches the chip
     if mode == "overlap":

@@ -58,7 +58,13 @@ TIER_FAST=(
   test_quantization.py
   test_recovery.py
   test_resnet.py test_response_cache.py test_timeline.py
-  test_transformer.py test_utils_ops.py
+  test_transformer.py
+  # Closed-loop autotuning drill (ISSUE 12): injected comm regression →
+  # drift → bounded re-tune → regression-gated rollback → resolution in
+  # the report's tuning section, plus the tuning-memory store/warm-start
+  # surface (`bench.py --bench warmstart` measures time-to-best-config).
+  test_tuning_loop.py
+  test_utils_ops.py
 )
 
 # Tier 2 — multi-process matrix: native runtime, transports, device

@@ -24,6 +24,16 @@ AUTOTUNE_WARMUP_SAMPLES = "AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_STEPS_PER_SAMPLE = "AUTOTUNE_STEPS_PER_SAMPLE"
 AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+# Closed-loop autotuning (the observatory feedback plane): persistent
+# tuning memory keyed by (model fingerprint, world, topology) — the
+# autotune analog of the response cache — plus drift-triggered bounded
+# re-tune episodes with regression-gated rollback.  See
+# docs/timeline_autotune.md ("Closing the loop").
+AUTOTUNE_MEMORY = "AUTOTUNE_MEMORY"            # warm start + write-back
+AUTOTUNE_MEMORY_DIR = "AUTOTUNE_MEMORY_DIR"    # local store (no gateway)
+AUTOTUNE_RETUNE = "AUTOTUNE_RETUNE"            # drift-triggered re-tune
+AUTOTUNE_RETUNE_WINDOWS = "AUTOTUNE_RETUNE_WINDOWS"  # episode budget
+AUTOTUNE_ROLLBACK_PCT = "AUTOTUNE_ROLLBACK_PCT"  # regression gate (%)
 LOG_LEVEL = "LOG_LEVEL"
 LOG_HIDE_TIME = "LOG_HIDE_TIME"
 STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
@@ -93,6 +103,7 @@ CHAOS_COMMIT_CRASH = "CHAOS_COMMIT_CRASH"      # "<point>[@step]" crash point
 CHAOS_SLOW_PEER_MS = "CHAOS_SLOW_PEER_MS"      # peer-serving latency injection
 CHAOS_TORN_RANKS = "CHAOS_TORN_RANKS"          # corrupt these ranks' replicas
 CHAOS_INPUT_DELAY_MS = "CHAOS_INPUT_DELAY_MS"  # input-pipeline slowdown drill
+CHAOS_COMM_DELAY_MS = "CHAOS_COMM_DELAY_MS"    # comm-side slowdown drill
 # Self-healing wire fabric (horovod_tpu/net/ + native/src/net.cc).  The
 # native knobs are parsed in C (net.cc NetResilience/NetChaos); they are
 # listed here so the knob table has one home and launch.py exports them.
@@ -183,6 +194,19 @@ class Config:
     autotune_steps_per_sample: int = 0   # 0 = time-windowed sampling
     autotune_bayes_opt_max_samples: int = 20
     autotune_gaussian_process_noise: float = 0.8
+    # Closed-loop autotuning: the tuning memory is on by default but
+    # only engages once a model fingerprint is announced (TpuState or
+    # autotune.announce_model); gateway jobs ride the fleet store, the
+    # local dir is the gateway-less fallback.  A drift whose suspect is
+    # a tunable subsystem triggers a bounded re-tune of
+    # autotune_retune_windows sample windows; the re-tuned config rolls
+    # back to the last-known-good entry when its score lands more than
+    # autotune_rollback_pct percent below the pre-drift baseline.
+    autotune_memory: bool = True
+    autotune_memory_dir: str = "./autotune_memory"
+    autotune_retune: bool = True
+    autotune_retune_windows: int = 6
+    autotune_rollback_pct: float = 5.0
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
@@ -307,6 +331,15 @@ class Config:
         cfg.autotune_gaussian_process_noise = get_float(
             AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
             cfg.autotune_gaussian_process_noise)
+        cfg.autotune_memory = get_bool(AUTOTUNE_MEMORY, cfg.autotune_memory)
+        cfg.autotune_memory_dir = get_env(
+            AUTOTUNE_MEMORY_DIR, cfg.autotune_memory_dir) \
+            or cfg.autotune_memory_dir
+        cfg.autotune_retune = get_bool(AUTOTUNE_RETUNE, cfg.autotune_retune)
+        cfg.autotune_retune_windows = max(1, get_int(
+            AUTOTUNE_RETUNE_WINDOWS, cfg.autotune_retune_windows))
+        cfg.autotune_rollback_pct = max(0.0, get_float(
+            AUTOTUNE_ROLLBACK_PCT, cfg.autotune_rollback_pct))
         cfg.stall_check_disable = get_bool(STALL_CHECK_DISABLE)
         cfg.stall_warning_time_seconds = get_float(
             STALL_CHECK_TIME_SECONDS, cfg.stall_warning_time_seconds)

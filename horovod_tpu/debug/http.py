@@ -4,6 +4,9 @@ metrics subsystem's Prometheus endpoint, which also mounts these two
 paths when it is running (one port serves both surfaces):
 
 * ``GET /debug/flight`` — this rank's flight-recorder dump as JSON.
+* ``GET /debug/regression`` — the last drift-triggered regression
+  report (``hvd.debug.last_regression_report()``; 404 before the first
+  confirmed drift) — previously only reachable via shared disk.
 * ``GET /debug/stacks`` — all-thread Python stacks via ``faulthandler``
   (the exact output a wedged rank would print on SIGUSR1, fetchable
   remotely while the main thread is stuck inside a collective — the
@@ -30,6 +33,19 @@ from . import flight as _flight
 def render_flight_json() -> bytes:
     """The local flight dump, serialized for the wire."""
     return json.dumps(_flight.recorder().dump_obj()).encode("utf-8")
+
+
+def render_regression_json() -> Optional[bytes]:
+    """The last regression report (debug/regression.py), serialized for
+    the wire — None before the first confirmed drift.  Until now the
+    perf_regression_step<N>.json artifact was only reachable over
+    shared disk; this serves it beside /debug/flight under the same
+    trust model."""
+    from . import regression as _regression
+    report = _regression.last_report()
+    if report is None:
+        return None
+    return json.dumps(report, default=str).encode("utf-8")
 
 
 def request_authorized(headers, key: str) -> bool:
@@ -67,6 +83,13 @@ class _DebugHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_error(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _authorized(self, key: str) -> bool:
         return request_authorized(self.headers, key)
 
@@ -78,6 +101,17 @@ class _DebugHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 return
             self._send(render_flight_json())
+        elif path == "/debug/regression":
+            if not self._authorized("regression"):
+                self.send_response(403)
+                self.end_headers()
+                return
+            body = render_regression_json()
+            if body is None:
+                self._send_error(404, b'{"error": "no regression '
+                                      b'report yet"}')
+                return
+            self._send(body)
         elif path == "/debug/stacks":
             if not self._authorized("stacks"):
                 self.send_response(403)

@@ -38,6 +38,12 @@ from . import flight as _flight
 # flight event.
 EVENT_SUBSYSTEM: Dict[str, str] = {
     "autotune.decision": "autotune",
+    # The closed loop's own events (autotune.py): a re-tune episode, a
+    # regression-gated rollback, and a memory warm start are discrete
+    # config-changing moments the diagnoser must be able to quote — a
+    # rollback in particular is how a drift RESOLVES.
+    "autotune.retune": "autotune", "autotune.rollback": "autotune",
+    "autotune.warm_start": "autotune",
     "elastic.reset": "elastic", "elastic.sync": "elastic",
     "elastic.restore": "elastic", "elastic.commit": "elastic_commit",
     "fleet.preempt": "fleet", "fleet.schedule": "fleet",
@@ -59,6 +65,10 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     "data.stall_warning": "data", "data.stall_timeout": "data",
     "data.producer_dead": "data", "data.chaos_delay": "data",
     "data.wait": "data",
+    # Comm-side chaos injection (ops/collective.py): the wire analog of
+    # data.chaos_delay — a deliberately slowed eager plane reads as a
+    # net-subsystem event, consistent with the comm_exposed component.
+    "net.chaos_delay": "net",
     # Prefix families (trailing "."): any kind under these namespaces
     # classifies even when it has no exact entry — subsystems grow new
     # event kinds (checkpoint.extract.*, recovery.restore.miss, ...)
@@ -212,6 +222,13 @@ def build_regression_report(event, write: bool = True,
             key=lambda ev: ev.get("t_mono") or 0.0),
         "ranks": ranks,
         "slowest_rank": slowest,
+        # What the feedback loop did about this drift: filled in by
+        # autotune.notify_drift right after this build (retune started /
+        # why not) and AMENDED by the episode's resolution
+        # (record_tuning rewrites the JSON on disk too), so the report
+        # ends up saying "rolled back, score ratio 0.71" instead of
+        # leaving the operator to correlate flight events by hand.
+        "tuning": None,
     }
     path = None
     if write:
@@ -260,6 +277,32 @@ def _write(report: dict, step: int) -> str:
         json.dump(report, f, indent=1, default=str)
     os.replace(tmp, path)
     return path
+
+
+def record_tuning(info: dict) -> Optional[dict]:
+    """Merge the feedback loop's activity into the last regression
+    report's ``tuning`` section (autotune.notify_drift records the
+    trigger decision, ParameterManager._finish_retune the resolution)
+    and rewrite the on-disk JSON so the artifact matches.  Returns the
+    updated report (None when no drift has been reported yet)."""
+    global _last_report
+    with _last_lock:
+        if _last_report is None:
+            return None
+        tuning = dict(_last_report.get("tuning") or {})
+        tuning.update(info)
+        _last_report["tuning"] = tuning
+        report = dict(_last_report)
+    path = report.get("path")
+    if path:
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # the in-memory report still carries the section
+    return report
 
 
 def last_report() -> Optional[dict]:

@@ -337,6 +337,20 @@ class TpuState(ObjectState):
         super().__init__(**kwargs)
         for k, v in data_objs.items():
             setattr(self, k, v)
+        # Closed-loop tuning memory (autotune.announce_model): the
+        # state's pytrees ARE the model identity — announce the
+        # leaf-spec fingerprint so an autotuned job warm-starts from
+        # (and freezes back into) the persistent tuned-config store.
+        # Best-effort, and a no-op on every process without an active
+        # tuner (everyone but rank 0 of an --autotune job).
+        try:
+            from .. import autotune as _autotune
+            if _autotune.active_manager() is not None:
+                trees = {k: getattr(self, k) for k in self._tree_keys}
+                if trees:
+                    _autotune.announce_model(trees)
+        except Exception:  # noqa: BLE001 — memory never blocks training
+            pass
 
     def _mesh(self):
         if self._checkpoint_mesh is not None:

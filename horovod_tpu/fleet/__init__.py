@@ -21,6 +21,10 @@ from .job import (ACTIVE_STATES, CANCELLED, DENIED, DONE, FAILED,
 from .policy import JobView, plan
 from .queue import DurableJobQueue
 from .scheduler import ElasticJobRunner, Scheduler
+from .tuning import (GatewayTuningStore, LocalTuningStore,
+                     TuningSchemaMismatch, config_key, make_record,
+                     model_fingerprint, resolve_store,
+                     topology_signature)
 
 __all__ = [
     "ACTIVE_STATES", "CANCELLED", "DENIED", "DONE", "FAILED",
@@ -30,4 +34,7 @@ __all__ = [
     "JobSpec", "JobView", "Scheduler",
     "cancel_job", "default_addr", "detect_gateway", "get_job",
     "list_jobs", "plan", "submit_job", "wait_job",
+    "GatewayTuningStore", "LocalTuningStore", "TuningSchemaMismatch",
+    "config_key", "make_record", "model_fingerprint", "resolve_store",
+    "topology_signature",
 ]

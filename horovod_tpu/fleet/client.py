@@ -34,7 +34,8 @@ def _secret(secret: Optional[str]) -> Optional[str]:
 
 
 def _request(method: str, addr: str, key: str, body: bytes = b"",
-             secret: Optional[str] = None, timeout: float = 5.0) -> dict:
+             secret: Optional[str] = None, timeout: float = 5.0,
+             none_on_404: bool = False):
     from .. import net as _net
     from ..runner.rendezvous import _signature
     req = urllib.request.Request(
@@ -47,6 +48,9 @@ def _request(method: str, addr: str, key: str, body: bytes = b"",
         raw = _net.request_bytes(req, timeout=timeout,
                                  name=f"fleet.{method.lower()}.{key}")
     except urllib.error.HTTPError as e:
+        if e.code == 404 and none_on_404:
+            # A miss is an answer, not a failure (tuning-memory lookups).
+            return None
         if e.code == 403:
             raise PermissionError(
                 f"fleet gateway at {addr} rejected the request signature "

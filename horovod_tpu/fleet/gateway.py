@@ -13,6 +13,8 @@ them onto the device inventory.  Endpoints::
     GET    /fleet/jobs         list job records
     GET    /fleet/jobs/<id>    one job record
     DELETE /fleet/jobs/<id>    cancel (queued or running)
+    GET    /fleet/tuning/<key> stored tuned config (tuning memory)
+    PUT    /fleet/tuning/<key> persist a tuned config record
 
 All job endpoints are HMAC-gated with the fleet secret
 (``HVD_TPU_FLEET_SECRET``) under the rendezvous KV's signature scheme —
@@ -105,6 +107,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
             if rec is None:
                 return self._send(404, {"error": "no such job"})
             return self._send(200, rec.to_dict())
+        if key.startswith("tuning/"):
+            # Tuning memory (fleet/tuning.py): the stored record is
+            # served raw — schema/dims validation belongs to the
+            # consumer, whose knob space the server cannot know.
+            rec = gw.tuning.get(key[len("tuning/"):])
+            if rec is None:
+                return self._send(404, {"error": "no tuned config"})
+            return self._send(200, rec)
         return self._send(404, {"error": "not found"})
 
     def do_POST(self):
@@ -124,6 +134,24 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if isinstance(rec, str):  # validation refusal
             return self._send(400, {"error": rec})
         return self._send(200, rec.to_dict())
+
+    def do_PUT(self):
+        gw = self.server.gateway  # type: ignore[attr-defined]
+        key = self._key()
+        if key is None or not key.startswith("tuning/"):
+            return self._send(404, {"error": "not found"})
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._authorized("PUT", key, body):
+            return self._send(403, {"error": "bad or missing signature"})
+        from .tuning import TuningSchemaMismatch
+        try:
+            rec = json.loads(body.decode())
+            stored = gw.tuning.put(key[len("tuning/"):], rec)
+        except (ValueError, TypeError, TuningSchemaMismatch) as e:
+            return self._send(400, {"error": f"malformed tuned-config "
+                                             f"record: {e}"})
+        return self._send(200, stored)
 
     def do_DELETE(self):
         gw = self.server.gateway  # type: ignore[attr-defined]
@@ -176,6 +204,11 @@ class FleetGateway(BackgroundHTTPServer):
             secret = get_env("FLEET_SECRET")
         self.secret = secret
         self.store = DurableJobQueue(fleet_dir)
+        # Fleet-level tuning memory: tuned configs persist beside the
+        # job queue with the same durability discipline, served at
+        # GET/PUT /fleet/tuning/<key> so resubmitted jobs start warm.
+        from .tuning import LocalTuningStore
+        self.tuning = LocalTuningStore(fleet_dir)
         hosts_provider = hosts if callable(hosts) else (lambda: list(hosts))
         self.scheduler = Scheduler(
             self.store, hosts_provider, runner_factory=runner_factory,

@@ -405,6 +405,21 @@ class StepAttribution:
             out["wall"] = self._win_wall
             return out
 
+    def window_shares(self) -> Optional[Dict[str, float]]:
+        """Normalized wall-component shares of the CURRENT window —
+        component seconds divided by the window's wall seconds, the
+        multi-step view of a single record's ``shares``.  This is the
+        structured signal the autotuner consumes (autotune.py): one
+        sample window spans many steps, so the tuner wants the window
+        mean, not whichever step happened to close last.  None before
+        any record landed in the window."""
+        with self._lock:
+            wall = self._win_wall
+            if wall <= 0.0:
+                return None
+            return {k: self._win.get(k, 0.0) / wall
+                    for k in WALL_COMPONENTS}
+
     def advance_window(self) -> None:
         with self._lock:
             self._win = {}
@@ -475,3 +490,10 @@ def last_attribution() -> Optional[dict]:
     """The most recent step's attribution record (None before the
     second ``step_end``)."""
     return attribution().last_record()
+
+
+def window_shares() -> Optional[dict]:
+    """Normalized wall-component shares of the current attribution
+    window (None before any record) — the autotuner's per-window
+    signal."""
+    return attribution().window_shares()

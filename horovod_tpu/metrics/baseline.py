@@ -257,6 +257,7 @@ class DriftDetector:
             "since ~step %d (dominant component: %s, share +%.0f%%)",
             event.current_s * 1e3, event.ratio, event.baseline_s * 1e3,
             event.onset_step, event.component, event.share_delta * 100)
+        report = None
         if self.emit_report:
             try:
                 from ..debug import regression
@@ -264,6 +265,17 @@ class DriftDetector:
                 event.report_path = report.get("path")
             except Exception:  # noqa: BLE001 — diagnosis never kills
                 pass
+        # Close the loop: a drift whose suspect is a tunable subsystem
+        # (or whose dominant component is exposed comm) triggers a
+        # bounded re-tune episode with regression-gated rollback instead
+        # of an operator page — autotune.notify_drift decides, records
+        # its decision in the report's ``tuning`` section, and no-ops on
+        # ranks that own no tuner.
+        try:
+            from .. import autotune as _autotune
+            _autotune.notify_drift(event, report)
+        except Exception:  # noqa: BLE001 — the loop never kills the step
+            pass
 
     # -- read side ---------------------------------------------------------
 

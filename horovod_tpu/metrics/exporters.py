@@ -109,15 +109,18 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "2")
             self.end_headers()
             self.wfile.write(b"ok")
-        elif path in ("/debug/flight", "/debug/stacks"):
+        elif path in ("/debug/flight", "/debug/regression",
+                      "/debug/stacks"):
             # The metrics port doubles as a debug surface: one scrape
-            # endpoint per host already exists, so the flight dump and
-            # all-thread stacks ride it instead of demanding a second
-            # port (debug/http.py serves the same handlers standalone —
-            # and the same HMAC gate applies on BOTH mounts, or setting
-            # the launch secret would protect one copy of the paths
-            # while this one stayed open).
+            # endpoint per host already exists, so the flight dump, the
+            # last regression report and all-thread stacks ride it
+            # instead of demanding a second port (debug/http.py serves
+            # the same handlers standalone — and the same HMAC gate
+            # applies on BOTH mounts, or setting the launch secret
+            # would protect one copy of the paths while this one stayed
+            # open).
             from ..debug.http import (render_flight_json,
+                                      render_regression_json,
                                       render_stacks_text,
                                       request_authorized)
             key = path.rsplit("/", 1)[1]
@@ -127,6 +130,16 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 return
             if path == "/debug/flight":
                 body, ctype = render_flight_json(), "application/json"
+            elif path == "/debug/regression":
+                body, ctype = render_regression_json(), "application/json"
+                if body is None:
+                    body = b'{"error": "no regression report yet"}'
+                    self.send_response(404)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
             else:
                 body, ctype = (render_stacks_text(),
                                "text/plain; charset=utf-8")

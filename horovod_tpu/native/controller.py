@@ -321,6 +321,11 @@ class NativeController:
             # shift mode before any window is scored (probe traffic is
             # excluded via autotune_paused, so no warmup is lost).
             self._autotune = ParameterManager(**self._autotune_kwargs)
+            # Register with the closed loop (autotune.set_active_manager)
+            # so the drift plane can open re-tune episodes and the
+            # tuning memory can warm-start / write back.
+            from .. import autotune as _autotune_mod
+            _autotune_mod.set_active_manager(self._autotune)
 
     @contextlib.contextmanager
     def autotune_paused(self):
@@ -372,8 +377,10 @@ class NativeController:
             initial_toggles=(0, 0,
                              self._autotune_kwargs["initial_toggles"][2]),
             tune_toggles=tunable + (cache_tunable,))
+        from .. import autotune as _autotune_mod
         from ..autotune import ParameterManager
         self._autotune = ParameterManager(**self._autotune_kwargs)
+        _autotune_mod.set_active_manager(self._autotune)
 
     def _apply_tuned(self, fusion, cycle, hier_allreduce, hier_allgather,
                      cache_enabled, compression="none", overlap=None):
@@ -958,4 +965,10 @@ class NativeController:
         self._lib.hvd_native_stop_timeline()
 
     def shutdown(self):
+        if self._autotune is not None:
+            # Deregister from the closed loop: a drift firing after
+            # shutdown must not reach a tuner whose apply path is gone.
+            from .. import autotune as _autotune_mod
+            if _autotune_mod.active_manager() is self._autotune:
+                _autotune_mod.set_active_manager(None)
         self._lib.hvd_native_shutdown()

@@ -137,6 +137,36 @@ def _overlap_fallback_metric():
     return _overlap_fallback_lat
 
 
+# Comm-side chaos (HVD_TPU_CHAOS_COMM_DELAY_MS): the wire analog of the
+# input pipeline's HVD_TPU_CHAOS_INPUT_DELAY_MS drill — every eager
+# collective pays a deterministic extra delay inside its measured span,
+# so the observatory sees comm_exposed grow and the closed-loop drill
+# (tests/test_tuning_loop.py) can inject a comm regression without
+# touching real hardware.  Read once and cached (this sits on the hot
+# path); reset_comm_chaos() re-reads the knob, the drill's mid-run
+# flip.  Inert unless the knob is set.
+_comm_chaos_delay: Optional[float] = None
+
+
+def _chaos_comm_delay_s() -> float:
+    global _comm_chaos_delay
+    if _comm_chaos_delay is None:
+        from ..core.config import get_float
+        d = max(0.0, get_float("CHAOS_COMM_DELAY_MS", 0.0)) / 1e3
+        _comm_chaos_delay = d
+        if d:
+            # Flight-recorded at activation, like data.chaos_delay: the
+            # drift diagnoser's causal window must contain the cause.
+            _flight.record("net.chaos_delay", "eager", delay_ms=d * 1e3)
+    return _comm_chaos_delay
+
+
+def reset_comm_chaos() -> None:
+    """Re-read HVD_TPU_CHAOS_COMM_DELAY_MS at the next collective."""
+    global _comm_chaos_delay
+    _comm_chaos_delay = None
+
+
 def _wire_sent_bytes(tensor, comp) -> Optional[int]:
     """Bytes the EAGER transport actually moves for ``tensor`` (None
     when unknown).  Cast compressors genuinely shrink the payload before
@@ -197,6 +227,9 @@ def _op_range(kind: str, name, tensor, comp=None):
         with op_range(f"hvd.{kind}.{name or 'unnamed'}", nbytes):
             yield
     finally:
+        chaos = _chaos_comm_delay_s()
+        if chaos:
+            time.sleep(chaos)  # inside the timed span: latency pays it
         ops.inc()
         if nbytes:
             bts.inc(float(nbytes))

@@ -73,15 +73,18 @@ def test_parameter_manager_logs(tmp_path):
     lines = log.read_text().strip().splitlines()
     assert len(lines) == 3  # 2 samples + final
     assert lines[-1].startswith("final,")
-    # Each line records the categorical choices: tag, fusion, cycle,
-    # har, hag, cache, compression, overlap_bucket_bytes, score.
+    # Each line records the categorical choices plus the attribution
+    # vector that motivated the decision: tag, fusion, cycle, har, hag,
+    # cache, compression, overlap_bucket_bytes, score, attr ("-" when
+    # the observatory had nothing — ";"-joined k=v, never a comma).
     for ln in lines:
         cols = ln.split(",")
-        assert len(cols) == 9, cols
+        assert len(cols) == 10, cols
         assert cols[3] in ("0", "1") and cols[4] in ("0", "1") \
             and cols[5] in ("0", "1"), cols
         assert cols[6] in ("none", "bf16", "int8"), cols
         assert int(cols[7]) in ParameterManager.OVERLAP_CHOICES, cols
+        assert cols[9] == "-" or "=" in cols[9], cols
 
 
 def test_parameter_manager_bootstrap_tries_both_toggle_values():
@@ -294,7 +297,7 @@ def test_autotune_disables_hierarchical_on_single_host(tmp_path, monkeypatch):
     # the hierarchical-allreduce toggle were actually sampled.
     lines = [ln.split(",") for ln in
              open(log_file).read().strip().splitlines()]
-    assert all(len(ln) == 9 for ln in lines), lines
+    assert all(len(ln) == 10 for ln in lines), lines
     sampled_har = {ln[3] for ln in lines if ln[0] == "sample"}
     assert sampled_har == {"0", "1"}, lines
     assert lines[-1][0] == "final" and lines[-1][3] == "0", lines

@@ -1,0 +1,719 @@
+"""Closed-loop autotuning tests (ISSUE 12): attribution-guided search,
+drift-triggered bounded re-tune with regression-gated rollback, the
+fleet-level tuning memory, and the loop's own observability.
+
+THE acceptance drill lives here: an injected comm-side regression
+(``HVD_TPU_CHAOS_COMM_DELAY_MS`` through the real eager collective
+span) must — with no operator input — fire the drift detector with
+component ``comm_exposed``, open a bounded re-tune episode on the
+frozen tuner, find nothing that recovers the pre-drift baseline (the
+chaos is external), roll back to the last-known-good config, and leave
+the whole decision trail in metrics, flight events and the regression
+report's ``tuning`` section.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import autotune as at
+from horovod_tpu import metrics
+from horovod_tpu.autotune import ParameterManager
+from horovod_tpu.debug import flight, regression
+from horovod_tpu.fleet import tuning as T
+from horovod_tpu.metrics.aggregate import Aggregator
+from horovod_tpu.metrics.attribution import (
+    attribution, reset_peak_cache, set_enabled as set_attr_enabled,
+)
+from horovod_tpu.metrics.baseline import (
+    drift_detector, reset_drift_detector, set_drift_enabled,
+)
+from horovod_tpu.ops import collective as C
+
+
+@pytest.fixture(autouse=True)
+def _fresh_loop(monkeypatch):
+    """The loop rides process-global state (active tuner, observatory,
+    drift detector, comm chaos cache, last report) — every test starts
+    and leaves it clean."""
+    monkeypatch.delenv("HVD_TPU_CHAOS_COMM_DELAY_MS", raising=False)
+    at.set_active_manager(None)
+    attribution().reset()
+    reset_drift_detector()
+    reset_peak_cache()
+    set_attr_enabled(None)
+    set_drift_enabled(None)
+    regression.reset()
+    C.reset_comm_chaos()
+    yield
+    at.set_active_manager(None)
+    attribution().reset()
+    reset_drift_detector()
+    reset_peak_cache()
+    set_attr_enabled(None)
+    set_drift_enabled(None)
+    regression.reset()
+    C.reset_comm_chaos()
+
+
+def _pm(**overrides):
+    kwargs = dict(apply_fn=lambda *p: None, max_samples=8,
+                  window_seconds=0.0, warmup_samples=0,
+                  attribution_source=lambda: None)
+    kwargs.update(overrides)
+    return ParameterManager(**kwargs)
+
+
+def _scalars():
+    return metrics.registry().scalars()
+
+
+# ---------------------------------------------------------------------------
+# tuning memory: stores, keys, schema guard
+# ---------------------------------------------------------------------------
+
+def test_local_store_roundtrip_and_durability(tmp_path):
+    store = T.LocalTuningStore(str(tmp_path / "mem"))
+    key = T.config_key("fp", 4, "l2")
+    assert store.get(key) is None
+    rec = T.make_record({"fusion_bytes": 1 << 26, "cycle_ms": 2.5,
+                         "hierarchical_allreduce": False,
+                         "hierarchical_allgather": False,
+                         "cache_enabled": True, "compression": "int8",
+                         "overlap_bucket_bytes": 8 << 20},
+                        score=1e9, dims=("a", "b"))
+    store.put(key, rec)
+    # A fresh instance over the same dir sees the committed record (the
+    # tmp+fsync+rename discipline: the file on disk is always whole).
+    store2 = T.LocalTuningStore(str(tmp_path / "mem"))
+    got = store2.get(key)
+    assert got["config"]["compression"] == "int8"
+    assert got["score"] == 1e9
+    assert got["schema"] == T.SCHEMA_VERSION
+    assert not list((tmp_path / "mem").glob("*.tmp.*"))
+
+
+def test_config_key_separates_model_world_topology():
+    k = T.config_key("fp", 4, "l2")
+    assert k != T.config_key("fp2", 4, "l2")
+    assert k != T.config_key("fp", 8, "l2")
+    assert k != T.config_key("fp", 4, "l4")
+    assert k == T.config_key("fp", 4, "l2")
+
+
+def test_model_fingerprint_matches_leaf_specs():
+    tree = {"w": np.zeros((4, 4), np.float32),
+            "b": np.zeros((4,), np.float32)}
+    fp = T.model_fingerprint(tree)
+    assert fp == T.model_fingerprint(
+        {"w": np.ones((4, 4), np.float32),
+         "b": np.ones((4,), np.float32)})  # values don't matter
+    assert fp != T.model_fingerprint(
+        {"w": np.zeros((4, 8), np.float32),
+         "b": np.zeros((4,), np.float32)})  # structure does
+
+
+def test_store_refuses_mismatched_dims_and_schema(tmp_path):
+    """The satellite guard: PR 5 and PR 11 each grew the GP
+    dimensionality — a record tuned over an older knob space must be
+    refused loudly, never silently mis-seeded."""
+    store = T.LocalTuningStore(str(tmp_path))
+    key = T.config_key("fp", 1, "flat")
+    store.put(key, T.make_record({"compression": "int8"},
+                                 dims=("old_dim_a", "old_dim_b")))
+    with pytest.raises(T.TuningSchemaMismatch) as ei:
+        store.get(key, dims=("new_dim_a", "new_dim_b", "new_dim_c"))
+    assert "refusing to warm-start" in str(ei.value).lower() \
+        or "refusing" in str(ei.value).lower()
+    assert "old_dim_a" in str(ei.value)
+    # Schema-version drift refuses too.
+    rec = T.make_record({"x": 1}, dims=("d",))
+    rec["schema"] = T.SCHEMA_VERSION + 1
+    store._flush({key: rec})
+    with pytest.raises(T.TuningSchemaMismatch):
+        store.get(key, dims=("d",))
+
+
+def test_pm_gp_dims_reflect_mode():
+    assert _pm().gp_dims()[2] == "hier_allreduce:bool"
+    assert _pm(dispatch_shifts=True,
+               initial_toggles=(0, 0, True)).gp_dims()[2] == \
+        "hier_allreduce:shift3"
+    # The dims tuple is exactly what the store compares: bool-mode and
+    # shift-mode records never cross-seed.
+    assert _pm().gp_dims() != _pm(dispatch_shifts=True,
+                                  initial_toggles=(0, 0, True)).gp_dims()
+
+
+# ---------------------------------------------------------------------------
+# warm start
+# ---------------------------------------------------------------------------
+
+def test_warm_start_seeds_stored_config():
+    pm1 = _pm(max_samples=3, tune_compression=True,
+              initial_toggles=(True, False, True))
+    # Synthetic objective: int8 + har-off wins.
+    while not pm1.frozen:
+        _, _, har, _, _, comp, _ = pm1.current
+        pm1._observe(1e9 * (1.3 if comp == "int8" else 1.0)
+                     * (0.8 if har else 1.0))
+    rec = T.make_record(pm1.config_dict(), score=pm1._frozen_score,
+                        dims=pm1.gp_dims())
+
+    pm2 = _pm(max_samples=3, tune_compression=True,
+              initial_toggles=(True, False, True))
+    before = _scalars().get("hvd_autotune_warm_starts_total", 0)
+    assert pm2.warm_start(rec)
+    # The stored config is APPLIED immediately — window 0, not after a
+    # bootstrap sweep.
+    assert pm2.config_dict() == pm1.config_dict()
+    assert pm2._warm_started
+    assert _scalars()["hvd_autotune_warm_starts_total"] == before + 1
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "autotune.warm_start" in kinds
+
+
+def test_warm_start_refused_after_tuning_started():
+    pm = _pm(max_samples=4)
+    pm._observe(1.0)
+    rec = T.make_record(pm.config_dict(), dims=pm.gp_dims())
+    assert pm.warm_start(rec) is False
+
+
+def test_warm_start_raises_on_dims_mismatch():
+    pm = _pm()
+    rec = T.make_record({"compression": "int8"}, dims=("stale",))
+    with pytest.raises(ValueError) as ei:
+        pm.warm_start(rec)
+    assert "refusing" in str(ei.value)
+
+
+def test_warm_start_respects_operator_pins():
+    """A stored record must never override an explicit operator pin —
+    the pinned dim keeps its pinned value."""
+    pm = _pm(tune_toggles=(False, False, False),
+             initial_toggles=(False, False, True),
+             initial_compression="none", tune_compression=False)
+    donor = _pm(tune_toggles=True, tune_compression=True,
+                initial_toggles=(False, False, True))
+    rec = T.make_record(
+        {"fusion_bytes": 1 << 24, "cycle_ms": 2.0,
+         "hierarchical_allreduce": True, "hierarchical_allgather": True,
+         "cache_enabled": False, "compression": "int8",
+         "overlap_bucket_bytes": 0},
+        dims=pm.gp_dims())
+    del donor
+    assert pm.warm_start(rec)
+    cfg = pm.config_dict()
+    assert cfg["hierarchical_allreduce"] is False   # pinned
+    assert cfg["compression"] == "none"             # pinned
+    assert cfg["fusion_bytes"] == 1 << 24           # numeric seeded
+
+
+def test_announce_model_roundtrip_via_local_store(tmp_path, monkeypatch):
+    """End-to-end memory: job 1 tunes cold and freezes → write-back;
+    job 2 with the same model announces and starts warm."""
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_MEMORY_DIR",
+                       str(tmp_path / "mem"))
+    monkeypatch.delenv("HVD_TPU_FLEET_ADDR", raising=False)
+    tree = {"w": np.zeros((8, 8), np.float32)}
+
+    pm1 = _pm(max_samples=2)
+    at.set_active_manager(pm1)
+    key = at.announce_model(tree)
+    assert key is not None
+    pm1._observe(100.0)
+    pm1._observe(120.0)
+    assert pm1.frozen
+    rec = T.LocalTuningStore(str(tmp_path / "mem")).get(key)
+    assert rec is not None and rec["config"] == pm1.config_dict()
+
+    pm2 = _pm(max_samples=2)
+    at.set_active_manager(pm2)
+    assert at.announce_model(tree) == key
+    assert pm2._warm_started
+    assert pm2.config_dict() == pm1.config_dict()
+
+
+def test_announce_model_mismatched_dims_starts_cold(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_MEMORY_DIR",
+                       str(tmp_path / "mem"))
+    monkeypatch.delenv("HVD_TPU_FLEET_ADDR", raising=False)
+    tree = {"w": np.zeros((3,), np.float32)}
+    pm1 = _pm(max_samples=1, dispatch_shifts=True,
+              initial_toggles=(0, 0, True))
+    at.set_active_manager(pm1)
+    key = at.announce_model(tree)
+    pm1._observe(1.0)
+    assert pm1.frozen
+    # Same model, but the knob space reverted to bool mode: the stored
+    # shift-mode record must be refused, the job tunes cold.
+    pm2 = _pm(max_samples=1)
+    at.set_active_manager(pm2)
+    assert at.announce_model(tree) == key
+    assert not pm2._warm_started
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "autotune.memory_reject" in kinds
+
+
+# ---------------------------------------------------------------------------
+# bootstrap coverage: warmup replay + attribution-guided ordering
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_plan_replays_after_warmup():
+    """Satellite regression test: warmup windows are discarded WITHOUT
+    consuming bootstrap-plan entries — every categorical arm is scored
+    exactly once after warmup ends."""
+    pm = _pm(max_samples=8, warmup_samples=3,
+             initial_toggles=(True, False, True))
+    scored = []
+    orig = pm._opt.observe
+    pm._opt.observe = lambda x, y: (scored.append(pm.current[2:5]),
+                                    orig(x, y))
+    for _ in range(3 + 4):  # 3 warmup windows + the 4 bootstrap arms
+        pm.record_bytes(1000)
+    assert scored == [(True, False, True),   # configured combo
+                      (False, False, True),  # har flipped
+                      (True, True, True),    # hag flipped
+                      (True, False, False)]  # cache flipped
+    # And the warmup windows really were discarded, not scored.
+    assert pm._samples == 4
+
+
+def test_attribution_guided_plan_pulls_comm_arms_forward():
+    """A comm-bound window reorders the bootstrap toward the comm knobs
+    (compression before the host-side cache flip); a compute-bound
+    window keeps the declared order.  Every arm still runs."""
+    comm = {"compute": 0.35, "comm_exposed": 0.45, "input": 0.05,
+            "checkpoint": 0.0, "host": 0.15}
+    host = {"compute": 0.85, "comm_exposed": 0.05, "input": 0.05,
+            "checkpoint": 0.0, "host": 0.05}
+
+    def run(shares):
+        seen = []
+        pm = ParameterManager(
+            apply_fn=lambda *p: seen.append((p[4], p[5])),
+            max_samples=10, window_seconds=0.0, warmup_samples=0,
+            attribution_source=lambda: shares,
+            # Pin the hier toggles so the plan is [base, cache(host),
+            # bf16(comm), int8(comm)] — order is the observable.
+            tune_toggles=(False, False, True), tune_compression=True)
+        for _ in range(4):
+            pm.record_bytes(1000)
+        return seen, pm
+
+    seen_comm, pm_comm = run(comm)
+    # base applied first; then the COMM arms (wire formats) before the
+    # host-side cache flip.
+    assert seen_comm[1][1] != "none" and seen_comm[2][1] != "none"
+    assert seen_comm[3][0] is False  # cache arm still ran, last
+    seen_host, _ = run(host)
+    # Compute/host-bound: declared order — cache flip right after base.
+    assert seen_host[1][0] is False
+    assert {c for _, c in seen_host} == {"none", "bf16", "int8"}
+
+
+def test_decision_records_carry_attribution(tmp_path):
+    shares = {"compute": 0.3, "comm_exposed": 0.5, "input": 0.1,
+              "checkpoint": 0.0, "host": 0.1}
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(
+        apply_fn=lambda *p: None, max_samples=2, window_seconds=0.0,
+        warmup_samples=0, log_file=str(log),
+        attribution_source=lambda: shares)
+    pm.record_bytes(100)
+    pm.record_bytes(100)
+    assert pm.frozen
+    # CSV: 10 columns, the last the ;-joined attribution vector.
+    lines = [ln.split(",") for ln in
+             log.read_text().strip().splitlines()]
+    assert all(len(ln) == 10 for ln in lines), lines
+    assert any("comm_exposed=0.500" in ln[9] for ln in lines), lines
+    # Flight: autotune.decision events carry attr + reason.
+    evs = [e for e in flight.snapshot()
+           if e["kind"] == "autotune.decision"]
+    assert evs
+    assert any(e.get("attr", {}) and
+               e["attr"].get("comm_exposed") == 0.5 for e in evs)
+    assert all("reason" in e for e in evs)
+    # Journal mirrors the trail.
+    assert pm.journal()
+    assert pm.journal()[-1]["attr"]["comm_exposed"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# re-tune episodes: rollback gate and acceptance
+# ---------------------------------------------------------------------------
+
+def _frozen_pm(score=100.0, **overrides):
+    pm = _pm(max_samples=1, **overrides)
+    pm._observe(score)
+    assert pm.frozen and pm._frozen_score == score
+    return pm
+
+
+def test_retune_rolls_back_when_nothing_recovers_baseline(monkeypatch):
+    applied = []
+    pm = _frozen_pm(100.0, apply_fn=lambda *p: applied.append(p))
+    good = pm.current
+    before = _scalars().get("hvd_autotune_rollbacks_total", 0)
+    assert pm.request_retune(reason="test", windows=3)
+    assert not pm.frozen
+    for s in (40.0, 35.0, 30.0):  # every candidate far below baseline
+        pm._observe(s)
+    assert pm.frozen
+    assert pm.current == good  # rolled back to last-known-good
+    assert applied[-1] == good
+    assert pm._frozen_score == 100.0  # baseline stands
+    st = pm.loop_status()
+    assert st["retunes"] == 1 and st["rollbacks"] == 1
+    assert st["last_outcome"]["outcome"] == "rolled_back"
+    flat = _scalars()
+    assert flat["hvd_autotune_rollbacks_total"] == before + 1
+    assert flat["hvd_autotune_score_ratio"] == pytest.approx(0.4)
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "autotune.retune" in kinds and "autotune.rollback" in kinds
+
+
+def test_retune_accepts_recovering_config():
+    pm = _frozen_pm(100.0)
+    assert pm.request_retune(windows=3)
+    pm._observe(95.0)    # incumbent, re-measured post-drift
+    pm._observe(140.0)   # a proposal that beats the baseline
+    pm._observe(90.0)
+    assert pm.frozen
+    assert pm._frozen_score == 140.0
+    st = pm.loop_status()
+    assert st["last_outcome"]["outcome"] == "accepted"
+    assert st["rollbacks"] == 0
+    assert _scalars()["hvd_autotune_score_ratio"] == pytest.approx(1.4)
+
+
+def test_retune_confirms_incumbent_within_gate(monkeypatch):
+    """A small dip (inside the rollback tolerance) with the incumbent
+    still best is a CONFIRMED episode, not a rollback."""
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_ROLLBACK_PCT", "10")
+    pm = _frozen_pm(100.0)
+    assert pm.request_retune(windows=2)
+    pm._observe(96.0)  # incumbent under post-drift conditions
+    pm._observe(93.0)
+    assert pm.frozen
+    assert pm.loop_status()["last_outcome"]["outcome"] == "confirmed"
+    assert pm.current is not None
+
+
+def test_retune_refused_while_exploring():
+    pm = _pm(max_samples=10)
+    assert pm.request_retune() is False  # not frozen yet
+
+
+def test_retune_proposals_are_gp_not_leftover_bootstrap():
+    """A tuner can freeze with bootstrap arms still queued (max_samples
+    below the plan length); a re-tune episode must propose through the
+    GP — with comm focus — not replay stale pre-drift arms labeled
+    'bootstrap'."""
+    pm = _pm(max_samples=1, tune_compression=True, tune_overlap=True,
+             initial_overlap=0)
+    pm._observe(100.0)
+    assert pm.frozen and pm._toggle_plan  # froze mid-plan
+    assert pm.request_retune(windows=3, focus_component="comm_exposed")
+    plan_before = list(pm._toggle_plan)
+    pm._observe(50.0)  # incumbent window → first episode proposal
+    assert pm._reason == "retune"
+    assert pm.journal()[-1]["reason"] == "retune_incumbent"
+    pm._observe(45.0)
+    assert pm.journal()[-1]["reason"] == "retune"
+    pm._observe(40.0)
+    assert pm.frozen
+    # The stale arms were not consumed by the episode.
+    assert pm._toggle_plan == plan_before
+
+
+def test_notify_drift_gates_and_records():
+    class _Ev:
+        component = "comm_exposed"
+
+    # No active tuner → no action (and no crash).
+    assert at.notify_drift(_Ev(), None) is False
+    # Non-tunable suspect + non-comm component → refused.
+    pm = _frozen_pm(50.0)
+    at.set_active_manager(pm)
+    class _EvInput:
+        component = "input"
+    rep = {"suspect": {"subsystem": "data"}}
+    assert at.notify_drift(_EvInput(), rep) is False
+    assert pm.frozen  # untouched
+    # Tunable: comm_exposed component opens an episode.
+    assert at.notify_drift(_Ev(), rep) is True
+    assert not pm.frozen and pm._retune_left > 0
+
+
+def test_notify_drift_knob_off(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_RETUNE", "0")
+    pm = _frozen_pm(50.0)
+    at.set_active_manager(pm)
+    class _Ev:
+        component = "comm_exposed"
+    assert at.notify_drift(_Ev(), None) is False
+    assert pm.frozen
+
+
+def test_record_tuning_amends_report_and_disk(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+
+    class _Fake:
+        step = 7
+        onset_step = 5
+        onset_wall = time.time()
+        onset_mono = time.monotonic()
+        ratio = 2.0
+        component = "comm_exposed"
+        baseline_s = 0.01
+        current_s = 0.02
+        share_delta = 0.2
+
+        def as_dict(self):
+            return {"step": 7}
+
+    rep = regression.build_regression_report(_Fake(), events=[])
+    assert rep["tuning"] is None
+    regression.record_tuning({"action": "retune", "outcome": "started"})
+    regression.record_tuning({"outcome": "rolled_back",
+                              "score_ratio": 0.4})
+    got = regression.last_report()["tuning"]
+    assert got["action"] == "retune"
+    assert got["outcome"] == "rolled_back"  # later info wins
+    on_disk = json.load(open(rep["path"]))
+    assert on_disk["tuning"]["outcome"] == "rolled_back"
+
+
+# ---------------------------------------------------------------------------
+# gateway tuning endpoints + /debug/regression
+# ---------------------------------------------------------------------------
+
+def test_gateway_tuning_get_put_roundtrip(tmp_path):
+    from horovod_tpu import fleet
+    gw = fleet.FleetGateway([], port=0, fleet_dir=str(tmp_path / "fl"),
+                            secret="tunesec")
+    port = gw.start()  # HTTP plane only — no scheduler needed here
+    try:
+        addr = f"127.0.0.1:{port}"
+        store = T.GatewayTuningStore(addr, secret="tunesec")
+        key = T.config_key("fp", 2, "l2")
+        assert store.get(key) is None  # 404 → miss, not an error
+        rec = T.make_record({"compression": "int8"}, score=2e9,
+                            dims=("d1", "d2"))
+        store.put(key, rec)
+        got = store.get(key, dims=("d1", "d2"))
+        assert got["config"]["compression"] == "int8"
+        # Dims guard applies to gateway records too.
+        with pytest.raises(T.TuningSchemaMismatch):
+            store.get(key, dims=("other",))
+        # Unsigned requests are rejected like every fleet endpoint.
+        with pytest.raises(PermissionError):
+            T.GatewayTuningStore(addr, secret="wrong").put(key, rec)
+        # Durable: a fresh gateway over the same dir still serves it.
+        assert gw.tuning.get(key)["score"] == 2e9
+    finally:
+        gw.stop()
+
+
+def test_debug_regression_endpoint(tmp_path, monkeypatch):
+    """Satellite: the last regression report is served beside
+    /debug/flight under the same HMAC trust model — previously only
+    reachable via shared disk."""
+    from horovod_tpu.debug import http as dhttp
+    from horovod_tpu.runner.rendezvous import sign_request
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_SECRET", "s3cret")
+    srv = dhttp.DebugServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/regression"
+        # Unsigned → 403 even before a report exists.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 403
+        # Signed but no report yet → 404.
+        req = urllib.request.Request(url)
+        sign_request(req, "GET", "debug", "regression")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 404
+
+        class _Fake:
+            step = 3
+            onset_step = 1
+            onset_wall = time.time()
+            onset_mono = time.monotonic()
+            ratio = 1.8
+            component = "input"
+            baseline_s = 0.01
+            current_s = 0.018
+            share_delta = 0.3
+
+            def as_dict(self):
+                return {"step": 3}
+
+        regression.build_regression_report(_Fake(), events=[])
+        req = urllib.request.Request(url)
+        sign_request(req, "GET", "debug", "regression")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            served = json.loads(r.read().decode())
+        assert served["kind"] == "perf_regression"
+        assert served["component"] == "input"
+    finally:
+        srv.stop()
+
+
+def test_metrics_port_mounts_regression_endpoint(tmp_path, monkeypatch):
+    from horovod_tpu.metrics.exporters import MetricsServer
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+
+    class _Fake:
+        step = 9
+        onset_step = 8
+        onset_wall = time.time()
+        onset_mono = time.monotonic()
+        ratio = 1.5
+        component = "compute"
+        baseline_s = 0.01
+        current_s = 0.015
+        share_delta = 0.1
+
+        def as_dict(self):
+            return {"step": 9}
+
+    regression.build_regression_report(_Fake(), events=[])
+    srv = MetricsServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/regression"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            served = json.loads(r.read().decode())
+        assert served["component"] == "compute"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: injected comm regression → detect → re-tune →
+# rollback → resolution in the report, all without operator input
+# ---------------------------------------------------------------------------
+
+def _drill_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_PERF_DRIFT_WARMUP", "10")
+    monkeypatch.setenv("HVD_TPU_PERF_DRIFT_THRESHOLD", "6")
+    monkeypatch.setenv("HVD_TPU_PERF_DRIFT_MIN_PCT", "50")
+    monkeypatch.setenv("HVD_TPU_PERF_DRIFT_COOLDOWN", "100")
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_RETUNE_WINDOWS", "3")
+    reset_drift_detector()
+
+
+@pytest.mark.timeout(120)
+def test_closed_loop_drill_comm_regression_rolls_back(
+        monkeypatch, tmp_path):
+    _drill_env(monkeypatch, tmp_path)
+    payload = np.ones((64, 256), dtype=np.float32)  # 64 KB "gradient"
+    agg = Aggregator()
+    # The live tuner: froze on the steady regime before the drill, its
+    # windows scored from the same loop the drill drives (steps_per_
+    # sample=1: every step closes a window, score = bytes / step time).
+    pm = ParameterManager(apply_fn=lambda *p: None, max_samples=3,
+                          window_seconds=0.0, warmup_samples=1,
+                          steps_per_sample=1)
+    at.set_active_manager(pm)
+
+    step_idx = {"i": 0}
+
+    def one_step():
+        with C._op_range("allreduce", "grad", payload):
+            pass  # chaos delay (when armed) lands inside this span
+        time.sleep(0.003)  # the compute half of the step
+        pm.record_bytes(payload.nbytes)
+        step_idx["i"] += 1
+        agg.step_end(step=step_idx["i"])
+
+    for _ in range(20):  # steady phase: tuner freezes, baseline learns
+        one_step()
+    assert pm.frozen, "tuner must be frozen before the drill"
+    baseline_score = pm._frozen_score
+    good = pm.current
+    assert drift_detector().events() == []
+
+    # The injection: every collective now pays 30 ms on the wire.
+    monkeypatch.setenv("HVD_TPU_CHAOS_COMM_DELAY_MS", "30")
+    C.reset_comm_chaos()
+    for _ in range(45):
+        one_step()
+        st = pm.loop_status()
+        if st["retunes"] and not st["retuning"]:
+            break  # episode resolved
+
+    # 1. The drift fired, attributed to exposed comm.
+    events = drift_detector().events()
+    assert len(events) >= 1
+    assert events[0].component == "comm_exposed"
+    # 2. The loop opened a bounded episode and — the chaos being
+    #    external, nothing recovers the baseline — rolled back.
+    st = pm.loop_status()
+    assert st["retunes"] == 1
+    assert st["rollbacks"] == 1
+    assert st["frozen"] and not st["retuning"]
+    assert pm.current == good
+    assert pm._frozen_score == baseline_score
+    assert st["last_outcome"]["outcome"] == "rolled_back"
+    assert st["last_outcome"]["score_ratio"] < 0.7
+    # 3. The decision trail: metrics...
+    flat = _scalars()
+    assert flat["hvd_autotune_retunes_total"] >= 1
+    assert flat["hvd_autotune_rollbacks_total"] >= 1
+    assert flat["hvd_autotune_score_ratio"] < 0.7
+    #    ...flight events (the diagnoser's causal vocabulary covers
+    #    them all)...
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "perf.drift" in kinds
+    for k in ("net.chaos_delay", "autotune.retune", "autotune.rollback"):
+        assert k in kinds, (k, sorted(set(kinds)))
+        # The causal vocabulary covers the loop's events (perf.* — the
+        # diagnoser's own output — deliberately stays out).
+        assert regression._classify(k) is not None, k
+    #    ...and the regression report's tuning section names the
+    #    resolution, on disk too.
+    rep = regression.last_report()
+    assert rep is not None
+    assert rep["component"] == "comm_exposed"
+    assert rep["suspect"]["subsystem"] in ("net", "autotune")
+    assert rep["tuning"]["action"] == "retune"
+    assert rep["tuning"]["outcome"] == "rolled_back"
+    on_disk = json.load(open(rep["path"]))
+    assert on_disk["tuning"]["outcome"] == "rolled_back"
+
+
+@pytest.mark.timeout(120)
+def test_closed_loop_drill_steady_run_stays_closed(monkeypatch, tmp_path):
+    """The control arm: the identical loop with no chaos never fires
+    the detector and never perturbs the frozen tuner."""
+    _drill_env(monkeypatch, tmp_path)
+    payload = np.ones((64, 256), dtype=np.float32)
+    agg = Aggregator()
+    pm = ParameterManager(apply_fn=lambda *p: None, max_samples=3,
+                          window_seconds=0.0, warmup_samples=1,
+                          steps_per_sample=1)
+    at.set_active_manager(pm)
+    for i in range(45):
+        with C._op_range("allreduce", "grad", payload):
+            pass
+        time.sleep(0.003)
+        pm.record_bytes(payload.nbytes)
+        agg.step_end(step=i + 1)
+    assert pm.frozen
+    assert drift_detector().events() == []
+    assert pm.loop_status()["retunes"] == 0
+    assert regression.last_report() is None
