@@ -2713,6 +2713,257 @@ def bench_net_resilience():
     })
 
 
+def _control_plane_fleet(ranks, steps=20, straggler=None, seed=7):
+    """Synthetic per-rank snapshots shaped like production ones: a
+    ~real-sized flat scalar map (~120 keys — the live registry emits
+    ~70 families), windowed sums, a per-step sketch and component
+    attribution.  One injected straggler (2.2x, checkpoint-bound) so
+    the flat and tree paths have a verdict to agree on."""
+    import random as _random
+
+    from horovod_tpu.metrics.digest import QuantileSketch
+
+    rng = _random.Random(seed)
+    scal_keys = [f"hvd_family_{i}_total" for i in range(100)] + \
+        [f"hvd_gauge_{i}" for i in range(20)]
+    snaps = []
+    for r in range(ranks):
+        slow = 2.2 if r == straggler else 1.0
+        times = [0.1 * slow * (1.0 + 0.05 * rng.random())
+                 for _ in range(steps)]
+        ckpt = 0.1 * (slow - 1.0) * steps  # the excess is checkpoint
+        wall = sum(times)
+        snaps.append({
+            "rank": r, "step": steps,
+            "step_time_sum": wall, "step_count": steps,
+            "data_wait_sum": 0.002 * steps, "data_wait_count": steps,
+            "sketch": QuantileSketch.of(times).to_dict(),
+            "attr": {"steps": float(steps), "flops": 0.0, "wall": wall,
+                     "compute": wall - ckpt - 0.004 * steps,
+                     "comm_exposed": 0.002 * steps,
+                     "input": 0.002 * steps, "checkpoint": ckpt,
+                     "host": 0.0},
+            "scalars": {k: float(rng.randrange(1 << 20))
+                        for k in scal_keys},
+        })
+    return snaps
+
+
+def _counted_kv():
+    """A rendezvous KV whose handled bytes are counted in both
+    directions — the coordination fabric under measurement."""
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    srv = RendezvousServer(host="127.0.0.1")
+    srv.start()
+    counts = {"in": 0, "out": 0}
+    kv = srv._server
+    orig_put, orig_get = kv.store_put, kv.store_get
+
+    def put(scope, key, value):
+        counts["in"] += len(value)
+        orig_put(scope, key, value)
+
+    def get(scope, key):
+        v = orig_get(scope, key)
+        counts["out"] += len(v or b"")
+        return v
+
+    kv.store_put, kv.store_get = put, get
+    return srv, counts
+
+
+def bench_control_plane():
+    """Control-plane scale-out soak (ISSUE 13 / ROADMAP item 4): fake
+    workers, REAL digest/merge/observer/gateway code paths, measuring
+    what the coordination fabric (one rendezvous KV) handles per
+    metrics sync round — flat (one raw snapshot per rank through the
+    coordinator) vs tree (intra-host digest merge, one digest per
+    host) — at 4/64/256/1000 simulated ranks (8 ranks/host, so the
+    1000-rank point is 125 hosts).  Verdict parity: the straggler
+    flag set and its per-component cause must MATCH between paths on
+    the same synthetic fleet at every scale.  Emits
+    BENCH_CONTROL_PLANE.json.  Select with
+    `bench.py --bench control_plane`."""
+    import math as _math
+    from concurrent.futures import ThreadPoolExecutor
+
+    from horovod_tpu.metrics import digest as _dig
+    from horovod_tpu.metrics.health import StragglerDetector
+    from horovod_tpu.runner.rendezvous import http_get, http_put
+
+    local_size = 8
+    rounds = int(os.environ.get("BENCH_CP_ROUNDS", "2"))
+    scales = [int(s) for s in os.environ.get(
+        "BENCH_CP_SCALES", "4,64,256,1000").split(",")]
+
+    def flat_round(addr, snaps, det):
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(
+                lambda s: http_put(addr, "metrics",
+                                   f"snap_{s['rank']}",
+                                   json.dumps(s).encode()), snaps))
+        t0 = time.perf_counter()
+        gathered = []
+        for r in range(len(snaps)):
+            raw = http_get(addr, "metrics", f"snap_{r}", timeout=10)
+            gathered.append(json.loads(raw.decode()))
+        report = det.score_ranks(gathered)
+        wall = time.perf_counter() - t0
+        return wall, [(h.rank, h.cause) for h in report if h.flagged]
+
+    def tree_round(addr, snaps, det):
+        hosts = [snaps[i:i + local_size]
+                 for i in range(0, len(snaps), local_size)]
+        # Host-side pre-merge: real digest build, NOT coordinator work.
+        digests = []
+        for h, host_snaps in enumerate(hosts):
+            d = _dig.snapshot_digest(
+                host_snaps, host=f"host{h}",
+                expected_ranks=[s["rank"] for s in host_snaps])
+            digests.append(d)
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(
+                lambda hd: http_put(addr, "observe",
+                                    f"digest_{hd[0]}",
+                                    json.dumps(hd[1]).encode()),
+                enumerate(digests)))
+        t0 = time.perf_counter()
+        gathered = []
+        for h in range(len(hosts)):
+            raw = http_get(addr, "observe", f"digest_{h}", timeout=10)
+            gathered.append(json.loads(raw.decode()))
+        fleet = _dig.merge_all(gathered)
+        http_put(addr, "observe", "fleet", json.dumps(fleet).encode())
+        report = det.score_digest(fleet)
+        wall = time.perf_counter() - t0
+        return wall, [(h.rank, h.cause) for h in report if h.flagged]
+
+    results = []
+    parity_ok = True
+    for ranks in scales:
+        hosts = _math.ceil(ranks / local_size)
+        snaps = _control_plane_fleet(ranks, straggler=ranks - 1)
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3,
+                                patience=1)
+        per_mode = {}
+        for mode, fn in (("flat", flat_round), ("tree", tree_round)):
+            srv, counts = _counted_kv()
+            addr = f"127.0.0.1:{srv.port}"
+            walls, flags = [], None
+            try:
+                for _ in range(rounds):
+                    counts["in"] = counts["out"] = 0
+                    wall, flags = fn(addr, snaps, det)
+                    walls.append(wall)
+                per_mode[mode] = {
+                    "bytes_per_round": counts["in"] + counts["out"],
+                    "coord_wall_s_min": min(walls),
+                    "coord_wall_s_mean": sum(walls) / len(walls),
+                    "flagged": flags,
+                }
+            finally:
+                srv.stop()
+        agree = per_mode["flat"]["flagged"] == per_mode["tree"]["flagged"]
+        parity_ok = parity_ok and agree
+        ratio_bytes = per_mode["flat"]["bytes_per_round"] / max(
+            per_mode["tree"]["bytes_per_round"], 1)
+        ratio_wall = per_mode["flat"]["coord_wall_s_min"] / max(
+            per_mode["tree"]["coord_wall_s_min"], 1e-9)
+        results.append({
+            "ranks": ranks, "hosts": hosts,
+            "flat": per_mode["flat"], "tree": per_mode["tree"],
+            "ratio_bytes": round(ratio_bytes, 2),
+            "ratio_wall": round(ratio_wall, 2),
+            "verdicts_agree": agree,
+        })
+        sys.stderr.write(
+            f"control_plane: {ranks} ranks / {hosts} hosts — bytes "
+            f"{per_mode['flat']['bytes_per_round']} vs "
+            f"{per_mode['tree']['bytes_per_round']} "
+            f"({ratio_bytes:.1f}x), coord wall "
+            f"{per_mode['flat']['coord_wall_s_min']*1e3:.0f} ms vs "
+            f"{per_mode['tree']['coord_wall_s_min']*1e3:.0f} ms, "
+            f"verdicts {'AGREE' if agree else 'DIVERGE'}\n")
+
+    # End-to-end drill at 64 ranks: REAL HostObservers exchanging over
+    # the KV + REAL gateway ingest — the wiring the measured rounds
+    # abstract (in-process snapshot submits stand in for rank HTTP).
+    e2e = _control_plane_e2e_drill(local_size)
+
+    payload = {
+        "bench": "control_plane",
+        "local_size": local_size,
+        "rounds_per_scale": rounds,
+        "scales": results,
+        "parity_ok": parity_ok,
+        "e2e": e2e,
+        "methodology": (
+            "bytes = KV-handled in+out per sync round (flat: every "
+            "rank's raw snapshot through the coordinator; tree: one "
+            "host digest per host).  coord wall = gather+parse+merge+"
+            "score on the coordinator, best-of rounds.  Fake workers, "
+            "real digest/merge/score code; e2e drill runs real "
+            "observers + gateway."),
+    }
+    with open("BENCH_CONTROL_PLANE.json", "w") as f:
+        json.dump(payload, f, indent=1)
+    _emit(payload)
+    return payload
+
+
+def _control_plane_e2e_drill(local_size, hosts=8):
+    """Real observers, real KV exchange, real gateway timeline —
+    64 simulated ranks on 8 in-process host observers."""
+    import tempfile
+
+    import horovod_tpu.fleet as fleet
+    from horovod_tpu.metrics.observer import HostObserver
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    ranks = hosts * local_size
+    snaps = _control_plane_fleet(ranks, straggler=ranks - 1)
+    kv = RendezvousServer(host="127.0.0.1")
+    kv.start()
+    rdv = f"127.0.0.1:{kv.port}"
+    gw = fleet.FleetGateway(
+        hosts=[], port=0,
+        fleet_dir=tempfile.mkdtemp(prefix="hvd_cp_bench_"))
+    gw_port = gw.serve()
+    observers = []
+    try:
+        t0 = time.perf_counter()
+        for h in range(hosts):
+            local = list(range(h * local_size, (h + 1) * local_size))
+            observers.append(HostObserver(
+                f"host{h}", local, cross_rank=h, cross_size=hosts,
+                rdv_addr=rdv).start())
+        for h, ob in enumerate(observers):
+            for r in ob.local_ranks:
+                ob.submit_snapshot(1, snaps[r])
+        fleets = [ob.fleet_digest(min_round=1, wait_s=30)
+                  for ob in observers]
+        exchange_s = time.perf_counter() - t0
+        ok = all(f is not None and f.get("ranks") == ranks
+                 for f in fleets)
+        for ob in observers:
+            fleet.push_observation("soak_job", ob.host_digest(),
+                                   addr=f"127.0.0.1:{gw_port}")
+        series = fleet.get_observation(
+            "soak_job", addr=f"127.0.0.1:{gw_port}")["series"]
+        return {
+            "ranks": ranks, "hosts": hosts,
+            "exchange_wall_s": round(exchange_s, 3),
+            "all_hosts_converged": ok,
+            "gateway_sample_ranks": series[-1]["ranks"],
+            "gateway_outliers": series[-1]["outlier_ranks"][:2],
+        }
+    finally:
+        for ob in observers:
+            ob.stop()
+        gw.close()
+        kv.stop()
+
+
 def _tpu_transport_alive() -> bool:
     """The axon TPU tunnel (loopback relay) can die; when it does, any
     TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
@@ -2761,6 +3012,8 @@ def main():
         return bench_net_resilience()  # host-only TCP loopback job
     if mode == "fleet":
         return bench_fleet()  # host-only local fleet; CPU workers
+    if mode == "control_plane":
+        return bench_control_plane()  # host-only; loopback HTTP soak
     if mode == "eager":
         return bench_eager()  # never touches the accelerator
     if mode == "eager_sweep":

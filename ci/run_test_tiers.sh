@@ -47,6 +47,10 @@ TIER_FAST=(
   test_launch_flags.py
   test_metrics.py
   test_net_resilience.py
+  # Fleet-scale observability plane (ISSUE 13): digest merge algebra
+  # goldens, flat-vs-tree straggler verdict parity, host observer
+  # exchange + crash tolerance, gateway timeline, new debug surfaces.
+  test_observe_plane.py
   test_optimizers.py
   test_overlap.py
   test_parallel.py
@@ -83,7 +87,12 @@ TIER_MATRIX=(
 
 # Tier 3 — elastic recovery + slow-marked perf/regression asserts.
 TIER_SLOW=(
-  test_churn_soak.py test_eager_bench.py test_elastic.py
+  test_churn_soak.py
+  # 1000-rank/125-host control-plane soak (ISSUE 13): thousands of
+  # real HTTP requests per mode/scale — slow-marked, NEVER in tier 1
+  # (tier-1 wall time is already near its budget).
+  test_control_plane_soak.py
+  test_eager_bench.py test_elastic.py
   test_tf_elastic.py
 )
 

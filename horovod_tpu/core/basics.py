@@ -280,6 +280,21 @@ def init(mesh=None,
 
     global_state.elastic_enabled = global_state.config.elastic
     global_state.initialized = True
+
+    # --- host-sharded telemetry plane -------------------------------------
+    # Tree mode: local rank 0 hosts the per-host observer (the host's
+    # one serving slot, same gate as the metrics port above) that merges
+    # its ranks' snapshots and runs the O(hosts) digest exchange.  Like
+    # every telemetry server, a failure to start degrades to a warning —
+    # the sync path then falls back to local-only digests, named.
+    if global_state.config.metrics_tree and global_state.local_rank == 0:
+        try:
+            from ..metrics.observer import start_host_observer
+            start_host_observer()
+        except Exception as e:  # noqa: BLE001 — telemetry never kills
+            log.warning("metrics tree: cannot start host observer (%r); "
+                        "sync degrades to local-only digests", e)
+
     log.debug(
         "initialized: rank=%d size=%d local=%d/%d cross=%d/%d mesh=%s",
         global_state.rank, global_state.size, global_state.local_rank,
@@ -320,6 +335,16 @@ def shutdown() -> None:
         from .. import debug as _debug
         _debug.stop_stall_watchdog()
         _debug.flight.record("shutdown", None)
+    except Exception:  # noqa: BLE001 - best-effort teardown
+        pass
+    # The host observer's exchange thread is also hvd-tpu-* named, and
+    # unlike the metrics server its identity (cross_rank, local ranks)
+    # is world-shaped: a re-init after an elastic renumber must build a
+    # fresh one, not inherit a stale rank map that names departed ranks
+    # "missing" forever.
+    try:
+        from ..metrics.observer import stop_host_observer
+        stop_host_observer()
     except Exception:  # noqa: BLE001 - best-effort teardown
         pass
     try:

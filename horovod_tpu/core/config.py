@@ -72,6 +72,16 @@ METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
 METRICS_STRAGGLER_FACTOR = "METRICS_STRAGGLER_FACTOR"
 METRICS_STRAGGLER_MIN_SECONDS = "METRICS_STRAGGLER_MIN_SECONDS"
 METRICS_STRAGGLER_PATIENCE = "METRICS_STRAGGLER_PATIENCE"
+# Host-sharded (hierarchical) telemetry plane (metrics/digest.py +
+# metrics/observer.py): intra-host digest merge at the per-host
+# observer, one O(hosts) exchange per sync round, flat allgather kept
+# as the small-world default.  TOPK bounds the per-host raw outlier
+# evidence riding each digest.
+METRICS_TREE = "METRICS_TREE"                  # hierarchical sync on/off
+METRICS_TOPK = "METRICS_TOPK"                  # outlier evidence per host
+METRICS_TREE_TIMEOUT_S = "METRICS_TREE_TIMEOUT_S"  # exchange deadline
+METRICS_TREE_GRACE_S = "METRICS_TREE_GRACE_S"  # laggard-snapshot grace
+METRICS_RETAIN_FILES = "METRICS_RETAIN_FILES"  # JSONL rotation retention
 # Performance observatory (horovod_tpu/metrics/attribution.py +
 # baseline.py): per-step time attribution, live MFU, drift detection.
 ATTRIBUTION = "ATTRIBUTION"                    # per-step attribution on/off
@@ -125,6 +135,11 @@ FLEET_TICK_S = "FLEET_TICK_S"                  # scheduler cadence
 FLEET_QUOTA_SLOTS = "FLEET_QUOTA_SLOTS"        # per-tenant slots; 0 = unlimited
 FLEET_PREEMPTION = "FLEET_PREEMPTION"          # priority preemption on/off
 FLEET_PREEMPT_GRACE_S = "FLEET_PREEMPT_GRACE_S"  # commit wait before forcing
+# Fleet timeline (fleet/observe.py): host observers push digests to the
+# gateway's bounded ring store on a cadence; operators query per-job
+# series over GET /fleet/observe/<job> without touching worker disks.
+FLEET_OBSERVE_PUSH_S = "FLEET_OBSERVE_PUSH_S"  # push cadence; 0 = off
+FLEET_OBSERVE_RETAIN = "FLEET_OBSERVE_RETAIN"  # ring samples per job
 # Seeded wire chaos (both the native socket layer and the Python HTTP
 # planes read these; inert unless set).
 CHAOS_NET_SEED = "CHAOS_NET_SEED"              # wire-chaos schedule seed
@@ -250,6 +265,16 @@ class Config:
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
     metrics_port: int = 0
+    # Host-sharded telemetry plane: tree sync off by default (small
+    # worlds lose nothing to the flat allgather; the launcher exports
+    # the knob fleet-wide so every rank agrees).  topk bounds per-host
+    # raw outlier evidence; retain_files prunes rotated JSONL sinks on
+    # long-lived fleet workers.
+    metrics_tree: bool = False
+    metrics_topk: int = 4
+    metrics_tree_timeout_s: float = 10.0
+    metrics_tree_grace_s: float = 2.0
+    metrics_retain_files: int = 3
     # Performance observatory: step_end() closes a per-step attribution
     # record (compute / exposed comm / hidden comm / input / checkpoint /
     # host gap) and feeds the EWMA/CUSUM drift detector; both default on
@@ -303,6 +328,8 @@ class Config:
     fleet_quota_slots: int = 0
     fleet_preemption: bool = True
     fleet_preempt_grace_s: float = 30.0
+    fleet_observe_push_s: float = 0.0
+    fleet_observe_retain: int = 512
     net_resilience: bool = True
     net_probe_ms: float = 10000.0
     net_reconnect_s: float = 10.0
@@ -388,6 +415,13 @@ class Config:
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)
+        cfg.metrics_tree = get_bool(METRICS_TREE, cfg.metrics_tree)
+        # The other tree/retention knobs (METRICS_TOPK, the tree
+        # timeouts, METRICS_RETAIN_FILES) are read at their use sites
+        # with the dataclass defaults below — like the straggler knobs,
+        # they are consumed by long-lived helpers, not by init(), so
+        # parsing them into this snapshot would just be a second copy
+        # of the clamp logic that nothing reads.
         cfg.attribution = get_bool(ATTRIBUTION, cfg.attribution)
         cfg.attribution_jsonl = get_env(
             ATTRIBUTION_JSONL, cfg.attribution_jsonl) or ""
@@ -426,6 +460,10 @@ class Config:
                                         cfg.fleet_preemption)
         cfg.fleet_preempt_grace_s = get_float(FLEET_PREEMPT_GRACE_S,
                                               cfg.fleet_preempt_grace_s)
+        cfg.fleet_observe_push_s = max(0.0, get_float(
+            FLEET_OBSERVE_PUSH_S, cfg.fleet_observe_push_s))
+        cfg.fleet_observe_retain = max(1, get_int(
+            FLEET_OBSERVE_RETAIN, cfg.fleet_observe_retain))
         cfg.net_resilience = get_bool(NET_RESILIENCE, cfg.net_resilience)
         cfg.net_probe_ms = get_float(NET_PROBE_MS, cfg.net_probe_ms)
         cfg.net_reconnect_s = get_float(NET_RECONNECT_S,

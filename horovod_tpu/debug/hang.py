@@ -78,9 +78,16 @@ def attribute(events: List[dict]) -> str:
 def build_hang_report(stalled: List[dict],
                       rank_dumps: Dict[int, Optional[dict]],
                       world: int, step: int,
-                      last_n: Optional[int] = None) -> dict:
+                      last_n: Optional[int] = None,
+                      host_status: Optional[Dict[str, str]] = None) -> dict:
     """Assemble the report object from the stall snapshot + per-rank
-    dumps (None value = unreachable rank).  Pure function."""
+    dumps (None value = unreachable rank).  Pure function.
+
+    ``host_status`` (tree-fanned collection, ``_collect_dumps``) maps
+    each per-host observer to how its fan-in went — ``"ok"``,
+    ``"unreachable"``, or ``"fallback:<reason>"`` — so a report built
+    from a partial round NAMES which host's evidence is missing
+    instead of just showing its ranks as unreachable."""
     last_n = last_n or _flight.last_events_limit()
     missing_union = sorted({r for s in stalled for r in s.get("missing", [])})
     ranks = {}
@@ -107,6 +114,7 @@ def build_hang_report(stalled: List[dict],
         "stalled": [dict(s, type_name=_REQUEST_TYPE_NAMES.get(
             s.get("type"), str(s.get("type")))) for s in stalled],
         "missing_ranks": missing_union,
+        "hosts": dict(host_status) if host_status else None,
         "ranks": ranks,
         # The last recovery decision on THIS process (path peer/disk/
         # none, bytes, latency): a hang right after an elastic reset
@@ -166,6 +174,7 @@ class StallWatchdog:
         self._armed = True
         self.reports_written: List[str] = []
         self._report_seq = 0
+        self.last_host_status: Dict[str, str] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "StallWatchdog":
@@ -212,8 +221,39 @@ class StallWatchdog:
                 log.warning("stall escalation failed: %r", e)
 
     def _collect_dumps(self, world: int) -> Dict[int, Optional[dict]]:
+        dumps, self.last_host_status = self._collect_dumps_status(world)
+        return dumps
+
+    def _collect_dumps_status(self, world: int):
+        """(rank dumps, per-host fan-in status).  With per-host
+        observers published (HVD_TPU_METRICS_TREE — metrics/observer.py)
+        the fetch is ONE request per host returning all its ranks'
+        dumps; hosts whose observer fails fall back to per-rank fetches
+        for the uncovered ranks and are named in the report's ``hosts``
+        section.  Without observers it is the flat per-rank fan-out."""
+        host_status: Dict[str, str] = {}
+        covered: Dict[int, Optional[dict]] = {}
+        if self._rdv:
+            covered, host_status = self._collect_via_observers()
+        missing = [r for r in range(world) if r not in covered]
+        covered.update(self._collect_per_rank(missing))
+        return {r: covered.get(r) for r in range(world)}, host_status
+
+    def _collect_via_observers(self):
+        # One request per host through the published observers
+        # (metrics/observer.py).  Ranks an observer could not answer
+        # for — observer down, or a sibling that timed out inside the
+        # observer's fan-in — are NOT marked covered, so the per-rank
+        # path still retries them with this watchdog's own timeout.
+        from ..metrics.observer import collect_fleet_dumps
+        return collect_fleet_dumps(self._rdv,
+                                   timeout=self._fetch_timeout)
+
+    def _collect_per_rank(self, ranks: List[int]) -> Dict[int, Optional[dict]]:
         from concurrent.futures import ThreadPoolExecutor
         from . import http as _http
+        if not ranks:
+            return {}
         my_rank = self._ctl.rank()
 
         def fetch(r: int) -> Optional[dict]:
@@ -235,10 +275,10 @@ class StallWatchdog:
         # (each unreachable rank costs up to 2x fetch_timeout) and quote
         # stale evidence by the time it lands.
         with ThreadPoolExecutor(
-                max_workers=min(world, 16),
+                max_workers=min(len(ranks), 16),
                 thread_name_prefix="hvd-tpu-flight-fetch") as pool:
-            results = list(pool.map(fetch, range(world)))
-        return dict(enumerate(results))
+            results = list(pool.map(fetch, ranks))
+        return dict(zip(ranks, results))
 
     def _step(self) -> int:
         """Report step index: the training step when the metrics
@@ -255,8 +295,10 @@ class StallWatchdog:
 
     def _write_report(self, stalled: List[dict]) -> str:
         world = self._ctl.size()
-        report = build_hang_report(stalled, self._collect_dumps(world),
-                                   world=world, step=self._step())
+        dumps, host_status = self._collect_dumps_status(world)
+        report = build_hang_report(stalled, dumps, world=world,
+                                   step=self._step(),
+                                   host_status=host_status)
         os.makedirs(self._dir, exist_ok=True)
         path = os.path.join(self._dir,
                             f"hang_report_{report['step']}.json")

@@ -48,6 +48,39 @@ def render_regression_json() -> Optional[bytes]:
     return json.dumps(report, default=str).encode("utf-8")
 
 
+def render_autotune_json() -> Optional[bytes]:
+    """The active tuner's closed-loop status (``autotune.loop_status``
+    — PR 12's journal surface), serialized for the wire; None when this
+    process owns no tuner.  Until now a fleet operator could not see
+    warm starts / re-tunes / rollbacks without attaching to the
+    process."""
+    from .. import autotune as _autotune
+    status = _autotune.loop_status()
+    if status is None:
+        return None
+    return json.dumps(status, default=str).encode("utf-8")
+
+
+def render_fleet_scalars_json() -> bytes:
+    """The aggregator's queryable fleet surface: per-rank flat scalars
+    from the last sync round ({} before the first).  Under the tree
+    path the per-rank section is EMPTY by design (outlier evidence is
+    pruned of scalar maps — metrics/digest.py) and the ``merged``
+    section carries the fleet digest's exact counter totals and
+    (min, max, last) gauge envelopes instead."""
+    from ..metrics.aggregate import aggregator
+    agg = aggregator()
+    payload: dict = {"ranks": {str(r): s for r, s in
+                               agg.fleet_scalars().items() if s}}
+    digest = agg.fleet_digest()
+    if digest is not None:
+        payload["merged"] = {"counters": digest.get("counters") or {},
+                             "gauges": digest.get("gauges") or {},
+                             "hosts": digest.get("hosts") or [],
+                             "ranks_merged": digest.get("ranks", 0)}
+    return json.dumps(payload, default=str).encode("utf-8")
+
+
 def request_authorized(headers, key: str) -> bool:
     """HMAC gate for a dump request — the same scheme as the rendezvous
     KV (signed as a GET of ``debug/<key>`` with the launch secret):
@@ -119,6 +152,23 @@ class _DebugHandler(BaseHTTPRequestHandler):
                 return
             self._send(render_stacks_text(),
                        ctype="text/plain; charset=utf-8")
+        elif path == "/debug/autotune":
+            if not self._authorized("autotune"):
+                self.send_response(403)
+                self.end_headers()
+                return
+            body = render_autotune_json()
+            if body is None:
+                self._send_error(404, b'{"error": "no active tuner in '
+                                      b'this process"}')
+                return
+            self._send(body)
+        elif path == "/debug/fleet_scalars":
+            if not self._authorized("fleet_scalars"):
+                self.send_response(403)
+                self.end_headers()
+                return
+            self._send(render_fleet_scalars_json())
         elif path == "/healthz":
             self._send(b"ok", ctype="text/plain")
         else:

@@ -48,6 +48,49 @@ def load_dump(path: str) -> dict:
         return json.load(f)
 
 
+def fetch_fleet_dumps(rdv_addr: str,
+                      timeout: float = 5.0) -> Dict[int, dict]:
+    """Fetch every reachable rank's flight dump from a LIVE fleet —
+    the merge CLI's ``--from-fleet`` source.
+
+    Host-sharded when per-host observers are published
+    (``HVD_TPU_METRICS_TREE`` — metrics/observer.py): one
+    ``GET /observe/dumps`` per host returns all its ranks' dumps, so a
+    125-host fleet costs 125 requests, not 1000.  Hosts without an
+    observer (or whose observer is down) degrade to per-rank fetches of
+    the ``debug/flight_addr_<rank>`` endpoints; either way unreachable
+    ranks are skipped with a stderr note, never fatal."""
+    from ..metrics.observer import collect_fleet_dumps
+    from ..runner.rendezvous import http_get, http_list
+    from . import http as _dhttp
+
+    dumps, host_status = collect_fleet_dumps(rdv_addr, timeout=timeout)
+    for host, status in sorted(host_status.items()):
+        if status != "ok":
+            sys.stderr.write(f"merge: {host} {status}\n")
+
+    # Per-rank sweep for whatever the observers did not cover.
+    debug_keys = http_list(rdv_addr, "debug", timeout=timeout) or []
+    for key in sorted(k for k in debug_keys
+                      if k.startswith("flight_addr_")):
+        try:
+            rank = int(key[len("flight_addr_"):])
+        except ValueError:
+            continue
+        if rank in dumps:
+            continue
+        raw = http_get(rdv_addr, "debug", key, timeout=timeout)
+        addr = raw.decode() if raw else None
+        d = _dhttp.fetch_flight_dump(addr, timeout=timeout) \
+            if addr else None
+        if d is not None:
+            dumps[rank] = d
+        else:
+            sys.stderr.write(f"merge: rank {rank} unreachable; its row "
+                             "will be absent from the trace\n")
+    return dumps
+
+
 def load_timeline(path: str) -> List[dict]:
     """Native Chrome timeline: tolerant of a truncated file (a process
     that died mid-run leaves the JSON array unterminated)."""
@@ -181,21 +224,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m horovod_tpu.debug.merge",
         description="Merge per-rank flight dumps (+ the native Chrome "
                     "timeline) into one clock-aligned Chrome trace.")
-    p.add_argument("dumps", nargs="+",
+    p.add_argument("dumps", nargs="*",
                    help="flight_rank<N>.json files (one per rank)")
     p.add_argument("-o", "--output", default="merged_trace.json")
     p.add_argument("--timeline", default=None,
                    help="native Chrome timeline (HVD_TPU_TIMELINE file)")
+    p.add_argument("--from-fleet", default=None, metavar="RDV_ADDR",
+                   help="fetch dumps from a live fleet via its "
+                        "rendezvous KV (host:port) — one request per "
+                        "host when per-host observers are running, "
+                        "per-rank otherwise")
     args = p.parse_args(argv)
+    if not args.dumps and not args.from_fleet:
+        p.error("give dump files or --from-fleet RDV_ADDR")
 
     dumps = [load_dump(path) for path in args.dumps]
+    if args.from_fleet:
+        fetched = fetch_fleet_dumps(args.from_fleet)
+        dumps.extend(fetched[r] for r in sorted(fetched))
     timeline = load_timeline(args.timeline) if args.timeline else None
     trace = merge_dumps(dumps, timeline_events=timeline)
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(trace, f)
     pids = sorted({e.get("pid") for e in trace["traceEvents"]})
     sys.stderr.write(
-        f"merged {len(args.dumps)} flight dump(s)"
+        f"merged {len(dumps)} flight dump(s)"
         + (" + native timeline" if timeline else "")
         + f" -> {args.output} ({len(trace['traceEvents'])} events, "
         f"process rows for ranks {pids})\n")

@@ -12,9 +12,12 @@ bit-identically from its committed step), and SLO-driven admission
 control fed by the health plane.  See docs/fleet.md.
 """
 
-from .client import (cancel_job, default_addr, detect_gateway, get_job,
-                     list_jobs, submit_job, wait_job)
+from .client import (cancel_job, default_addr, detect_gateway,
+                     get_job, get_observation, list_jobs,
+                     list_observed_jobs, push_observation, submit_job,
+                     wait_job)
 from .gateway import SERVICE_NAME, FleetGateway
+from .observe import FleetSeriesStore
 from .job import (ACTIVE_STATES, CANCELLED, DENIED, DONE, FAILED,
                   PREEMPTED, PREEMPTING, QUEUED, RUNNING,
                   TERMINAL_STATES, JobRecord, JobSpec)
@@ -30,10 +33,11 @@ __all__ = [
     "ACTIVE_STATES", "CANCELLED", "DENIED", "DONE", "FAILED",
     "PREEMPTED", "PREEMPTING", "QUEUED", "RUNNING", "TERMINAL_STATES",
     "SERVICE_NAME",
-    "DurableJobQueue", "ElasticJobRunner", "FleetGateway", "JobRecord",
-    "JobSpec", "JobView", "Scheduler",
+    "DurableJobQueue", "ElasticJobRunner", "FleetGateway",
+    "FleetSeriesStore", "JobRecord", "JobSpec", "JobView", "Scheduler",
     "cancel_job", "default_addr", "detect_gateway", "get_job",
-    "list_jobs", "plan", "submit_job", "wait_job",
+    "get_observation", "list_jobs", "list_observed_jobs", "plan",
+    "push_observation", "submit_job", "wait_job",
     "GatewayTuningStore", "LocalTuningStore", "TuningSchemaMismatch",
     "config_key", "make_record", "model_fingerprint", "resolve_store",
     "topology_signature",

@@ -35,11 +35,12 @@ def _secret(secret: Optional[str]) -> Optional[str]:
 
 def _request(method: str, addr: str, key: str, body: bytes = b"",
              secret: Optional[str] = None, timeout: float = 5.0,
-             none_on_404: bool = False):
+             none_on_404: bool = False, query: str = ""):
     from .. import net as _net
     from ..runner.rendezvous import _signature
     req = urllib.request.Request(
-        f"http://{addr}/fleet/{key}", data=body or None, method=method)
+        f"http://{addr}/fleet/{key}" + (f"?{query}" if query else ""),
+        data=body or None, method=method)
     sec = _secret(secret)
     if sec:
         req.add_header("X-HVD-Signature",
@@ -108,6 +109,35 @@ def cancel_job(job_id: str, addr: Optional[str] = None,
     return JobRecord.from_dict(
         _request("DELETE", default_addr(addr), f"jobs/{job_id}",
                  secret=secret))
+
+
+def push_observation(job_id: str, host_digest: dict,
+                     addr: Optional[str] = None,
+                     secret: Optional[str] = None) -> None:
+    """Ingest one host digest into the gateway's fleet timeline
+    (``fleet/observe.py``) — what the per-host observer's push loop
+    calls on the ``HVD_TPU_FLEET_OBSERVE_PUSH_S`` cadence."""
+    payload = json.dumps(host_digest).encode()
+    _request("POST", default_addr(addr), f"observe/{job_id}", payload,
+             secret=secret)
+
+
+def get_observation(job_id: str, addr: Optional[str] = None,
+                    secret: Optional[str] = None,
+                    since: float = 0.0) -> Optional[dict]:
+    """The job's retained timeline series (None when the gateway has
+    none) — "what was job J's MFU over the last hour" without touching
+    worker disks."""
+    return _request("GET", default_addr(addr), f"observe/{job_id}",
+                    secret=secret, none_on_404=True,
+                    query=f"since={since}" if since else "")
+
+
+def list_observed_jobs(addr: Optional[str] = None,
+                       secret: Optional[str] = None) -> List[str]:
+    payload = _request("GET", default_addr(addr), "observe",
+                       secret=secret)
+    return list(payload.get("jobs", []))
 
 
 def wait_job(job_id: str, addr: Optional[str] = None,

@@ -15,6 +15,11 @@ them onto the device inventory.  Endpoints::
     DELETE /fleet/jobs/<id>    cancel (queued or running)
     GET    /fleet/tuning/<key> stored tuned config (tuning memory)
     PUT    /fleet/tuning/<key> persist a tuned config record
+    POST   /fleet/observe/<job> ingest one host digest (fleet timeline)
+    GET    /fleet/observe/<job> the job's retained series [?since=ts]
+    GET    /fleet/observe      jobs with series + store stats
+    GET    /fleet/metrics      fleet-wide Prometheus exposition
+                               (unsigned, like every scrape endpoint)
 
 All job endpoints are HMAC-gated with the fleet secret
 (``HVD_TPU_FLEET_SECRET``) under the rendezvous KV's signature scheme —
@@ -49,8 +54,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
     # -- plumbing ----------------------------------------------------------
 
     def _key(self) -> Optional[str]:
-        """The signature key: the path under /fleet/ (None = not ours)."""
-        parts = self.path.strip("/").split("/")
+        """The signature key: the path under /fleet/, query stripped
+        (None = not ours).  Clients sign the bare key — a ``?since=``
+        filter is a read refinement, not a distinct resource."""
+        parts = self.path.partition("?")[0].strip("/").split("/")
         if not parts or parts[0] != "fleet":
             return None
         return "/".join(parts[1:])
@@ -86,6 +93,18 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 "service": SERVICE_NAME, "ok": True,
                 "jobs": len(gw.store.list()),
             })
+        if key == "metrics":
+            # Fleet-wide Prometheus exposition of the timeline's latest
+            # sample per job — unsigned like every scrape endpoint in
+            # this stack (scrapers cannot sign; only aggregates leave).
+            body = gw.observe.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if not self._authorized("GET", key):
             return self._send(403, {"error": "bad or missing signature"})
         if key == "status":
@@ -115,11 +134,47 @@ class _FleetHandler(BaseHTTPRequestHandler):
             if rec is None:
                 return self._send(404, {"error": "no tuned config"})
             return self._send(200, rec)
+        if key == "observe":
+            return self._send(200, {"jobs": gw.observe.jobs(),
+                                    "stats": gw.observe.stats()})
+        if key.startswith("observe/"):
+            # The fleet timeline (fleet/observe.py): per-job series
+            # derived from pushed host digests — observability without
+            # touching worker disks.
+            job = key[len("observe/"):]
+            since = 0.0
+            q = self.path.partition("?")[2]
+            for part in q.split("&"):
+                if part.startswith("since="):
+                    try:
+                        since = float(part[6:])
+                    except ValueError:
+                        pass
+            if job not in gw.observe.jobs():
+                return self._send(404, {"error": "no series for job "
+                                                 f"{job!r}"})
+            # A known job with nothing newer than ?since= is an EMPTY
+            # window, not a missing job — 404 here would make every
+            # idle poll interval read as "series disappeared".
+            rows = gw.observe.series(job, since=since)
+            return self._send(200, {"job": job, "series": rows})
         return self._send(404, {"error": "not found"})
 
     def do_POST(self):
         gw = self.server.gateway  # type: ignore[attr-defined]
         key = self._key()
+        if key is not None and key.startswith("observe/"):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if not self._authorized("POST", key, body):
+                return self._send(403,
+                                  {"error": "bad or missing signature"})
+            try:
+                gw.observe.ingest(key[len("observe/"):],
+                                  json.loads(body.decode()))
+            except (ValueError, TypeError) as e:
+                return self._send(400, {"error": f"malformed digest: {e}"})
+            return self._send(200, {"ok": True})
         if key != "jobs":
             return self._send(404, {"error": "not found"})
         length = int(self.headers.get("Content-Length", 0))
@@ -209,6 +264,11 @@ class FleetGateway(BackgroundHTTPServer):
         # GET/PUT /fleet/tuning/<key> so resubmitted jobs start warm.
         from .tuning import LocalTuningStore
         self.tuning = LocalTuningStore(fleet_dir)
+        # The fleet timeline (fleet/observe.py): bounded per-job series
+        # fed by host-digest pushes; telemetry, deliberately NOT
+        # persisted with the queue's durability.
+        from .observe import FleetSeriesStore
+        self.observe = FleetSeriesStore()
         hosts_provider = hosts if callable(hosts) else (lambda: list(hosts))
         self.scheduler = Scheduler(
             self.store, hosts_provider, runner_factory=runner_factory,

@@ -49,7 +49,12 @@ from .registry import (
     enabled, registry, set_enabled,
 )
 from .aggregate import (
-    Aggregator, aggregator, fleet_snapshot, step_end, sync,
+    Aggregator, aggregator, fleet_digest, fleet_snapshot, step_end,
+    sync,
+)
+from .digest import (
+    QuantileSketch, digest_mfu, digest_shares, digest_step_quantiles,
+    merge_all, merge_digests, snapshot_digest,
 )
 from .health import (
     RankHealth, StragglerDetector, blacklist_hint, detector,
@@ -76,7 +81,11 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BYTE_BUCKETS", "DEFAULT_TIME_BUCKETS",
     "enabled", "registry", "set_enabled",
-    "Aggregator", "aggregator", "fleet_snapshot", "step_end", "sync",
+    "Aggregator", "aggregator", "fleet_digest", "fleet_snapshot",
+    "step_end", "sync",
+    "QuantileSketch", "digest_mfu", "digest_shares",
+    "digest_step_quantiles", "merge_all", "merge_digests",
+    "snapshot_digest",
     "RankHealth", "StragglerDetector", "blacklist_hint", "detector",
     "straggler_report",
     "JsonlSink", "MetricsServer", "render_prometheus", "serve",

@@ -49,6 +49,19 @@ def _sync_cadence() -> int:
     return max(get_int("METRICS_SYNC_STEPS", 0), 0)
 
 
+def _tree_enabled() -> bool:
+    """The hierarchical (host-sharded) sync path — see metrics/digest.py
+    and metrics/observer.py.  Off by default: small worlds lose nothing
+    to the flat allgather, and the knob must agree on every rank (it is
+    env-driven, exported by the launcher) or half a fleet would wait on
+    observers that never hear from the other half."""
+    from ..core.state import global_state
+    if global_state.initialized and global_state.config is not None:
+        return bool(getattr(global_state.config, "metrics_tree", False))
+    from ..core.config import get_bool
+    return get_bool("METRICS_TREE", False)
+
+
 def _data_wait_totals() -> tuple:
     """(total_s, count, reset_generation) of data-wait spans from the
     registry (the migrated ``utils/profiler.data_wait_stats`` storage).
@@ -81,6 +94,13 @@ class Aggregator:
         self._last_step_ts: Optional[float] = None
         self._fleet: Optional[List[dict]] = None
         self._fleet_step = -1
+        # Tree-mode state: the per-window step-time sketch that rides
+        # the snapshot (metrics/digest.py), the sync round index
+        # observers align on, and the last merged fleet digest.
+        from .digest import QuantileSketch
+        self._win_sketch = QuantileSketch()
+        self._sync_round = 0
+        self._fleet_digest: Optional[dict] = None
         # Idempotency latch: the last explicitly-indexed step_end(step=)
         # absorbed.  A user loop and an elastic commit hook both closing
         # the same step index must count it once (double-counting halves
@@ -128,6 +148,7 @@ class Aggregator:
             if step_time_s is not None:
                 self._step_sum += step_time_s
                 self._step_count += 1
+                self._win_sketch.add(step_time_s)
         reg.counter("hvd_steps_total", "Training steps observed").inc()
         if step_time_s is not None:
             reg.histogram("hvd_step_time_seconds",
@@ -167,6 +188,10 @@ class Aggregator:
                 "step_count": self._step_count - self._mark_step_count,
                 "data_wait_sum": dw_sum,
                 "data_wait_count": dw_count,
+                # The window's per-step time sketch: what the host
+                # digest merges so fleet p50/p95 survive aggregation
+                # (metrics/digest.py).  Bounded — log-bucket counts.
+                "sketch": self._win_sketch.to_dict(),
             }
         if _attr.enabled():
             # Windowed per-component seconds + declared FLOPs: the
@@ -178,12 +203,14 @@ class Aggregator:
 
     def _advance_window(self) -> None:
         wait_sum, wait_count, wait_gen = _data_wait_totals()
+        from .digest import QuantileSketch
         with self._lock:
             self._mark_step_sum = self._step_sum
             self._mark_step_count = self._step_count
             self._mark_wait_sum = wait_sum
             self._mark_wait_count = wait_count
             self._mark_wait_gen = wait_gen
+            self._win_sketch = QuantileSketch()
         if _attr.enabled():
             _attr.attribution().advance_window()
 
@@ -192,7 +219,16 @@ class Aggregator:
         collective — every rank must call it at the same step (the
         cadence in ``step_end`` guarantees this for SPMD loops, and an
         elastic reset re-zeroes every member's step counter so rejoined
-        worlds stay aligned — see elastic/state.py ``_reset``)."""
+        worlds stay aligned — see elastic/state.py ``_reset``).
+
+        Under ``HVD_TPU_METRICS_TREE`` the sync is hierarchical
+        instead: intra-host merge through the per-host observer, one
+        O(hosts) digest exchange, and the merged fleet digest back —
+        see :meth:`sync_tree`.  The return value is then the digest's
+        bounded outlier evidence (the per-rank entries that survived
+        aggregation), not one entry per rank."""
+        if _tree_enabled():
+            return self.sync_tree()
         t0 = time.perf_counter()
         snap = self.local_snapshot()
         from ..core.state import global_state
@@ -221,6 +257,83 @@ class Aggregator:
             self._fleet = gathered
             self._fleet_step = snap["step"]
         return gathered
+
+    def sync_tree(self) -> List[dict]:
+        """The hierarchical sync round: snapshot → host observer →
+        O(hosts) exchange → merged fleet digest.  No collective runs;
+        an unreachable observer degrades to a local-only digest (named
+        as partial) rather than blocking the step.  Health and the
+        fleet MFU gauges evaluate from the digest; the bounded outlier
+        entries stand in for the flat path's per-rank list."""
+        t0 = time.perf_counter()
+        from . import digest as _dig
+        from . import observer as _observer
+        snap = self.local_snapshot()
+        with self._lock:
+            self._sync_round += 1
+            round_idx = self._sync_round
+        fleet_digest = _observer.rank_sync(snap, round_idx)
+        self._advance_window()
+        from ..core.state import global_state
+        if fleet_digest is None:
+            # No observer reachable (single process, or the host's
+            # serving slot died): a digest of this rank alone — the
+            # read surfaces stay coherent and the degradation is
+            # visible (ranks=1, hosts empty).
+            kinds = None
+            try:
+                kinds = _registry().scalar_kinds()
+            except Exception:  # noqa: BLE001
+                pass
+            expected = [snap["rank"]]
+            if global_state.initialized and \
+                    global_state.process_count > 1:
+                # The most-degraded mode must SAY so: every other rank
+                # is unreported here, and the unreported gauges would
+                # otherwise read a clean 0/0 while the fleet view
+                # silently covered one rank.
+                expected = list(range(global_state.process_count))
+            fleet_digest = _dig.snapshot_digest(
+                [snap], host="", top_k=_observer.top_k(),
+                expected_ranks=expected,
+                scalar_kinds=kinds, peak=_attr.peak_flops())
+            fleet_digest["round"] = round_idx
+        reg = _registry()
+        fresh = int(fleet_digest.get("round", -1)) == round_idx
+        if fresh:
+            _detector().evaluate_digest(
+                fleet_digest, warn=global_state.process_rank == 0)
+        else:
+            # The observer served a PREVIOUS round's digest (this
+            # round's exchange missed its deadline).  Keep it for the
+            # read surfaces, but feeding it to the stateful evaluator
+            # again would double-count straggler streaks — one
+            # transient flagged round must not fabricate a
+            # blacklist_hint.
+            reg.counter(
+                "hvd_metrics_tree_stale_rounds_total",
+                "Tree syncs that served a previous round's digest "
+                "(exchange deadline missed)").inc()
+        mfu = _dig.digest_mfu(fleet_digest)
+        if mfu is not None:
+            reg.gauge("hvd_mfu_fleet_min",
+                      "Lowest per-rank MFU in the last aggregation "
+                      "window").set(mfu["min"])
+            reg.gauge("hvd_mfu_fleet_mean",
+                      "Mean per-rank MFU in the last aggregation "
+                      "window").set(mfu["mean"])
+        reg.counter("hvd_metrics_syncs_total",
+                    "Cross-rank metric aggregations").inc()
+        reg.gauge("hvd_metrics_sync_seconds",
+                  "Duration of the last metrics aggregation "
+                  "(gather + health scoring)").set(
+            time.perf_counter() - t0)
+        outliers = [dict(s) for s in fleet_digest.get("outliers") or []]
+        with self._lock:
+            self._fleet = outliers
+            self._fleet_step = snap["step"]
+            self._fleet_digest = fleet_digest
+        return outliers
 
     @staticmethod
     def _fleet_mfu_gauges(gathered: List[dict], reg) -> None:
@@ -262,9 +375,19 @@ class Aggregator:
 
     def fleet_scalars(self) -> Dict[int, Dict[str, float]]:
         """{rank: flat scalars} from the last sync — the queryable fleet
-        surface ("sum hvd_collective_bytes_total over ranks")."""
+        surface ("sum hvd_collective_bytes_total over ranks").  Under
+        the tree path only the digest's outlier ranks appear here; the
+        fleet-wide totals live in :meth:`fleet_digest`'s merged
+        counters (exact — counters sum)."""
         fleet = self.fleet() or []
         return {int(s["rank"]): dict(s.get("scalars", {})) for s in fleet}
+
+    def fleet_digest(self) -> Optional[dict]:
+        """The merged fleet digest from the most recent tree-mode sync
+        (None before the first, and always None on the flat path)."""
+        with self._lock:
+            return dict(self._fleet_digest) \
+                if self._fleet_digest is not None else None
 
     def reset(self) -> None:
         """Zero the step counter and open a fresh window anchored at the
@@ -284,6 +407,19 @@ class Aggregator:
             self._fleet = None
             self._fleet_step = -1
             self._last_explicit_step = None
+            from .digest import QuantileSketch
+            self._win_sketch = QuantileSketch()
+            self._sync_round = 0
+            self._fleet_digest = None
+        # The tree plane's round clock restarts with this aggregator:
+        # the host's observer (when this process hosts one) re-zeroes
+        # its sealed-round guard, and the observer-address cache is
+        # dropped (an elastic round can reseat local rank 0).
+        from . import observer as _observer
+        ob = _observer.current_observer()
+        if ob is not None:
+            ob.reset_rounds()
+        _observer.reset_addr_cache()
         if _attr.enabled():
             # Re-anchor the attribution marks at the counters' current
             # values (the elastic run() loop re-anchors AGAIN after the
@@ -321,3 +457,8 @@ def sync() -> List[dict]:
 
 def fleet_snapshot() -> Optional[List[dict]]:
     return aggregator().fleet()
+
+
+def fleet_digest() -> Optional[dict]:
+    """The last tree-mode fleet digest (None on the flat path)."""
+    return aggregator().fleet_digest()

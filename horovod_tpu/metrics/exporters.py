@@ -110,16 +110,19 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b"ok")
         elif path in ("/debug/flight", "/debug/regression",
-                      "/debug/stacks"):
+                      "/debug/stacks", "/debug/autotune",
+                      "/debug/fleet_scalars"):
             # The metrics port doubles as a debug surface: one scrape
             # endpoint per host already exists, so the flight dump, the
-            # last regression report and all-thread stacks ride it
-            # instead of demanding a second port (debug/http.py serves
-            # the same handlers standalone — and the same HMAC gate
-            # applies on BOTH mounts, or setting the launch secret
-            # would protect one copy of the paths while this one stayed
-            # open).
-            from ..debug.http import (render_flight_json,
+            # last regression report, all-thread stacks, the autotune
+            # loop status and the fleet-scalars view ride it instead of
+            # demanding a second port (debug/http.py serves the same
+            # handlers standalone — and the same HMAC gate applies on
+            # BOTH mounts, or setting the launch secret would protect
+            # one copy of the paths while this one stayed open).
+            from ..debug.http import (render_autotune_json,
+                                      render_fleet_scalars_json,
+                                      render_flight_json,
                                       render_regression_json,
                                       render_stacks_text,
                                       request_authorized)
@@ -128,22 +131,39 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 self.send_response(403)
                 self.end_headers()
                 return
+            code = 200
             if path == "/debug/flight":
                 body, ctype = render_flight_json(), "application/json"
             elif path == "/debug/regression":
                 body, ctype = render_regression_json(), "application/json"
                 if body is None:
                     body = b'{"error": "no regression report yet"}'
-                    self.send_response(404)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
+                    code = 404
+            elif path == "/debug/autotune":
+                body, ctype = render_autotune_json(), "application/json"
+                if body is None:
+                    body = b'{"error": "no active tuner in this process"}'
+                    code = 404
+            elif path == "/debug/fleet_scalars":
+                body, ctype = (render_fleet_scalars_json(),
+                               "application/json")
             else:
                 body, ctype = (render_stacks_text(),
                                "text/plain; charset=utf-8")
-            self.send_response(200)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path.startswith("/observe/"):
+            # The host observer's surface also answers on the metrics
+            # port (one host, one serving slot — both are rank-gated to
+            # local rank 0): /observe/digest, /observe/fleet,
+            # /observe/dumps.  404 when no observer runs here.
+            from .observer import current_observer, handle_observe_get
+            code, body, ctype = handle_observe_get(
+                current_observer(), self.path, self.headers)
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -216,17 +236,41 @@ class JsonlSink:
     exceed ``max_bytes`` it rotates: ``path`` → ``path.1`` → ... →
     ``path.<backups>`` (oldest dropped).  Each write opens/closes the
     file — this is the offline sink, not a hot path, and it keeps
-    rotation trivially correct."""
+    rotation trivially correct.
+
+    ``backups`` defaults to the ``HVD_TPU_METRICS_RETAIN_FILES`` knob
+    (3 when unset) — the retention control long-lived fleet-mode
+    workers need: a worker that outlives many retention settings prunes
+    down on construction, so stale ``path.<N>`` backups from an earlier
+    looser setting cannot accumulate forever."""
 
     def __init__(self, path: str, max_bytes: int = 4 << 20,
-                 backups: int = 3):
+                 backups: Optional[int] = None):
+        from ..core import config as _config
         self.path = path
         self.max_bytes = int(max_bytes)
+        if backups is None:
+            backups = _config.get_int(
+                "METRICS_RETAIN_FILES",
+                _config.Config.metrics_retain_files)
         self.backups = max(int(backups), 1)
         self._lock = threading.Lock()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        self._prune()
+
+    def _prune(self) -> None:
+        """Drop rotated backups beyond the current retention — covers a
+        sink re-created with a tighter ``backups`` over files a looser
+        predecessor left behind."""
+        i = self.backups + 1
+        while os.path.exists(f"{self.path}.{i}"):
+            try:
+                os.unlink(f"{self.path}.{i}")
+            except OSError:
+                break
+            i += 1
 
     def _rotate(self) -> None:
         oldest = f"{self.path}.{self.backups}"

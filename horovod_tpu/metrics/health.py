@@ -177,6 +177,47 @@ class StragglerDetector:
         # slowdown): the original input-vs-compute split.
         return "input" if wait >= 0.5 * excess else "compute"
 
+    def score_digest(self, digest: dict) -> List[RankHealth]:
+        """Score a merged fleet digest (metrics/digest.py) — the tree
+        path's analog of :meth:`score_ranks`.
+
+        The baseline median and per-component medians come from the
+        digest's quantile sketches (within the sketch's ~2.5% bound of
+        the flat path's exact medians); the per-rank verdicts cover the
+        **outlier evidence** the digest carried raw — each host's top-K
+        slowest ranks, preserved through merges up to the fleet ceiling
+        (``digest.FLEET_OUTLIER_CAP``), i.e. exactly the candidates a
+        straggler flag could name.  A rank absent from the outlier list
+        is faster than its host's top-K slowest and can never clear the
+        flag factor, so the verdict set matches the flat path on the
+        same fleet (golden-tested parity,
+        ``tests/test_observe_plane.py``) — unless more than the ceiling
+        are sick at once, at which point per-rank flags stop being the
+        interesting signal."""
+        from . import digest as _digest
+        median = _digest.digest_median_step(digest)
+        comp_medians = _digest.digest_component_medians(digest)
+        out = []
+        for entry in digest.get("outliers") or []:
+            n = int(entry.get("step_count", 0))
+            mean = (float(entry.get("step_time_sum", 0.0)) / n) if n else 0.0
+            wait = (float(entry.get("data_wait_sum", 0.0)) / n) if n else 0.0
+            comps = _component_means(entry)
+            if n == 0 or not median or median <= 0.0:
+                out.append(RankHealth(int(entry["rank"]), mean, wait, 1.0,
+                                      False, "", n, comps))
+                continue
+            score = mean / median
+            excess = mean - median
+            flagged = score >= self.factor and excess >= self.min_seconds
+            cause = ""
+            if flagged:
+                cause = self._attribute_cause(comps, comp_medians,
+                                              wait, excess)
+            out.append(RankHealth(int(entry["rank"]), mean, wait, score,
+                                  flagged, cause, n, comps))
+        return out
+
     # -- stateful evaluation ----------------------------------------------
 
     def evaluate(self, per_rank: Sequence[dict],
@@ -184,6 +225,38 @@ class StragglerDetector:
         """Score + update consecutive-flag streaks, emit warnings,
         timeline markers and registry metrics.  Returns the report."""
         report = self.score_ranks(per_rank)
+        return self._absorb(report, warn=warn)
+
+    def evaluate_digest(self, digest: dict,
+                        warn: bool = True) -> List[RankHealth]:
+        """The tree path's evaluation: score the digest's outlier
+        evidence, warn about hosts whose digests never arrived (a
+        partial round is NAMED, never silently averaged away), and
+        update the same streak/registry surfaces as the flat path."""
+        report = self.score_digest(digest)
+        failed = digest.get("failed_hosts") or []
+        missing = digest.get("missing") or []
+        if warn and (failed or missing):
+            from ..utils import logging as log
+            log.warning(
+                "metrics tree: partial aggregation round — unreported "
+                "hosts %s, unreported ranks %s (their digests/snapshots "
+                "missed the round; verdicts below cover reporters only)",
+                failed or "[]", missing or "[]")
+        # Set UNCONDITIONALLY: a complete round must clear the gauges,
+        # or one transient partial round would alert forever.
+        _registry().gauge(
+            "hvd_metrics_tree_unreported_hosts",
+            "Hosts whose digest missed the last tree sync round"
+        ).set(len(failed))
+        _registry().gauge(
+            "hvd_metrics_tree_unreported_ranks",
+            "Ranks whose snapshot missed the last tree sync round"
+        ).set(len(missing))
+        return self._absorb(report, warn=warn)
+
+    def _absorb(self, report: List[RankHealth],
+                warn: bool = True) -> List[RankHealth]:
         reg = _registry()
         flagged = [h for h in report if h.flagged]
         with self._lock:

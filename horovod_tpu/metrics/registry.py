@@ -347,6 +347,24 @@ class MetricsRegistry:
                     out[fam.name + suffix] = child.value
         return out
 
+    def scalar_kinds(self) -> Dict[str, str]:
+        """{flat scalar key: "counter" | "gauge"} for every key
+        :meth:`scalars` emits — the digest merge rule's steering table
+        (metrics/digest.py): counters (and histogram ``_sum``/``_count``
+        reductions, which are monotone like counters) merge by sum,
+        gauges keep (min, max, last)."""
+        out: Dict[str, str] = {}
+        for fam, children in self.collect():
+            for key, _child in children:
+                suffix = "" if not key else \
+                    "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+                if fam.kind == "histogram":
+                    out[fam.name + "_sum" + suffix] = "counter"
+                    out[fam.name + "_count" + suffix] = "counter"
+                else:
+                    out[fam.name + suffix] = fam.kind
+        return out
+
     def reset(self) -> None:
         """Zero every metric (families and children stay registered —
         cached child references at call sites remain valid)."""

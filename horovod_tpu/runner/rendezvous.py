@@ -102,8 +102,12 @@ class _KVHandler(BaseHTTPRequestHandler):
         pass
 
     def _split(self) -> Tuple[str, str]:
-        parts = self.path.strip("/").split("/", 1)
+        stripped = self.path.strip("/")
+        parts = stripped.split("/", 1)
         if len(parts) == 1:
+            if stripped and self.path.endswith("/"):
+                # "/<scope>/" — a scope listing request (empty key).
+                return parts[0], ""
             return "", parts[0]
         return parts[0], parts[1]
 
@@ -134,6 +138,21 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         if not self._verify("GET", scope, key):
             return self._reject()
+        if key == "":
+            # Scope listing: GET /<scope>/ returns the scope's key set
+            # as a JSON array (signed as a GET of the empty key).  What
+            # lets fleet tooling DISCOVER published endpoints — observer
+            # addresses, per-rank flight addrs — instead of guessing
+            # index ranges (debug/merge.py --from-fleet).
+            import json as _json
+            keys = self.server.store_keys(scope)  # type: ignore[attr-defined]
+            body = _json.dumps(keys).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if scope == "debug" and key == "time":
             # Virtual key: the server's wall clock, sampled at handling
             # time — the reference point every rank's clock-offset
@@ -183,6 +202,10 @@ class _KVServer(ThreadingHTTPServer):
     def store_delete(self, scope: str, key: str):
         with self._lock:
             self._store.pop((scope, key), None)
+
+    def store_keys(self, scope: str):
+        with self._lock:
+            return sorted(k for s, k in self._store if s == scope)
 
 
 class BackgroundHTTPServer:
@@ -258,6 +281,44 @@ def http_get(addr: str, scope: str, key: str, timeout: float = 5.0,
         return None
     except OSError:
         return None
+
+
+def http_list(addr: str, scope: str, timeout: float = 5.0,
+              secret: Optional[str] = None) -> Optional[list]:
+    """List a scope's published keys (the GET-of-empty-key listing
+    above).  None on failure — callers that can enumerate another way
+    (a known host count) should."""
+    raw = http_get(addr, scope, "", timeout=timeout, secret=secret)
+    if raw is None:
+        return None
+    import json as _json
+    try:
+        out = _json.loads(raw.decode())
+    except ValueError:
+        return None
+    return out if isinstance(out, list) else None
+
+
+def http_delete(addr: str, scope: str, key: str, timeout: float = 5.0,
+                secret: Optional[str] = None) -> bool:
+    """Unpublish a key (e.g. an observer address at teardown, so fleet
+    tooling stops probing departed hosts).  Best-effort like the other
+    clients."""
+    import urllib.error
+    import urllib.request
+    from .. import net as _net
+    secret = secret or _env_secret()
+    req = urllib.request.Request(
+        f"http://{addr}/{scope}/{key}", method="DELETE")
+    if secret:
+        req.add_header(_SIG_HEADER,
+                       _signature(secret, "DELETE", scope, key))
+    try:
+        _net.request_bytes(req, timeout=timeout,
+                           name=f"kv.delete.{scope}")
+        return True
+    except (urllib.error.HTTPError, OSError):
+        return False
 
 
 def http_put(addr: str, scope: str, key: str, value: bytes,

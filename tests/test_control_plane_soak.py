@@ -1,0 +1,59 @@
+"""The 1000-rank / 125-host control-plane soak (ISSUE 13 acceptance,
+ROADMAP item 4's measure-on-sandbox discipline).
+
+Slow-marked on purpose — the soak pushes thousands of real HTTP
+requests through one rendezvous KV per mode and scale; it runs in the
+slow CI tier (``ci/run_test_tiers.sh slow``), never in tier 1.  Fast
+algebra/observer coverage lives in ``tests/test_observe_plane.py``.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(540)
+def test_control_plane_soak_tree_beats_flat():
+    """Fake workers, real digest/merge/observer/gateway code paths:
+    at the simulated 1000-rank point the tree path must cut
+    coordinator-handled bytes per sync round by >= 5x vs the flat
+    allgather, grow O(hosts) not O(ranks), and agree with the flat
+    path's straggler verdicts at every scale."""
+    import bench
+
+    os.environ["BENCH_CP_SCALES"] = "4,64,1000"
+    os.environ["BENCH_CP_ROUNDS"] = "1"
+    try:
+        payload = bench.bench_control_plane()
+    finally:
+        os.environ.pop("BENCH_CP_SCALES", None)
+        os.environ.pop("BENCH_CP_ROUNDS", None)
+
+    assert payload["parity_ok"], \
+        "flat and tree straggler verdicts diverged"
+    by_ranks = {s["ranks"]: s for s in payload["scales"]}
+    top = by_ranks[1000]
+    assert top["ratio_bytes"] >= 5.0, top
+    # O(hosts), not O(ranks): growing the world 1000/64 = 15.6x grows
+    # tree-side coordinator bytes about like the host count (125/8 =
+    # 15.6x of a PER-HOST payload), so the flat/tree ratio must not
+    # collapse as the world grows — the flat side grows at least as
+    # fast.  Allow sandbox noise around equality.
+    assert top["ratio_bytes"] >= by_ranks[64]["ratio_bytes"] * 0.8
+    # Coordinator wall time follows the same shape.
+    assert top["flat"]["coord_wall_s_min"] > \
+        top["tree"]["coord_wall_s_min"]
+    # The end-to-end drill (real observers + gateway) converged every
+    # host onto one fleet digest and the gateway retained the sample.
+    assert payload["e2e"]["all_hosts_converged"]
+    assert payload["e2e"]["gateway_sample_ranks"] == \
+        payload["e2e"]["ranks"]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -412,6 +412,54 @@ def _free_port():
     return port
 
 
+def _probe_noop():
+    pass
+
+
+_LOAD_FACTOR = None
+
+# Nominal probe costs on an idle machine (measured on this container:
+# spawn+join of a no-op process ~0.5 s, the 2M-add loop ~0.1 s).  The
+# drill deadlines below were sized against an idle machine too, so the
+# measured/nominal ratio is exactly the factor they need.
+_NOMINAL_SPAWN_S = 0.6
+_NOMINAL_CPU_S = 0.12
+
+
+def _load_factor():
+    """Per-machine deadline scale, measured once per module: time one
+    spawn-context process round-trip (what every native drill pays 4x)
+    and a fixed CPU workload.  Under concurrent sandbox load both
+    stretch together with the drill's real work, so scaling the
+    HARNESS deadlines by the same factor keeps the drills
+    deterministic-in-outcome instead of flaking on wall clocks sized
+    for an idle machine (PR 12 verification hit exactly that).  Clamped
+    to [1, 8] and disclosed on stderr."""
+    global _LOAD_FACTOR
+    if _LOAD_FACTOR is not None:
+        return _LOAD_FACTOR
+    ctx = mp.get_context("spawn")
+    t0 = time.perf_counter()
+    p = ctx.Process(target=_probe_noop)
+    p.start()
+    p.join()
+    spawn_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(2_000_000):
+        acc += i
+    cpu_s = time.perf_counter() - t0
+    factor = max(1.0, min(max(spawn_s / _NOMINAL_SPAWN_S,
+                              cpu_s / _NOMINAL_CPU_S), 8.0))
+    _LOAD_FACTOR = factor
+    sys.stderr.write(
+        f"net_resilience: machine load factor {factor:.2f}x "
+        f"(spawn probe {spawn_s:.2f}s vs {_NOMINAL_SPAWN_S}s nominal, "
+        f"cpu probe {cpu_s:.2f}s vs {_NOMINAL_CPU_S}s nominal); "
+        "drill harness deadlines scaled accordingly\n")
+    return factor
+
+
 def _chaos_worker(rank, size, port, env, iters, out_queue):
     sys.path.insert(0, REPO)
     os.environ.update(env)
@@ -441,6 +489,13 @@ def _chaos_worker(rank, size, port, env, iters, out_queue):
 
 
 def _run_chaos_job(env, size=4, iters=14, timeout=150):
+    # Harness deadlines (NOT the ladder's own budgets, which are part
+    # of what the drills test) scale with the measured machine load —
+    # a drill that takes 40 s idle can legitimately take minutes under
+    # a saturated sandbox, and only the OUTCOME is the assertion.  The
+    # cap keeps the scaled wait under the drill tests' 600 s
+    # @pytest.mark.timeout ceiling.
+    timeout = min(timeout * _load_factor(), 540)
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -457,7 +512,7 @@ def _run_chaos_job(env, size=4, iters=14, timeout=150):
             rank, status, payload = q.get(timeout=timeout)
             results[rank] = (status, payload)
     finally:
-        deadline = time.time() + 30
+        deadline = time.time() + 30 * _load_factor()
         for p in procs:
             p.join(timeout=max(0.1, deadline - time.time()))
         for p in procs:
@@ -467,6 +522,7 @@ def _run_chaos_job(env, size=4, iters=14, timeout=150):
     return results
 
 
+@pytest.mark.timeout(600)
 class TestNativeLadder:
     def test_reconnect_and_resume_bit_exact(self):
         """THE acceptance drill: >=1% connection resets + 0.5% dropped

@@ -1,0 +1,776 @@
+"""The fleet-scale observability plane (ISSUE 13).
+
+* digest algebra — associativity/commutativity goldens, quantile-sketch
+  error bounds on adversarial distributions, counters-sum /
+  gauges-(min,max,last) merge rules, bounded top-K outlier evidence;
+* flat-vs-tree straggler verdict parity on a synthetic fleet;
+* the per-host observer — local merge, round grace for laggard ranks
+  (missing ranks NAMED), the O(hosts) KV exchange with a crashed host
+  named in ``failed_hosts``, the one-request-per-host dump fan-in;
+* the gateway fleet timeline — ingest/series/retention,
+  ``/fleet/metrics`` exposition, HMAC on the observe endpoints;
+* the new debug surfaces — ``/debug/autotune`` (loop_status over HTTP)
+  and ``/debug/fleet_scalars`` on both mounts, KV scope listing;
+* ``JsonlSink`` retention (``HVD_TPU_METRICS_RETAIN_FILES``).
+
+The 1000-rank control-plane soak lives in
+``tests/test_control_plane_soak.py`` (slow tier).
+"""
+
+import json
+import os
+import statistics
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.metrics import digest as D  # noqa: E402
+from horovod_tpu.metrics.digest import QuantileSketch  # noqa: E402
+from horovod_tpu.metrics.health import StragglerDetector  # noqa: E402
+
+
+def _snap(rank, mean=0.1, steps=10, wait=0.002, ckpt=0.0,
+          scalars=None, step=10):
+    times = [mean] * steps
+    wall = sum(times)
+    return {
+        "rank": rank, "step": step,
+        "step_time_sum": wall, "step_count": steps,
+        "data_wait_sum": wait * steps, "data_wait_count": steps,
+        "sketch": QuantileSketch.of(times).to_dict(),
+        "attr": {"steps": float(steps), "flops": 0.0, "wall": wall,
+                 "compute": wall - ckpt - 2 * wait * steps,
+                 "comm_exposed": wait * steps, "input": wait * steps,
+                 "checkpoint": ckpt, "host": 0.0},
+        "scalars": dict(scalars or {}),
+    }
+
+
+def _digest_close(a, b, rel=1e-9, path=""):
+    """Recursive near-equality for merged digests (float sums are not
+    bitwise associative)."""
+    assert type(a) is type(b), f"{path}: {type(a)} vs {type(b)}"
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a) ^ set(b)}"
+        for k in a:
+            _digest_close(a[k], b[k], rel, f"{path}.{k}")
+    elif isinstance(a, list):
+        assert len(a) == len(b), f"{path}: len {len(a)} vs {len(b)}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _digest_close(x, y, rel, f"{path}[{i}]")
+    elif isinstance(a, float):
+        assert a == pytest.approx(b, rel=rel), f"{path}: {a} vs {b}"
+    else:
+        assert a == b, f"{path}: {a} vs {b}"
+
+
+# ---------------------------------------------------------------------------
+# QuantileSketch
+# ---------------------------------------------------------------------------
+
+class TestQuantileSketch:
+    def test_error_bound_adversarial_distributions(self):
+        """The sketch's median stays within its advertised relative
+        bound of the exact median on shapes built to stress log
+        buckets: heavy lognormal tail, extreme bimodal, constants, and
+        values straddling one bucket boundary."""
+        import random
+        rng = random.Random(3)
+        dists = {
+            "lognormal": [rng.lognormvariate(-2.0, 1.5)
+                          for _ in range(999)],
+            "bimodal": [0.001] * 499 + [10.0] * 500,
+            "constant": [0.25] * 101,
+            "boundary": [0.1 * (1.0 + 0.001 * (i % 3))
+                         for i in range(99)],
+            "microseconds": [2e-6 * (1 + rng.random())
+                             for _ in range(999)],
+        }
+        # Bucket width bound (sqrt(gamma)-1 each side) plus slack for
+        # the rank-discretization step on even-ish counts.
+        bound = 0.05
+        for name, values in dists.items():
+            s = QuantileSketch.of(values)
+            exact = statistics.median(values)
+            got = s.quantile(0.5)
+            assert got == pytest.approx(exact, rel=bound), \
+                f"{name}: sketch {got} vs exact {exact}"
+            assert s.min == pytest.approx(min(values))
+            assert s.max == pytest.approx(max(values))
+            assert s.mean() == pytest.approx(
+                statistics.fmean(values), rel=1e-9)
+
+    def test_median_interpolates_on_even_counts(self):
+        """statistics.median semantics: a 2-value sketch's median is
+        the midpoint, not the lower value — the lower-median would sit
+        a whole inter-rank gap below the flat path's baseline and flip
+        straggler verdicts near the 1.5x factor (0.16/0.10: midpoint
+        baseline scores 1.23, lower-median baseline scores 1.6)."""
+        s = QuantileSketch.of([0.10, 0.16])
+        assert s.median() == pytest.approx(0.13, rel=0.03)
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+        snaps = [_snap(0, mean=0.10), _snap(1, mean=0.16)]
+        flat = [h.rank for h in det.score_ranks(snaps) if h.flagged]
+        fleet = D.merge_all([D.snapshot_digest([s_], host=f"h{i}")
+                             for i, s_ in enumerate(snaps)])
+        tree = [h.rank for h in det.score_digest(fleet) if h.flagged]
+        assert flat == tree == []
+
+    def test_fixed_size_under_any_volume(self):
+        s = QuantileSketch()
+        for i in range(100_000):
+            s.add(1e-7 + (i % 1000) * 0.01)
+        assert len(s.buckets) <= QuantileSketch.MAX_INDEX + 1
+        assert s.count == 100_000
+
+    def test_merge_equals_bulk(self):
+        # Power-of-two values: float sums are then order-independent,
+        # so the merged dict must match the bulk dict EXACTLY.
+        a, b, bulk = QuantileSketch(), QuantileSketch(), QuantileSketch()
+        for i, v in enumerate([0.25, 0.5, 2.0, 0.125, 4.0, 8.0]):
+            (a if i % 2 else b).add(v)
+            bulk.add(v)
+        a.merge(b)
+        assert a.to_dict() == bulk.to_dict()
+
+    def test_wire_round_trip(self):
+        s = QuantileSketch.of([0.1, 0.2, 0.4])
+        assert QuantileSketch.from_dict(
+            json.loads(json.dumps(s.to_dict()))).to_dict() == s.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# digest algebra
+# ---------------------------------------------------------------------------
+
+class TestDigestAlgebra:
+    def _three(self):
+        # Exactly-representable values so float sums cannot mask an
+        # algebra bug behind tolerance.
+        mk = lambda r, m: _snap(r, mean=m, scalars={  # noqa: E731
+            "hvd_x_total": float(r + 1), "hvd_g": float(r * 2)})
+        kinds = {"hvd_x_total": "counter", "hvd_g": "gauge"}
+        A = D.snapshot_digest([mk(0, 0.125), mk(1, 0.25)], host="h0",
+                              expected_ranks=[0, 1], scalar_kinds=kinds)
+        B = D.snapshot_digest([mk(2, 0.5)], host="h1",
+                              expected_ranks=[2, 3], scalar_kinds=kinds)
+        C = D.snapshot_digest([mk(4, 0.0625), mk(5, 1.0)], host="h2",
+                              expected_ranks=[4, 5], scalar_kinds=kinds)
+        return A, B, C
+
+    def test_associative_and_commutative(self):
+        A, B, C = self._three()
+        left = D.merge_digests(D.merge_digests(A, B), C)
+        right = D.merge_digests(A, D.merge_digests(B, C))
+        flipped = D.merge_digests(C, D.merge_digests(B, A))
+        _digest_close(left, right)
+        _digest_close(left, flipped)
+
+    def test_counters_sum_gauges_keep_min_max_last(self):
+        A, B, C = self._three()
+        m = D.merge_all([A, B, C])
+        assert m["counters"]["hvd_x_total"] == 1 + 2 + 3 + 5 + 6
+        lo, hi, last, last_rank = m["gauges"]["hvd_g"]
+        assert (lo, hi) == (0.0, 10.0)
+        assert (last, last_rank) == (10.0, 5)  # highest-rank contributor
+        assert m["ranks"] == 5
+        assert m["missing"] == [3]            # named, not averaged away
+        assert m["hosts"] == ["h0", "h1", "h2"]
+
+    def test_top_k_outliers_per_host_bounded_by_fleet_cap(self):
+        snaps = [_snap(r, mean=0.1 + 0.01 * r) for r in range(16)]
+        # One host: top_k bounds the evidence.
+        full = D.snapshot_digest(snaps, host="h", top_k=4)
+        assert [o["rank"] for o in full["outliers"]] == [15, 14, 13, 12]
+        # Two hosts: EACH host's top-K survives the merge (per-host
+        # semantics — a straggler on a fast host is not shadowed by a
+        # slow host's ranks), ordered slowest-first.
+        halves = D.merge_digests(
+            D.snapshot_digest(snaps[:8], host="h0", top_k=4),
+            D.snapshot_digest(snaps[8:], host="h1", top_k=4))
+        assert [o["rank"] for o in halves["outliers"]] == \
+            [15, 14, 13, 12, 7, 6, 5, 4]
+        assert halves["outlier_cap"] == 8
+        # The fleet ceiling bounds the union when many hosts merge.
+        many = D.merge_all([
+            D.snapshot_digest([_snap(h * 100 + i, mean=0.1)
+                               for i in range(8)],
+                              host=f"h{h}", top_k=4)
+            for h in range(20)])
+        assert many["outlier_cap"] == D.FLEET_OUTLIER_CAP
+        assert len(many["outliers"]) <= D.FLEET_OUTLIER_CAP
+
+    def test_outlier_entries_are_pruned_evidence(self):
+        d = D.snapshot_digest(
+            [_snap(0, scalars={"hvd_big": 1.0})], host="h")
+        assert "scalars" not in d["outliers"][0]
+        assert "sketch" not in d["outliers"][0]
+        assert "attr" in d["outliers"][0]
+
+    def test_shares_and_quantiles(self):
+        d = D.snapshot_digest([_snap(r, mean=0.1) for r in range(4)],
+                              host="h")
+        shares = D.digest_shares(d)
+        assert shares is not None
+        assert sum(shares.values()) == pytest.approx(1.0, rel=1e-6)
+        q = D.digest_step_quantiles(d)
+        assert q["count"] == 40
+        assert q["p50"] == pytest.approx(0.1, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# flat-vs-tree verdict parity
+# ---------------------------------------------------------------------------
+
+class TestVerdictParity:
+    def _fleet(self, ranks=32, straggler=13, cause_ckpt=True):
+        snaps = []
+        for r in range(ranks):
+            if r == straggler:
+                extra = 0.12  # 2.2x the 0.1 base
+                snaps.append(_snap(
+                    r, mean=0.1 + extra,
+                    ckpt=extra * 10 if cause_ckpt else 0.0,
+                    wait=0.062 if not cause_ckpt else 0.002))
+            else:
+                snaps.append(_snap(r, mean=0.1 + 0.001 * (r % 5)))
+        return snaps
+
+    @pytest.mark.parametrize("cause_ckpt", [True, False])
+    def test_flat_and_tree_agree(self, cause_ckpt):
+        snaps = self._fleet(cause_ckpt=cause_ckpt)
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+        flat = [(h.rank, h.cause) for h in det.score_ranks(snaps)
+                if h.flagged]
+        hosts = [snaps[i:i + 8] for i in range(0, len(snaps), 8)]
+        fleet = D.merge_all([
+            D.snapshot_digest(h, host=f"h{i}",
+                              expected_ranks=[s["rank"] for s in h])
+            for i, h in enumerate(hosts)])
+        tree = [(h.rank, h.cause) for h in det.score_digest(fleet)
+                if h.flagged]
+        assert flat and flat == tree
+
+    def test_concurrent_stragglers_on_different_hosts_all_survive(self):
+        """Per-host top-K survives the merge: 6 stragglers on 6
+        DIFFERENT hosts (more than one host's top_k=4) must all be
+        flagged by the tree path, exactly like the flat path."""
+        snaps = []
+        slow = {5, 13, 21, 29, 37, 45}  # one per host, 6 hosts
+        for r in range(48):
+            snaps.append(_snap(r, mean=0.25 if r in slow else 0.1))
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+        flat = sorted(h.rank for h in det.score_ranks(snaps)
+                      if h.flagged)
+        fleet = D.merge_all([
+            D.snapshot_digest(snaps[i:i + 8], host=f"h{i//8}", top_k=4)
+            for i in range(0, 48, 8)])
+        tree = sorted(h.rank for h in det.score_digest(fleet)
+                      if h.flagged)
+        assert flat == sorted(slow)
+        assert tree == flat
+
+    def test_healthy_fleet_flags_nothing_either_way(self):
+        snaps = self._fleet(straggler=-1)
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+        assert not [h for h in det.score_ranks(snaps) if h.flagged]
+        fleet = D.merge_all([D.snapshot_digest(snaps[i:i + 8], host="h")
+                             for i in range(0, len(snaps), 8)])
+        assert not [h for h in det.score_digest(fleet) if h.flagged]
+
+    def test_evaluate_digest_names_partial_round(self):
+        from horovod_tpu.metrics.registry import registry
+        snaps = self._fleet(ranks=8, straggler=-1)
+        d = D.snapshot_digest(snaps, host="h0",
+                              expected_ranks=list(range(10)))
+        d["failed_hosts"] = ["host3"]
+        det = StragglerDetector(factor=1.5, min_seconds=1e-3, patience=1)
+        det.evaluate_digest(d, warn=False)
+        assert registry().gauge(
+            "hvd_metrics_tree_unreported_hosts", "").value == 1
+        assert registry().gauge(
+            "hvd_metrics_tree_unreported_ranks", "").value == 2
+        # A complete round CLEARS the gauges — a transient partial must
+        # not alert forever.
+        complete = D.snapshot_digest(snaps, host="h0",
+                                     expected_ranks=list(range(8)))
+        det.evaluate_digest(complete, warn=False)
+        assert registry().gauge(
+            "hvd_metrics_tree_unreported_hosts", "").value == 0
+        assert registry().gauge(
+            "hvd_metrics_tree_unreported_ranks", "").value == 0
+
+
+# ---------------------------------------------------------------------------
+# host observer: local merge, exchange, crash tolerance, dump fan-in
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def kv():
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    srv = RendezvousServer(host="127.0.0.1")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(autouse=True)
+def _observer_hygiene():
+    from horovod_tpu.metrics import observer as OB
+    OB.reset_addr_cache()
+    yield
+    OB.stop_host_observer()
+    OB.reset_addr_cache()
+
+
+class TestHostObserver:
+    def _observer(self, kv, cross_rank=0, cross_size=1, ranks=(0, 1),
+                  host=None):
+        from horovod_tpu.metrics.observer import HostObserver
+        return HostObserver(
+            host or f"h{cross_rank}", list(ranks), cross_rank=cross_rank,
+            cross_size=cross_size,
+            rdv_addr=f"127.0.0.1:{kv.port}").start()
+
+    def test_two_hosts_exchange_to_one_fleet_digest(self, kv,
+                                                    monkeypatch):
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.3")
+        ob0 = self._observer(kv, 0, 2, (0, 1))
+        ob1 = self._observer(kv, 1, 2, (2, 3))
+        try:
+            for r in (0, 1):
+                ob0.submit_snapshot(1, _snap(r))
+            for r in (2, 3):
+                ob1.submit_snapshot(1, _snap(r, mean=0.3 if r == 3
+                                             else 0.1))
+            f0 = ob0.fleet_digest(min_round=1, wait_s=10)
+            f1 = ob1.fleet_digest(min_round=1, wait_s=10)
+            assert f0 is not None and f0["ranks"] == 4
+            assert f1 is not None and f1["ranks"] == 4
+            assert f0["hosts"] == ["h0", "h1"]
+            assert [o["rank"] for o in f0["outliers"]][0] == 3
+        finally:
+            ob0.stop()
+            ob1.stop()
+
+    def test_crashed_host_named_in_failed_hosts(self, kv, monkeypatch):
+        """Host 1 never reports: the root seals the round partial
+        within the exchange deadline and NAMES the absent host."""
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_TIMEOUT_S", "1")
+        ob0 = self._observer(kv, 0, 2, (0, 1))
+        try:
+            for r in (0, 1):
+                ob0.submit_snapshot(1, _snap(r))
+            f = ob0.fleet_digest(min_round=1, wait_s=10)
+            assert f is not None
+            assert f["failed_hosts"] == ["host1"]
+            assert f["ranks"] == 2
+        finally:
+            ob0.stop()
+
+    def test_dead_host_does_not_starve_later_hosts(self, kv,
+                                                   monkeypatch):
+        """Host 1 of 3 is dead; host 2 published on time.  The root's
+        gather must still merge host 2 (a serial per-host wait would
+        burn the whole deadline on host 1 and mark host 2 failed with
+        zero fetch attempts)."""
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_TIMEOUT_S", "3")
+        ob0 = self._observer(kv, 0, 3, (0, 1))
+        ob2 = self._observer(kv, 2, 3, (4, 5))
+        try:
+            for r in (4, 5):
+                ob2.submit_snapshot(1, _snap(r))
+            for r in (0, 1):
+                ob0.submit_snapshot(1, _snap(r))
+            f = ob0.fleet_digest(min_round=1, wait_s=10)
+            assert f is not None
+            assert f["ranks"] == 4          # host 2's ranks made it in
+            assert f["hosts"] == ["h0", "h2"]
+            assert f["failed_hosts"] == ["host1"]
+        finally:
+            ob0.stop()
+            ob2.stop()
+
+    def test_shutdown_stops_host_observer(self, kv, monkeypatch):
+        """init(METRICS_TREE) starts the observer; shutdown() must stop
+        it (its exchange thread is hvd-tpu-* named) so a re-init after
+        an elastic renumber builds a fresh identity."""
+        import horovod_tpu as hvd
+        from horovod_tpu.metrics import observer as OB
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE", "1")
+        hvd.init()
+        assert OB.current_observer() is not None
+        hvd.shutdown()
+        assert OB.current_observer() is None
+
+    def test_laggard_local_rank_named_missing(self, kv, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        ob = self._observer(kv, 0, 1, (0, 1, 2))
+        try:
+            ob.submit_snapshot(1, _snap(0))
+            ob.submit_snapshot(1, _snap(1))  # rank 2 never shows
+            f = ob.fleet_digest(min_round=1, wait_s=10)
+            assert f is not None
+            assert f["missing"] == [2]
+            assert f["ranks"] == 2
+        finally:
+            ob.stop()
+
+    def test_late_snapshot_for_sealed_round_dropped(self, kv,
+                                                    monkeypatch):
+        """A retried/delayed push for an already-sealed round must not
+        re-open it (it would republish a stale mostly-missing digest);
+        it is dropped and counted."""
+        from horovod_tpu.metrics.registry import registry
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        ob = self._observer(kv, 0, 1, (0, 1))
+        try:
+            for r in (0, 1):
+                ob.submit_snapshot(1, _snap(r))
+            f1 = ob.fleet_digest(min_round=1, wait_s=10)
+            assert f1 is not None and f1["ranks"] == 2
+            late = registry().counter(
+                "hvd_observe_late_snapshots_total", "").value
+            ob.submit_snapshot(1, _snap(0))  # the delayed retry
+            assert registry().counter(
+                "hvd_observe_late_snapshots_total", "").value == late + 1
+            # The published digest is still round 1's complete one.
+            assert ob.host_digest()["ranks"] == 2
+        finally:
+            ob.stop()
+
+    def test_reset_rounds_survives_elastic_reset(self, kv, monkeypatch):
+        """After an elastic reset the round clock restarts at 1: the
+        observer must accept the new world's snapshots (not drop them
+        as 'late') and must not serve the pre-reset fleet digest."""
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        ob = self._observer(kv, 0, 1, (0,))
+        try:
+            for r in (1, 2, 3):
+                ob.submit_snapshot(r, _snap(0, mean=0.5))
+            assert ob.fleet_digest(min_round=3, wait_s=10) is not None
+            ob.reset_rounds()
+            assert ob.fleet_digest(min_round=1, wait_s=0) is None
+            ob.submit_snapshot(1, _snap(0, mean=0.1))
+            f = ob.fleet_digest(min_round=1, wait_s=10)
+            assert f is not None
+            # The digest is the POST-reset world's (mean 0.1, not 0.5).
+            assert f["window"]["step_time_sum"] == pytest.approx(1.0)
+        finally:
+            ob.stop()
+
+    def test_http_snapshot_push_and_fleet_fetch(self, kv, monkeypatch):
+        from horovod_tpu.metrics import observer as OB
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        ob = self._observer(kv, 0, 1, (0,))
+        try:
+            addr = ob.addr
+            assert OB.push_snapshot(addr, 1, _snap(0))
+            f = OB.fetch_fleet_digest(addr, min_round=1, wait_s=5)
+            assert f is not None and f["ranks"] == 1
+            # Address is discoverable through the KV.
+            assert OB.observer_addr_for(
+                0, rdv_addr=f"127.0.0.1:{kv.port}",
+                cached=False) == addr
+        finally:
+            ob.stop()
+        # stop() unpublishes: fleet tooling must not keep probing a
+        # departed host's address.
+        assert OB.observer_addr_for(
+            0, rdv_addr=f"127.0.0.1:{kv.port}", cached=False) is None
+
+    def test_dump_fan_in_one_request_per_host(self, kv):
+        """/observe/dumps returns every local rank's flight dump in one
+        response; an unreachable sibling is a null entry, not an
+        error."""
+        from horovod_tpu.debug import flight as _flight
+        from horovod_tpu.metrics import observer as OB
+        _flight.set_identity(rank=0, world=2)
+        ob = self._observer(kv, 0, 1, (0, 7777))  # 7777: no endpoint
+        try:
+            dumps = OB.fetch_host_dumps(ob.addr)
+            assert dumps is not None
+            assert dumps[0] is not None  # in-process dump
+            assert dumps[7777] is None
+        finally:
+            ob.stop()
+
+    def test_aggregator_tree_sync_local_fallback(self, monkeypatch):
+        """METRICS_TREE with no observer reachable: sync degrades to a
+        local-only digest (never a collective), and the digest read
+        surface works."""
+        from horovod_tpu.metrics.aggregate import Aggregator
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE", "1")
+        agg = Aggregator()
+        for i in range(5):
+            agg.step_end(0.01, step=i)
+        out = agg.sync()
+        d = agg.fleet_digest()
+        assert d is not None and d["ranks"] == 1
+        assert isinstance(out, list)
+        assert d["window"]["step_count"] == 5  # explicit times: all count
+
+
+# ---------------------------------------------------------------------------
+# gateway fleet timeline
+# ---------------------------------------------------------------------------
+
+class TestFleetTimeline:
+    def _store(self, retain=None):
+        from horovod_tpu.fleet.observe import FleetSeriesStore
+        return FleetSeriesStore(retain=retain)
+
+    def _host_digest(self, ranks, host="h0", round_idx=1, mean=0.1):
+        d = D.snapshot_digest([_snap(r, mean=mean) for r in ranks],
+                              host=host)
+        d["round"] = round_idx
+        return d
+
+    def test_same_round_pushes_merge_into_one_sample(self):
+        store = self._store()
+        store.ingest("j", self._host_digest((0, 1), "h0", 1), now=10.0)
+        store.ingest("j", self._host_digest((2, 3), "h1", 1), now=11.0)
+        rows = store.series("j")
+        assert len(rows) == 1
+        assert rows[0]["ranks"] == 4 and rows[0]["hosts"] == 2
+        assert rows[0]["open"] is True
+        # A newer round seals the previous into the ring.
+        store.ingest("j", self._host_digest((0, 1), "h0", 2), now=12.0)
+        rows = store.series("j")
+        assert len(rows) == 2
+        assert "open" not in rows[0] and rows[0]["ranks"] == 4
+
+    def test_late_push_to_sealed_round_dropped(self):
+        """A straggling host's push for a recently-sealed round must
+        not re-open it as a duplicate out-of-order sample."""
+        store = self._store()
+        store.ingest("j", self._host_digest((0, 1), "h0", 4), now=1.0)
+        store.ingest("j", self._host_digest((0, 1), "h0", 5), now=2.0)
+        store.ingest("j", self._host_digest((2, 3), "h1", 4), now=3.0)
+        rows = store.series("j")
+        assert [s["round"] for s in rows] == [4, 5]
+        assert rows[0]["ranks"] == 2  # NOT a second round-4 sample
+        assert store.stats()["late_drops"] == 1
+
+    def test_round_clock_restart_starts_fresh_epoch(self):
+        """A job resubmission/elastic reset restarts rounds at 1 —
+        far below the sealed high-water mark: the store must treat it
+        as a new epoch, not drop everything forever."""
+        store = self._store()
+        for r in (40, 41):
+            store.ingest("j", self._host_digest((0,), "h0", r),
+                         now=float(r))
+        store.ingest("j", self._host_digest((0, 1), "h0", 1), now=50.0)
+        rows = store.series("j")
+        assert rows[-1]["round"] == 1 and rows[-1]["open"] is True
+        store.ingest("j", self._host_digest((0, 1), "h0", 2), now=51.0)
+        assert [s["round"] for s in store.series("j")
+                if "open" not in s] == [40, 41, 1]
+
+    def test_retention_ring_bounded(self):
+        store = self._store(retain=5)
+        for r in range(1, 20):
+            store.ingest("j", self._host_digest((0,), "h0", r),
+                         now=float(r))
+        rows = [s for s in store.series("j") if "open" not in s]
+        assert len(rows) == 5
+        assert rows[0]["round"] == 14  # oldest retained
+
+    def test_non_digest_rejected(self):
+        with pytest.raises(ValueError):
+            self._store().ingest("j", {"not": "a digest"})
+
+    def test_field_poor_digest_rejected_without_poisoning_round(self):
+        """A version-stamped but field-poor digest must 400 at intake —
+        stored unvalidated it would make every later legitimate push
+        for the same round fail the merge."""
+        store = self._store()
+        with pytest.raises(ValueError):
+            store.ingest("j", {"v": 1, "round": 5})
+        good = self._host_digest((0, 1), "h0", 5)
+        store.ingest("j", good, now=1.0)
+        store.ingest("j", self._host_digest((2, 3), "h1", 5), now=2.0)
+        assert store.series("j")[-1]["ranks"] == 4
+
+    def test_exposition_escapes_tenant_job_ids(self):
+        store = self._store()
+        store.ingest('ab"c\\d', self._host_digest((0,)), now=1.0)
+        text = store.render_prometheus()
+        assert 'job="ab\\"c\\\\d"' in text
+        assert 'job="ab"c' not in text
+
+    def test_gateway_http_surface(self, tmp_path):
+        import horovod_tpu.fleet as fleet
+        gw = fleet.FleetGateway(hosts=[], port=0,
+                                fleet_dir=str(tmp_path / "fleet"))
+        port = gw.serve()
+        addr = f"127.0.0.1:{port}"
+        try:
+            fleet.push_observation("jobZ", self._host_digest((0, 1)),
+                                   addr=addr)
+            assert fleet.list_observed_jobs(addr=addr) == ["jobZ"]
+            obs = fleet.get_observation("jobZ", addr=addr)
+            assert obs["series"][-1]["ranks"] == 2
+            assert fleet.get_observation("nope", addr=addr) is None
+            # A known job with an empty ?since= window is 200 + empty
+            # series, NOT a 404 — idle poll intervals must not read as
+            # "series disappeared".
+            idle = fleet.get_observation("jobZ", addr=addr,
+                                         since=4e12)
+            assert idle is not None and idle["series"] == []
+            with urllib.request.urlopen(
+                    f"http://{addr}/fleet/metrics", timeout=5) as resp:
+                text = resp.read().decode()
+            assert 'hvd_fleet_job_step_time_mean_seconds{job="jobZ"}' \
+                in text
+            assert "hvd_fleet_job_component_share" in text
+        finally:
+            gw.close()
+
+    def test_observe_endpoints_hmac_gated(self, tmp_path, monkeypatch):
+        import horovod_tpu.fleet as fleet
+        gw = fleet.FleetGateway(hosts=[], port=0,
+                                fleet_dir=str(tmp_path / "fleet"),
+                                secret="s3cret")
+        port = gw.serve()
+        addr = f"127.0.0.1:{port}"
+        try:
+            monkeypatch.setenv("HVD_TPU_FLEET_SECRET", "s3cret")
+            fleet.push_observation("j", self._host_digest((0,)),
+                                   addr=addr)
+            monkeypatch.setenv("HVD_TPU_FLEET_SECRET", "wrong")
+            with pytest.raises(PermissionError):
+                fleet.push_observation("j", self._host_digest((0,)),
+                                       addr=addr)
+            with pytest.raises(PermissionError):
+                fleet.get_observation("j", addr=addr)
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# new debug surfaces + KV listing + sink retention
+# ---------------------------------------------------------------------------
+
+class TestNewSurfaces:
+    def test_kv_scope_listing(self, kv):
+        from horovod_tpu.runner.rendezvous import http_list
+        kv.put("observe", "addr_0", b"a")
+        kv.put("observe", "addr_2", b"b")
+        kv.put("debug", "flight_addr_1", b"c")
+        addr = f"127.0.0.1:{kv.port}"
+        assert http_list(addr, "observe") == ["addr_0", "addr_2"]
+        assert http_list(addr, "debug") == ["flight_addr_1"]
+        assert http_list(addr, "empty_scope") == []
+
+    def test_debug_autotune_endpoint_404_then_served(self, monkeypatch):
+        from horovod_tpu import autotune as at
+        from horovod_tpu.debug import http as dhttp
+        server = dhttp.DebugServer(host="127.0.0.1")
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/debug/autotune",
+                                       timeout=5)
+            assert e.value.code == 404
+            pm = at.ParameterManager(lambda *a, **kw: None)
+            monkeypatch.setattr(at, "_active_manager", pm)
+            with urllib.request.urlopen(f"{base}/debug/autotune",
+                                        timeout=5) as resp:
+                status = json.loads(resp.read().decode())
+            assert "frozen" in status and "retunes" in status
+        finally:
+            server.stop()
+
+    def test_debug_fleet_scalars_endpoint(self):
+        from horovod_tpu.debug import http as dhttp
+        server = dhttp.DebugServer(host="127.0.0.1")
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(f"{base}/debug/fleet_scalars",
+                                        timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+            assert "ranks" in payload
+        finally:
+            server.stop()
+
+    def test_metrics_port_mounts_new_surfaces(self):
+        from horovod_tpu.metrics.exporters import MetricsServer
+        server = MetricsServer(host="127.0.0.1")
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(f"{base}/debug/fleet_scalars",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/observe/digest",
+                                       timeout=5)
+            assert e.value.code == 404  # no observer on this host
+        finally:
+            server.stop()
+
+    def test_metrics_port_serves_observer_when_running(self, kv,
+                                                       monkeypatch):
+        from horovod_tpu.metrics import observer as OB
+        from horovod_tpu.metrics.exporters import MetricsServer
+        monkeypatch.setenv("HVD_TPU_METRICS_TREE_GRACE_S", "0.2")
+        from horovod_tpu.core.state import global_state
+        monkeypatch.setattr(global_state, "initialized", True,
+                            raising=False)
+        ob = OB.start_host_observer(
+            host="hX", local_ranks=[0], cross_rank=0, cross_size=1,
+            rdv_addr=f"127.0.0.1:{kv.port}")
+        server = MetricsServer(host="127.0.0.1")
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            ob.submit_snapshot(1, _snap(0))
+            ob.fleet_digest(min_round=1, wait_s=5)
+            with urllib.request.urlopen(f"{base}/observe/digest",
+                                        timeout=5) as resp:
+                d = json.loads(resp.read().decode())
+            assert d["hosts"] == ["hX"]
+        finally:
+            server.stop()
+
+    def test_hang_report_hosts_section(self):
+        from horovod_tpu.debug.hang import build_hang_report
+        report = build_hang_report(
+            [{"name": "t", "type": 0, "missing": [1]}],
+            {0: {"events": []}, 1: None}, world=2, step=3,
+            host_status={"host[1]@1.2.3.4:80":
+                         "unreachable (per-rank fallback)"})
+        assert report["hosts"] == {
+            "host[1]@1.2.3.4:80": "unreachable (per-rank fallback)"}
+
+    def test_jsonl_sink_retention_knob(self, tmp_path, monkeypatch):
+        from horovod_tpu.metrics.exporters import JsonlSink
+        path = str(tmp_path / "m.jsonl")
+        # A loose sink leaves 5 backups...
+        loose = JsonlSink(path, max_bytes=64, backups=5)
+        for i in range(40):
+            loose.write({"i": i, "pad": "x" * 32})
+        assert os.path.exists(f"{path}.5")
+        # ...a re-created sink under a tighter knob prunes them.
+        monkeypatch.setenv("HVD_TPU_METRICS_RETAIN_FILES", "2")
+        tight = JsonlSink(path, max_bytes=64)
+        assert tight.backups == 2
+        assert not os.path.exists(f"{path}.3")
+        assert not os.path.exists(f"{path}.5")
+        for i in range(40):
+            tight.write({"i": i, "pad": "x" * 32})
+        assert os.path.exists(f"{path}.2")
+        assert not os.path.exists(f"{path}.3")
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
