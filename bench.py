@@ -2964,6 +2964,406 @@ def _control_plane_e2e_drill(local_size, hosts=8):
         kv.stop()
 
 
+def _zero_gather_worker(rank, size, port, iters, out_queue):
+    """One rank of the ZeRO-3 gather bench job (top-level for spawn):
+    times the SAME parameter allgathers and the SAME compute scheduled
+    barrier-style (gather everything, then compute) vs forward-prefetch
+    (launch every bucket up front, take each just before its layer's
+    compute), through the shipped EagerGatherQueue + native controller
+    on the shm data plane."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    os.environ["HVD_TPU_CYCLE_TIME"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.metrics.registry import registry
+    from horovod_tpu.native.controller import NativeController
+    from horovod_tpu.ops import overlap as ov
+    ctl = None
+    try:
+        ctl = NativeController(rank, size, f"127.0.0.1:{port}")
+        global_state.controller = ctl
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import transformer as tfm
+        cfg = tfm.TransformerConfig(
+            vocab_size=2048,
+            d_model=int(os.environ.get("BENCH_ZERO_DMODEL", "256")),
+            n_heads=4, d_ff=1024,
+            n_layers=int(os.environ.get("BENCH_ZERO_LAYERS", "4")),
+            seq_len=64, dtype=jnp.float32)
+        par = tfm.ParallelConfig(dp=1, pp=1, mp=1)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg, par)
+        likes = [np.asarray(x, dtype=np.float32)
+                 for x in jax.tree_util.tree_leaves(params)]
+        bucket_bytes = int(os.environ.get("BENCH_ZERO_BUCKET_BYTES",
+                                          str(4 << 20)))
+        plan = ov.plan_buckets(likes, bucket_bytes, record=False,
+                               order="forward")
+        nb = plan.n_buckets
+
+        from horovod_tpu.checkpoint import shard_of
+
+        def my_shards(bucket):
+            # The golden-tested layout helper — the same slice
+            # _my_shard/the engine use, not a re-derivation.
+            return [np.ascontiguousarray(shard_of(likes[i], size, rank))
+                    for i in plan.buckets[bucket]]
+
+        shard_sets = [my_shards(b) for b in range(nb)]
+
+        def gather_all(name, interleave_s=0.0):
+            """One step's gathers: launch every bucket, then take each
+            (computing for interleave_s between takes — the forward
+            layers the prefetch hides behind)."""
+            q = ov.EagerGatherQueue(plan, like=likes, name=name,
+                                    world=size)
+            for b in range(nb):
+                q.launch(b, shard_sets[b])
+            for b in range(nb):
+                q.take(b)
+                if interleave_s:
+                    spin(interleave_s)
+            q.drain()
+
+        def spin(seconds):
+            a = np.ones((96, 96), dtype=np.float32)
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                a = np.tanh(a @ a.T * 1e-4)
+
+        gather_all("warm.0")  # mesh + buffers warm
+        t0 = time.perf_counter()
+        for i in range(iters):
+            gather_all(f"g.{i % 2}")
+        t_gather = (time.perf_counter() - t0) / iters
+        slice_s = t_gather / nb  # compute ~= wire: bandwidth-bound regime
+
+        def barrier_step(i):
+            # Gather EVERYTHING, then all the forward compute.
+            gather_all(f"bar.{i % 2}")
+            for _b in range(nb):
+                spin(slice_s)
+
+        def prefetch_step(i):
+            # Launch all buckets up front; each layer's compute runs
+            # while later buckets are still on the wire.
+            gather_all(f"pre.{i % 2}", interleave_s=slice_s)
+
+        for fn in (barrier_step, prefetch_step):
+            fn(98)  # warm this schedule's name set
+        reg = registry()
+
+        def counter(name):
+            fam = reg.snapshot().get(name) or {}
+            return float(sum(s.get("value", 0.0)
+                             for s in fam.get("series", [])))
+
+        t0 = time.perf_counter()
+        for i in range(iters):
+            barrier_step(i)
+        t_barrier = (time.perf_counter() - t0) / iters
+        # Window the gather counters around the PREFETCH arm only: the
+        # warmup, calibration and barrier gathers are fully exposed by
+        # design and would dilute the published hidden share toward 0.
+        exp0 = counter("hvd_zero_gather_exposed_seconds_total")
+        hid0 = counter("hvd_zero_gather_hidden_seconds_total")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            prefetch_step(i)
+        t_prefetch = (time.perf_counter() - t0) / iters
+        exposed = counter("hvd_zero_gather_exposed_seconds_total") - exp0
+        hidden = counter("hvd_zero_gather_hidden_seconds_total") - hid0
+        out_queue.put((rank, "ok", {
+            "t_gather": t_gather, "t_barrier": t_barrier,
+            "t_prefetch": t_prefetch, "n_buckets": nb,
+            "gather_exposed_s": exposed, "gather_hidden_s": hidden,
+            "bytes_per_step": int(sum(x.nbytes for x in likes)),
+        }))
+    except Exception as e:  # noqa: BLE001 — report, do not hang the bench
+        import traceback
+        out_queue.put((rank, "error",
+                       f"{e!r}\n{traceback.format_exc()[-2000:]}"))
+    finally:
+        if ctl is not None:
+            try:
+                ctl.shutdown()
+            except Exception:
+                pass
+
+
+def bench_zero():
+    """ZeRO-2/3 weight-update sharding (`bench.py --bench zero` →
+    BENCH_ZERO.json): (a) MEASURED per-rank state residency at world 4
+    for stages 1/2/3 on the GSPMD plane — live jax.Array shard bytes,
+    stage-3 optimizer+parameter residency must land within 1.3x of the
+    1/world ideal; (b) compiled-plane steps/sec at stage 3 with the
+    forward-prefetch bucket gather on vs off, and stage 3 vs stage 1;
+    (c) native eager plane, 2-rank local job driving the shipped
+    EagerGatherQueue: barrier (gather all, then compute) vs prefetch
+    (interleaved) steps/sec plus the queue-measured hidden/exposed
+    gather split — the observatory's comm attribution evidence.  Pure
+    CPU; never touches an accelerator."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    n = int(os.environ.get("BENCH_SCALING_DEVICES", "4"))
+    # The virtual device count only takes effect via XLA_FLAGS before
+    # the FIRST jax import (jax_num_cpu_devices is not available on
+    # every JAX) — without it the mesh silently degrades to world 1 and
+    # every residency ratio reads a meaningless 1.0.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(n, 4)}"
+        ).strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    if jax.device_count() < n:
+        raise SystemExit(
+            f"bench zero needs {n} virtual devices, got "
+            f"{jax.device_count()} (jax imported before the XLA flag?)")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint as ckpt
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.core.state import DATA_AXIS
+    from horovod_tpu.ops import gspmd
+
+    hvd.init()
+    mesh = Mesh(np.array(jax.devices()[:n]), (DATA_AXIS,))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    # A dim-0-divisible MLP stack so every leaf shards on both planes.
+    d = int(os.environ.get("BENCH_ZERO_WIDTH", "512"))
+    layers = int(os.environ.get("BENCH_ZERO_STACK", "4"))
+    key = jax.random.PRNGKey(0)
+    params = {}
+    for i in range(layers):
+        key, k1 = jax.random.split(key)
+        params[f"w{i}"] = jax.random.normal(k1, (d, d),
+                                            jnp.float32) * 0.02
+        params[f"b{i}"] = jnp.zeros((d,), jnp.float32)
+
+    def loss_fn(p, batch):
+        x, = batch
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean(h ** 2)
+
+    tx = optax.adamw(1e-3)
+    x = jnp.asarray(np.random.RandomState(0).randn(8 * n, d),
+                    dtype=jnp.float32)
+
+    # --- (a) measured residency per stage (GSPMD live arrays) ---------
+    residency = {}
+    for stage in (1, 2, 3):
+        fns = gspmd.make_zero_train_step(loss_fn, tx, mesh, stage=stage)
+        p, s = fns.init(params)
+        p, s, _ = fns.step(p, s, (x,))  # post-step = steady residency
+        rep = gspmd.residency_report((p, s), mesh)
+        residency[stage] = rep
+        sys.stderr.write(
+            f"  stage {stage}: max/device "
+            f"{rep['max_device_bytes'] / 1e6:.2f} MB of "
+            f"{rep['total_bytes'] / 1e6:.2f} MB total "
+            f"({rep['ratio_to_ideal']:.3f}x of 1/{n} ideal)\n")
+    stage3_ratio = residency[3]["ratio_to_ideal"]
+
+    # --- (b) compiled-plane steps/sec: prefetch on/off, stage 3 vs 1 --
+    batch = jnp.asarray(
+        np.random.RandomState(1).randn(n, 8, d), dtype=jnp.float32)
+
+    def compiled_stage_runner(stage, prefetch=True):
+        ztx = hvd.ZeroShardedOptimizer(
+            tx, stage=stage,
+            overlap=int(os.environ.get("BENCH_ZERO_BUCKET_BYTES",
+                                       str(256 << 10))))
+        if stage == 3:
+            ps = ckpt.zero_shard_params(ztx, params, mesh=mesh)
+            ost = ckpt.zero_init(ztx, ps, mesh=mesh)
+            ps_specs = ckpt.zero_state_specs(ps)
+            os_specs = ckpt.zero_state_specs(ost)
+
+            def step(pstate, ostate, xb):
+                xb = xb[0]
+
+                def lf(shards):
+                    full = ztx.gather_params(shards, params,
+                                             prefetch=prefetch)
+                    return loss_fn(full, (xb,))
+                g = jax.grad(lf)(pstate.inner)
+                u, ostate = ztx.update(g, ostate, pstate)
+                return ztx.apply_updates(pstate, u), ostate
+
+            fn = jax.jit(shard_map(
+                step, mesh=mesh,
+                in_specs=(ps_specs, os_specs, P(DATA_AXIS)),
+                out_specs=(ps_specs, os_specs), check_vma=False))
+            state0 = (ps, ost)
+        else:
+            ost = ckpt.zero_init(ztx, params, mesh=mesh)
+            os_specs = ckpt.zero_state_specs(ost)
+
+            def step(p, ostate, xb):
+                xb = xb[0]
+                g = jax.grad(lambda q: loss_fn(q, (xb,)))(p)
+                u, ostate = ztx.update(g, ostate, p)
+                return optax.apply_updates(p, u), ostate
+
+            fn = jax.jit(shard_map(
+                step, mesh=mesh,
+                in_specs=(P(), os_specs, P(DATA_AXIS)),
+                out_specs=(P(), os_specs), check_vma=False))
+            state0 = (params, ost)
+
+        def run():
+            a, b = state0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                a, b = fn(a, b, batch)
+            jax.block_until_ready(a)
+            return iters / (time.perf_counter() - t0)
+        run()  # compile + warm
+        return max(run() for _ in range(3))  # best-of: sandbox jitter
+
+    sps_s1 = compiled_stage_runner(1)
+    sps_s3_pre = compiled_stage_runner(3, prefetch=True)
+    sps_s3_mono = compiled_stage_runner(3, prefetch=False)
+    sys.stderr.write(
+        f"  compiled world {n}: stage1 {sps_s1:.2f} steps/s, stage3 "
+        f"prefetch {sps_s3_pre:.2f}, stage3 monolithic "
+        f"{sps_s3_mono:.2f}\n")
+
+    # --- (c) native 2-rank gather-hiding arm --------------------------
+    size = int(os.environ.get("BENCH_ZERO_RANKS", "2"))
+    import multiprocessing as mp
+    import socket as socket_mod
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_zero_gather_worker,
+                         args=(r, size, port, iters, q))
+             for r in range(size)]
+    for p_ in procs:
+        p_.start()
+    results = {}
+    try:
+        for _ in range(size):
+            rank, status, payload = q.get(timeout=300)
+            results[rank] = (status, payload)
+    finally:
+        for p_ in procs:
+            p_.join(timeout=30)
+        for p_ in procs:
+            if p_.is_alive():
+                p_.kill()
+                p_.join(timeout=10)
+    assert all(results[r][0] == "ok" for r in range(size)), results
+
+    def nmean(key):
+        return sum(results[r][1][key] for r in range(size)) / size
+
+    t_barrier, t_prefetch = nmean("t_barrier"), nmean("t_prefetch")
+    exposed, hidden = nmean("gather_exposed_s"), nmean("gather_hidden_s")
+    hidden_share = hidden / max(hidden + exposed, 1e-9)
+    sys.stderr.write(
+        f"  native plane: barrier {t_barrier * 1e3:.1f}ms vs prefetch "
+        f"{t_prefetch * 1e3:.1f}ms/step "
+        f"({t_barrier / max(t_prefetch, 1e-9):.2f}x), gather hidden "
+        f"share {hidden_share:.2f} (queue-measured)\n")
+
+    artifact = {
+        "schema": "horovod_tpu zero sharding bench v1",
+        "world": n,
+        "environment": {
+            "host_cores": os.cpu_count(),
+            "note": ("virtual CPU mesh; residency ratios and the "
+                     "prefetch hidden/exposed split are the signal — "
+                     "absolute steps/sec are CPU-bound.  The native "
+                     "arm's gathers ride the shm data plane of a "
+                     f"{size}-rank local job."),
+        },
+        "residency": {
+            f"stage{s_}": {
+                "max_device_bytes": int(r["max_device_bytes"]),
+                "total_bytes": int(r["total_bytes"]),
+                "ideal_bytes": int(r["ideal_bytes"]),
+                "ratio_to_ideal": round(r["ratio_to_ideal"], 4),
+                "unsharded_leaves": r["unsharded_leaves"],
+            } for s_, r in residency.items()
+        },
+        "stage3_residency_bar_x": 1.3,
+        "stage3_residency_within_bar": bool(stage3_ratio <= 1.3),
+        "compiled": {
+            "steps_per_sec_stage1": round(sps_s1, 3),
+            "steps_per_sec_stage3_prefetch": round(sps_s3_pre, 3),
+            "steps_per_sec_stage3_monolithic": round(sps_s3_mono, 3),
+            "stage3_vs_stage1": round(sps_s3_pre / sps_s1, 4),
+            "note": ("CPU mesh: XLA has no async collectives to hide "
+                     "here, so stage3-vs-stage1 prices the schedule "
+                     "overhead; the hiding evidence is the native arm"),
+        },
+        "native_gather": {
+            "ranks": size,
+            "steps_per_sec_prefetch": round(1.0 / t_prefetch, 3),
+            "steps_per_sec_barrier": round(1.0 / t_barrier, 3),
+            "prefetch_speedup_x": round(t_barrier / t_prefetch, 4),
+            "gather_exposed_s_per_rank": round(exposed, 4),
+            "gather_hidden_s_per_rank": round(hidden, 4),
+            "hidden_share": round(hidden_share, 4),
+            "n_buckets": int(results[0][1]["n_buckets"]),
+            "param_bytes": int(results[0][1]["bytes_per_step"]),
+            "note": ("hidden_share is the EagerGatherQueue's in-flight-"
+                     "union instrument — the same one PR 9's overlap "
+                     "bench reads (hvd_zero_gather_* counters, the "
+                     "observatory's exposed/hidden attribution source)."
+                     "  Wall-clock prefetch-vs-barrier parity (~1.0x) "
+                     "is a sandbox property: this kernel's shm "
+                     "allgather pays its cost in submit/finish copies "
+                     "on the caller thread, so background progress "
+                     "cannot shorten the wall here — the same regime "
+                     "cap bench_overlap disclosed (1.04x on this "
+                     "sandbox); the async-DMA hiding regime is TPU "
+                     "hardware."),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_ZERO.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    _emit({
+        "metric": "zero_stage3_residency_vs_ideal",
+        "value": round(stage3_ratio, 4),
+        "unit": (f"x of the 1/{n} per-rank ideal for optimizer+param "
+                 "residency (measured live jax.Array shard bytes, "
+                 "GSPMD plane, post-step steady state)"),
+        "bar_x": 1.3,
+        "within_bar": bool(stage3_ratio <= 1.3),
+        "stage1_ratio": round(residency[1]["ratio_to_ideal"], 4),
+        "stage2_ratio": round(residency[2]["ratio_to_ideal"], 4),
+        "steps_per_sec_stage3_vs_stage1": round(sps_s3_pre / sps_s1, 4),
+        "steps_bar_pct": 5.0,  # stage 3 within 5% of ZeRO-1 steps/sec
+        "steps_within_bar": bool(sps_s3_pre / sps_s1 >= 0.95),
+        "prefetch_hidden_share": round(hidden_share, 4),
+        "prefetch_speedup_x": round(t_barrier / t_prefetch, 4),
+        "artifact": "BENCH_ZERO.json",
+    })
+
+
 def _tpu_transport_alive() -> bool:
     """The axon TPU tunnel (loopback relay) can die; when it does, any
     TPU-touching jax call BLOCKS FOREVER (the plugin retries a refused
@@ -3008,6 +3408,8 @@ def main():
         return bench_flight_overhead()  # host-only
     if mode == "recovery":
         return bench_recovery()  # CPU mesh; never touches the chip
+    if mode == "zero":
+        return bench_zero()  # CPU mesh + local TCP job; no chip
     if mode == "net_resilience":
         return bench_net_resilience()  # host-only TCP loopback job
     if mode == "fleet":

@@ -61,6 +61,9 @@ TIER_FAST=(
   test_probe_rendezvous.py
   test_quantization.py
   test_recovery.py
+  # Flat-shard layout math goldens (ISSUE 14): 1-D + (dp, mp) nested
+  # reshard arithmetic every durability tier leans on.
+  test_reshard.py
   test_resnet.py test_response_cache.py test_timeline.py
   test_transformer.py
   # Closed-loop autotuning drill (ISSUE 12): injected comm regression →
@@ -69,6 +72,10 @@ TIER_FAST=(
   # surface (`bench.py --bench warmstart` measures time-to-best-config).
   test_tuning_loop.py
   test_utils_ops.py
+  # ZeRO-2/3 weight-update sharding (ISSUE 14): stage parity, the
+  # forward-prefetch gather, the GSPMD NamedSharding plane, and the
+  # world-4 -> world-2 / (dp, mp) mesh-change restore drill.
+  test_zero_stages.py
 )
 
 # Tier 2 — multi-process matrix: native runtime, transports, device
@@ -107,13 +114,39 @@ hang_dump_s() {
   esac
 }
 
+# Wall budget per tier (seconds) — the number the dump deadline shadows.
+# The fast budget has been within 12% twice; print the margin in every
+# run's log so drift toward the wall is visible per PR, not discovered
+# by a timeout.
+tier_budget_s() {
+  case "$1" in
+    fast)   echo 870 ;;
+    matrix) echo 1860 ;;
+    *)      echo 3660 ;;
+  esac
+}
+
+report_tier_time() {
+  # Printed on success AND failure (EXIT path): wall seconds vs budget
+  # with the consumed percentage, e.g. "tier fast: 812s / 870s (93%)".
+  local name="$1" start="$2" rc="$3"
+  local wall=$(( SECONDS - start ))
+  local budget; budget=$(tier_budget_s "$name")
+  local pct=$(( wall * 100 / budget ))
+  echo "=== tier ${name} wall time: ${wall}s / ${budget}s budget" \
+       "(${pct}% used, exit ${rc}) ==="
+}
+
 run_tier() {
   local name="$1"; shift
   local files=()
   for f in "$@"; do files+=("tests/$f"); done
   echo "=== tier: ${name} ($# files) ==="
+  local start=$SECONDS rc=0
   HVD_TPU_CI_HANG_DUMP_S="${HVD_TPU_CI_HANG_DUMP_S:-$(hang_dump_s "$name")}" \
-    python -m pytest "${files[@]}" -q
+    python -m pytest "${files[@]}" -q || rc=$?
+  report_tier_time "$name" "$start" "$rc"
+  return $rc
 }
 
 case "${1:-all}" in

@@ -41,6 +41,15 @@ def main():
                         "launches per-bucket inside the backward via the "
                         "bucketed DistributedOptimizer; requires "
                         "--pp 1 --mp 1 (a data-parallel technique)")
+    p.add_argument("--zero-stage", type=int, default=0,
+                   choices=[0, 1, 2, 3],
+                   help="ZeRO weight-update sharding over dp "
+                        "(docs/zero.md): 1 = optimizer-state shards, "
+                        "2 = + gradient shards, 3 = + parameter shards "
+                        "with forward-prefetched gathers; 0 = off.  "
+                        "Identical losses across stages (only the wire "
+                        "schedule and residency change); requires "
+                        "--pp 1 --mp 1")
     args = p.parse_args()
 
     hvd.init()
@@ -54,7 +63,72 @@ def main():
 
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, par)
     tx = optax.adamw(3e-4)
-    if args.overlap:
+    if args.zero_stage:
+        # ZeRO weight-update sharding (docs/zero.md): optimizer state —
+        # and at stage 3 the parameters themselves — live as flat 1/dp
+        # shards; gradients ride the (bucketed) reduce-scatter and
+        # stage-3 forwards rebuild params with the prefetch gather.
+        # Losses are identical across stages: the math never changes.
+        if args.pp != 1 or args.mp != 1:
+            raise SystemExit("--zero-stage shards over the dp axis: run "
+                             "with --pp 1 --mp 1")
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import checkpoint as zckpt
+        from horovod_tpu.compat import shard_map
+        ztx = hvd.ZeroShardedOptimizer(tx, axis_name="dp",
+                                       stage=args.zero_stage)
+        stage = args.zero_stage
+
+        def loss_of(q, tok, lab):
+            return tfm.forward_loss(cfg, par, q, tok, lab)
+
+        if stage == 3:
+            # Shapes/dtypes only: holding the real replicated tree here
+            # would keep full params resident and void the ZeRO-3 saving.
+            template = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+            pstate = zckpt.zero_shard_params(ztx, params, mesh=mesh,
+                                             axis_name="dp")
+            opt_state = zckpt.zero_init(ztx, pstate, mesh=mesh,
+                                        axis_name="dp")
+            ps_specs = zckpt.zero_state_specs(pstate, axis_name="dp")
+            os_specs = zckpt.zero_state_specs(opt_state, axis_name="dp")
+
+            def inner(ps_, o_, tok, lab):
+                def lf(shards):
+                    return loss_of(ztx.gather_params(shards, template),
+                                   tok, lab)
+                loss, g = jax.value_and_grad(lf)(ps_.inner)
+                u, o_ = ztx.update(g, o_, ps_)
+                ps_ = ztx.apply_updates(ps_, u)
+                return ps_, o_, jax.lax.pmean(loss, "dp")
+
+            step = jax.jit(shard_map(
+                inner, mesh=mesh,
+                in_specs=(ps_specs, os_specs, P("dp"), P("dp")),
+                out_specs=(ps_specs, os_specs, P()), check_vma=False),
+                donate_argnums=(0, 1))
+            params = pstate  # the sharded residency IS the live state
+        else:
+            opt_state = zckpt.zero_init(ztx, params, mesh=mesh,
+                                        axis_name="dp")
+            os_specs = zckpt.zero_state_specs(opt_state, axis_name="dp")
+
+            def inner(p_, o_, tok, lab):
+                loss, grads = jax.value_and_grad(loss_of)(p_, tok, lab)
+                if stage == 2:
+                    grads = ztx.reduce_grads(grads)
+                u, o_ = ztx.update(grads, o_, p_)
+                p_ = optax.apply_updates(p_, u)
+                return p_, o_, jax.lax.pmean(loss, "dp")
+
+            step = jax.jit(shard_map(
+                inner, mesh=mesh,
+                in_specs=(P(), os_specs, P("dp"), P("dp")),
+                out_specs=(P(), os_specs, P()), check_vma=False),
+                donate_argnums=(0, 1))
+    elif args.overlap:
         # Bucketed optimizer path: gradients computed inside shard_map
         # over the mesh, dp-allreduced per size-bounded bucket by the
         # overlap scheduler (identical losses — bit parity with the

@@ -34,6 +34,7 @@ from .ops.collective import (
     poll, synchronize,
 )
 from .ops.compression import Compression
+from .ops import gspmd
 from .ops import overlap
 from .optimizers import (
     DistributedOptimizer, ZeroShardedOptimizer, allreduce_gradients,
@@ -66,7 +67,7 @@ __all__ = [
     "reducescatter", "join", "barrier",
     "allreduce_async", "allgather_async", "broadcast_async",
     "alltoall_async", "poll", "synchronize",
-    "Compression", "overlap",
+    "Compression", "gspmd", "overlap",
     "DistributedOptimizer", "ZeroShardedOptimizer", "allreduce_gradients",
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",

@@ -22,11 +22,15 @@ from .engine import (
     read_manifest, read_shard, restore_leaves, save_leaves, step_dir,
     write_shard, LazyStep, RestoredStep,
 )
-from .reshard import pad_flat, reassemble, reshard, shard_of
+from .reshard import (
+    mesh_shard_of, pad_flat, reassemble, reassemble_mesh, reshard,
+    reshard_mesh, shard_of,
+)
 from .zero import (
     extract_zero_state, fingerprint_extra, has_zero_leaves,
     is_zero_state, rebuild_restored, restore_zero_state, save_extracted,
-    save_zero_state, zero_init, zero_state_specs, ExtractedState,
+    save_zero_state, zero_init, zero_shard_params, zero_state_specs,
+    ExtractedState,
 )
 from .data_state import (
     DATA_ITERS_KEY, restore_data_state, save_data_state,
@@ -38,10 +42,11 @@ __all__ = [
     "commit", "gc_steps", "is_committed", "latest_step", "list_steps",
     "open_step", "read_manifest", "read_shard", "restore_leaves",
     "save_leaves", "step_dir", "write_shard", "LazyStep", "RestoredStep",
-    "pad_flat", "reassemble", "reshard", "shard_of",
+    "mesh_shard_of", "pad_flat", "reassemble", "reassemble_mesh",
+    "reshard", "reshard_mesh", "shard_of",
     "extract_zero_state", "fingerprint_extra", "has_zero_leaves",
     "is_zero_state", "rebuild_restored", "restore_zero_state",
     "save_extracted", "save_zero_state", "zero_init",
-    "zero_state_specs", "ExtractedState",
+    "zero_shard_params", "zero_state_specs", "ExtractedState",
     "DATA_ITERS_KEY", "restore_data_state", "save_data_state",
 ]

@@ -51,3 +51,76 @@ def reshard(shards: Sequence[np.ndarray], true_size: int,
     flat = pad_flat(flat, new_world_size)
     k = flat.size // new_world_size
     return [flat[r * k:(r + 1) * k] for r in range(new_world_size)]
+
+
+# ---------------------------------------------------------------------------
+# (dp, mp) mesh layouts — the nested two-level shard math
+# ---------------------------------------------------------------------------
+#
+# A mesh with a model axis stores a leaf in two levels: the flat value
+# is zero-padded to a multiple of mp and split into mp contiguous MODEL
+# slices (rank-major: mp rank m owns slice m); each slice is then
+# zero-padded to a multiple of dp and split into dp DATA shards — the
+# ZeRO layout applied within each model slice.  The flat shard list is
+# dp-major: shard index = dp_rank * mp + mp_rank, matching
+# ``lax.axis_index(("data", "model"))`` inside shard_map.  With mp=1
+# every function below degrades exactly to the 1-D pair above.
+
+def _check_mesh(mesh) -> tuple:
+    dp, mp = int(mesh[0]), int(mesh[1])
+    if dp < 1 or mp < 1:
+        raise ValueError(f"mesh sizes must be >= 1, got {(dp, mp)}")
+    return dp, mp
+
+
+def mesh_shard_of(x: np.ndarray, mesh: Sequence[int], dp_rank: int,
+                  mp_rank: int) -> np.ndarray:
+    """Rank ``(dp_rank, mp_rank)``'s flat shard of a full value under a
+    ``(dp, mp)`` mesh."""
+    dp, mp = _check_mesh(mesh)
+    slice_ = pad_flat(x, mp).reshape(mp, -1)[mp_rank]
+    return shard_of(slice_, dp, dp_rank)
+
+
+def reassemble_mesh(shards: Sequence[np.ndarray], true_size: int,
+                    mesh: Sequence[int]) -> np.ndarray:
+    """Reassemble the logical value from a ``(dp, mp)`` mesh's dp-major
+    shard list, dropping both padding levels.
+
+    Refuses incompatible inputs loudly: a shard count that does not
+    match the mesh, or ragged shard sizes (every shard of one leaf has
+    the same length by construction — a mismatch means the shards come
+    from different leaves or a different layout).
+    """
+    dp, mp = _check_mesh(mesh)
+    if len(shards) != dp * mp:
+        raise ValueError(
+            f"(dp={dp}, mp={mp}) mesh stores {dp * mp} shards per leaf, "
+            f"got {len(shards)}")
+    sizes = {np.asarray(s).size for s in shards}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"ragged shard sizes {sorted(sizes)}: shards do not share "
+            "one (dp, mp) layout")
+    slice_padded = (true_size + (-true_size) % mp) // mp
+    slices = []
+    for m in range(mp):
+        part = reassemble([shards[d * mp + m] for d in range(dp)],
+                          slice_padded)
+        slices.append(part)
+    return np.concatenate(slices)[:true_size]
+
+
+def reshard_mesh(shards: Sequence[np.ndarray], true_size: int,
+                 old_mesh: Sequence[int],
+                 new_mesh: Sequence[int]) -> List[np.ndarray]:
+    """Re-slice a leaf's shards from an ``old_mesh = (dp, mp)`` layout
+    into ``new_mesh = (dp', mp')`` — the arbitrary-mesh-change
+    generalization of :func:`reshard` (which is the ``mp == mp' == 1``
+    special case).  Bit-identical logical elements; only the two
+    padding levels differ.  The returned list is dp-major for the new
+    mesh."""
+    dp2, mp2 = _check_mesh(new_mesh)
+    flat = reassemble_mesh(shards, true_size, old_mesh)
+    return [mesh_shard_of(flat, (dp2, mp2), d, m)
+            for d in range(dp2) for m in range(mp2)]

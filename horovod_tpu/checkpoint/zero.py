@@ -71,20 +71,37 @@ def _keystr(path) -> str:
     return jax.tree_util.keystr(path)
 
 
+def _axis_tuple(axis_name):
+    """Normalize an axis argument to a tuple of axis names: ZeRO state
+    may shard over ONE mesh axis (the classic dp layout) or over the
+    PRODUCT of several (``("data", "model")`` — every chip of a 2-D
+    mesh holds 1/(dp*mp), so a (dp, mp) mesh change is just an N→M
+    reshard of the same flat layout)."""
+    return axis_name if isinstance(axis_name, (tuple, list)) \
+        else (axis_name,)
+
+
 def _axis_world(mesh, axis_name) -> int:
-    return int(mesh.shape[axis_name])
+    return int(np.prod([int(mesh.shape[a])
+                        for a in _axis_tuple(axis_name)]))
 
 
 def _rank_of_device(mesh, axis_name):
     """{device: rank along ``axis_name``} for one replica slice of the
-    mesh (all other axes at position 0)."""
+    mesh (all other axes at position 0).  For a tuple of axes the rank
+    is the row-major flattened index over them, matching
+    ``lax.axis_index(tuple)`` inside ``shard_map``."""
     axes = list(mesh.axis_names)
-    ai = axes.index(axis_name)
+    ais = [axes.index(a) for a in _axis_tuple(axis_name)]
+    sizes = [int(mesh.shape[axes[i]]) for i in ais]
     out = {}
     dev = np.asarray(mesh.devices)
     for idx in np.ndindex(dev.shape):
-        if all(idx[j] == 0 for j in range(len(idx)) if j != ai):
-            out[dev[idx]] = idx[ai]
+        if all(idx[j] == 0 for j in range(len(idx)) if j not in ais):
+            rank = 0
+            for i, n in zip(ais, sizes):
+                rank = rank * n + idx[i]
+            out[dev[idx]] = rank
     return out
 
 
@@ -271,7 +288,13 @@ def zero_init(tx, params, mesh=None, axis_name: Optional[str] = None):
     """Initialize ZeRO state *globally threaded*: runs ``tx.init`` inside
     ``shard_map`` and returns vector leaves as full padded flat buffers
     partitioned over the axis — the layout ``save_zero_state`` and
-    ``restore_zero_state`` exchange."""
+    ``restore_zero_state`` exchange.
+
+    ``params`` may be full (replicated) parameters — the stage-1/2
+    layout — or a stage-3 sharded param state (``shard_params`` /
+    :func:`zero_shard_params` output, itself ZeRO state): sharded
+    inputs are threaded with their own partition specs so ``tx.init``
+    sees exactly this rank's shards."""
     import jax
     from jax.sharding import PartitionSpec as P
     from ..compat import shard_map
@@ -279,11 +302,40 @@ def zero_init(tx, params, mesh=None, axis_name: Optional[str] = None):
         from ..core import basics
         mesh = basics.mesh()
     ax = _default_axis(axis_name)
+    in_specs = (zero_state_specs(params, axis_name=ax)
+                if has_zero_leaves(params) else P())
     shape_probe = jax.eval_shape(
-        shard_map(tx.init, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        shard_map(tx.init, mesh=mesh, in_specs=(in_specs,), out_specs=P(),
                   check_vma=False), params)
     out_specs = zero_state_specs(shape_probe, axis_name=ax)
-    return jax.jit(shard_map(tx.init, mesh=mesh, in_specs=(P(),),
+    return jax.jit(shard_map(tx.init, mesh=mesh, in_specs=(in_specs,),
+                             out_specs=out_specs, check_vma=False))(params)
+
+
+def zero_shard_params(tx, params, mesh=None,
+                      axis_name: Optional[str] = None):
+    """Full parameters → a *globally threaded* stage-3 sharded param
+    state: runs ``tx.shard_params`` inside ``shard_map`` and returns the
+    params-structured flat shards as full padded buffers partitioned
+    over the axis — the exact layout the checkpoint engine commits and
+    the peer-recovery tier replicates (sharded params ARE ZeRO state,
+    see docs/zero.md)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..compat import shard_map
+    if getattr(tx, "shard_params", None) is None:
+        raise ValueError(
+            "zero_shard_params needs a ZeroShardedOptimizer "
+            "transformation (stage 3) exposing shard_params")
+    if mesh is None:
+        from ..core import basics
+        mesh = basics.mesh()
+    ax = _default_axis(axis_name)
+    shape_probe = jax.eval_shape(
+        shard_map(tx.shard_params, mesh=mesh, in_specs=(P(),),
+                  out_specs=P(), check_vma=False), params)
+    out_specs = zero_state_specs(shape_probe, axis_name=ax)
+    return jax.jit(shard_map(tx.shard_params, mesh=mesh, in_specs=(P(),),
                              out_specs=out_specs, check_vma=False))(params)
 
 

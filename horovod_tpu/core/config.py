@@ -66,6 +66,14 @@ QUANT_BLOCK = "QUANT_BLOCK"                    # elements per absmax scale
 # Backward-overlap bucketed gradient scheduler (horovod_tpu/ops/overlap.py).
 OVERLAP = "OVERLAP"                            # session default on/off
 OVERLAP_BUCKET_BYTES = "OVERLAP_BUCKET_BYTES"  # bucket size; pins autotune
+# GSPMD-native weight-update sharding (horovod_tpu/optimizers.py
+# ZeroShardedOptimizer + ops/gspmd.py): 1 = optimizer state sharded
+# (ZeRO-1), 2 = + gradient shards are the persistent objects (ZeRO-2),
+# 3 = + parameters sharded with forward-prefetched per-bucket gathers
+# (ZeRO-3).  ZERO_PREFETCH gates the per-bucket forward gather schedule
+# (off = one monolithic gather before forward).
+ZERO_STAGE = "ZERO_STAGE"                      # 1 | 2 | 3
+ZERO_PREFETCH = "ZERO_PREFETCH"                # bucketed forward gathers
 # Metrics subsystem (horovod_tpu/metrics/).
 METRICS_SYNC_STEPS = "METRICS_SYNC_STEPS"      # cross-rank cadence; 0 = off
 METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
@@ -261,6 +269,10 @@ class Config:
     # knob explicitly PINS the autotuner's bucket-size dimension.
     overlap: bool = False
     overlap_bucket_bytes: int = 8 * 1024 * 1024
+    # ZeRO weight-update sharding stage (ZeroShardedOptimizer default)
+    # and the stage-3 forward-prefetch schedule (docs/zero.md).
+    zero_stage: int = 1
+    zero_prefetch: bool = True
     # Metrics: registry always records locally; cross-rank aggregation
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
@@ -412,6 +424,10 @@ class Config:
         # alone in a bucket — legal but never what anyone meant.
         cfg.overlap_bucket_bytes = max(
             1024, get_int(OVERLAP_BUCKET_BYTES, cfg.overlap_bucket_bytes))
+        # Clamp to the defined stages: a typo'd knob must not silently
+        # run unsharded (0) or invent a stage 4.
+        cfg.zero_stage = min(3, max(1, get_int(ZERO_STAGE, cfg.zero_stage)))
+        cfg.zero_prefetch = get_bool(ZERO_PREFETCH, cfg.zero_prefetch)
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)

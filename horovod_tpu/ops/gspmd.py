@@ -1,0 +1,221 @@
+"""GSPMD-native ZeRO sharding plane — NamedSharding constraints the XLA
+partitioner schedules.
+
+The shard_map-era compiled plane spells every collective explicitly
+(``optimizers.ZeroShardedOptimizer`` emits reduce-scatter / allgather
+per bucket).  This module is the other idiom the north star needs —
+the NamedSharding + ``jax.jit`` pattern that scales the same
+application code from 8 chips to superclusters without changing it
+(SNIPPETS [2]/[3]): tensors carry ``jax.sharding.NamedSharding``
+annotations, ``with_sharding_constraint`` pins the ZeRO residency
+(optimizer state sharded at stage >= 1, gradients at stage >= 2,
+parameters at stage 3), and the XLA partitioner inserts AND SCHEDULES
+the reduce-scatters and parameter allgathers — the automatic
+cross-replica weight-update sharding of arXiv:2004.13336, with the
+stage-3 forward gathers placed by XLA's latency-hiding scheduler ahead
+of the layers that consume them (the compiler-side mirror of
+``ops.overlap.gather_in_forward``).
+
+Layout note: GSPMD shards a leaf on its leading axis (rows), the
+shard_map plane shards the padded FLAT value.  For row-major arrays
+whose dim 0 divides the axis size these coincide element-for-element;
+for the rest GSPMD falls back to replication (disclosed by
+``residency_report``) while the flat plane always shards.  Checkpoints
+interoperate either way: the engine stores logical values + flat
+shards, and restore re-slices for whichever plane consumes them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+from ..core.state import DATA_AXIS
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def named_sharding(mesh, *spec):
+    """``NamedSharding(mesh, PartitionSpec(*spec))`` — the one-liner
+    every GSPMD annotation reduces to."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _shardable(leaf, world: int) -> bool:
+    shape = getattr(leaf, "shape", ())
+    return len(shape) >= 1 and shape[0] % world == 0 and shape[0] > 0
+
+
+def leaf_spec(leaf, mesh, sharded: bool, axis: str = DATA_AXIS):
+    """The ``PartitionSpec`` for one leaf: dim-0 sharded over ``axis``
+    when requested and divisible, replicated otherwise."""
+    from jax.sharding import PartitionSpec as P
+    world = int(mesh.shape[axis])
+    if sharded and _shardable(leaf, world):
+        return P(axis)
+    return P()
+
+
+def zero_shardings(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+    """NamedSharding pytree for ``tree``: dim-0 sharded over ``axis``
+    where divisible (``sharded=True``), replicated otherwise."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda l: named_sharding(mesh, *leaf_spec(l, mesh, sharded, axis)),
+        tree)
+
+
+def place(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+    """``device_put`` every leaf at its ZeRO residency."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            l, named_sharding(mesh, *leaf_spec(l, mesh, sharded, axis))),
+        tree)
+
+
+def constrain(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+    """``with_sharding_constraint`` every leaf — the in-trace pin the
+    partitioner must honor (this is what makes gradient shards REAL at
+    stage >= 2: the constraint forces the reduce-scatter early, so the
+    full gradient's liveness ends inside the backward)."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.with_sharding_constraint(
+            l, named_sharding(mesh, *leaf_spec(l, mesh, sharded, axis))),
+        tree)
+
+
+class ZeroStepFns(NamedTuple):
+    """The jitted GSPMD train-step bundle ``make_zero_train_step``
+    returns: ``init(params)`` places params+state at their stage
+    residency, ``step(params, opt_state, batch)`` runs one update with
+    the ZeRO constraints compiled in."""
+
+    init: Any
+    step: Any
+    stage: int
+
+
+def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
+                         axis: str = DATA_AXIS):
+    """Build the GSPMD-native ZeRO training step.
+
+    ``loss_fn(params, batch) -> scalar`` is written for the GLOBAL
+    (logical) batch — no axis names, no collectives; ``tx`` is a plain
+    optax transformation.  The returned ``step`` is ``jax.jit`` over
+    the mesh with:
+
+    * batch sharded over ``axis`` (data parallelism),
+    * optimizer state constrained dim-0-sharded (stage >= 1),
+    * gradients constrained dim-0-sharded before the update
+      (stage >= 2 — the partitioner reduce-scatters them and frees the
+      full tree inside the backward),
+    * parameters constrained dim-0-sharded end-to-end (stage 3 — the
+      partitioner inserts per-tensor forward allgathers and schedules
+      them ahead of first use).
+
+    The XLA partitioner owns every collective: the same step scales to
+    any mesh shape without touching this code.
+    """
+    jax = _jax()
+    import optax  # noqa: F401 — documented dependency of tx
+
+    if stage is None:
+        from ..core.config import Config, get_int
+        stage = get_int("ZERO_STAGE", Config.zero_stage)
+    stage = int(stage)
+    if stage not in (1, 2, 3):
+        raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
+
+    params_sharded = stage >= 3
+    batch_sh = named_sharding(mesh, axis)
+
+    def init(params):
+        params = place(params, mesh, params_sharded, axis)
+        opt_state = jax.jit(
+            tx.init,
+            out_shardings=zero_shardings(
+                jax.eval_shape(tx.init, params), mesh, True, axis))(params)
+        return params, opt_state
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if stage >= 2:
+            grads = constrain(grads, mesh, True, axis)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        if params_sharded:
+            params = constrain(params, mesh, True, axis)
+        return params, opt_state, loss
+
+    compiled = {}  # one jit wrapper per (params, state) treedef pair
+
+    def step(params, opt_state, batch):
+        key = (jax.tree_util.tree_structure(params),
+               jax.tree_util.tree_structure(opt_state))
+        fn = compiled.get(key)
+        if fn is None:
+            p_sh = zero_shardings(params, mesh, params_sharded, axis)
+            s_sh = zero_shardings(opt_state, mesh, True, axis)
+            fn = jax.jit(
+                _step,
+                in_shardings=(p_sh, s_sh, batch_sh),
+                out_shardings=(p_sh, s_sh, named_sharding(mesh)))
+            compiled[key] = fn
+        return fn(params, opt_state, batch)
+
+    return ZeroStepFns(init=init, step=step, stage=stage)
+
+
+def per_device_bytes(tree) -> dict:
+    """{device: resident bytes} across every leaf's addressable shards
+    — the measured per-rank residency the ZeRO memory claims are graded
+    on (``bench.py --bench zero``; replicated leaves count their full
+    size on every device)."""
+    jax = _jax()
+    out: dict = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            for shard in leaf.addressable_shards:
+                # .nbytes on the shard's jax.Array — never np.asarray,
+                # which would device-to-host copy the whole state just
+                # to read a byte count.
+                out[shard.device] = out.get(shard.device, 0) + \
+                    int(shard.data.nbytes)
+        elif hasattr(leaf, "nbytes"):
+            dev = "host"
+            out[dev] = out.get(dev, 0) + int(leaf.nbytes)
+    return out
+
+
+def residency_report(tree, mesh, axis: str = DATA_AXIS) -> dict:
+    """Residency accounting for a pytree: total logical bytes, max
+    per-device resident bytes, the 1/world ideal, and which leaves
+    could not shard (dim 0 not divisible) — the disclosure surface for
+    the stage-3 <= 1.3x-of-ideal acceptance bar."""
+    jax = _jax()
+    world = int(mesh.shape[axis])
+    total = 0
+    unsharded = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        n = int(getattr(leaf, "nbytes", 0))
+        total += n
+        if not _shardable(leaf, world):
+            unsharded.append(jax.tree_util.keystr(path))
+    per_dev = per_device_bytes(tree)
+    max_dev = max(per_dev.values()) if per_dev else 0
+    return {
+        "total_bytes": total,
+        "max_device_bytes": max_dev,
+        "ideal_bytes": total // world,
+        "ratio_to_ideal": (max_dev * world / total) if total else 0.0,
+        "unsharded_leaves": unsharded,
+        "world": world,
+    }

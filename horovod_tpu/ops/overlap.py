@@ -69,13 +69,17 @@ def _leaf_nbytes(leaf) -> int:
 
 
 def plan_buckets(leaves: Sequence, bucket_bytes: Optional[int] = None,
-                 record: bool = True) -> BucketPlan:
-    """Partition ``leaves`` into size-bounded buckets in reverse order.
+                 record: bool = True, order: str = "backward") -> BucketPlan:
+    """Partition ``leaves`` into size-bounded buckets in launch order.
 
-    Reverse order = reverse-autodiff order: the LAST parameters of the
-    pytree (the deepest layers, whose grads backward produces first)
-    land in the first bucket, so their collective can launch while the
-    rest of the backward still runs.  A bucket closes when adding the
+    ``order="backward"`` (default) = reverse-autodiff order: the LAST
+    parameters of the pytree (the deepest layers, whose grads backward
+    produces first) land in the first bucket, so their collective can
+    launch while the rest of the backward still runs.
+    ``order="forward"`` is the mirror for the ZeRO-3 parameter-gather
+    schedule: the FIRST leaves (the layers forward consumes first) land
+    in the first bucket, so its gather can complete while later layers'
+    gathers are still in flight.  A bucket closes when adding the
     next leaf would exceed ``bucket_bytes`` or change dtype (buckets
     concatenate into one wire buffer — mixed dtypes cannot share it);
     a leaf larger than the bound gets a bucket of its own; the LAST
@@ -85,11 +89,15 @@ def plan_buckets(leaves: Sequence, bucket_bytes: Optional[int] = None,
              else bucket_bytes)
     if bb <= 0:
         raise ValueError(f"bucket_bytes must be positive, got {bb}")
+    if order not in ("backward", "forward"):
+        raise ValueError(f"order must be backward|forward, got {order!r}")
     buckets: List[Tuple[int, ...]] = []
     cur: List[int] = []
     cur_bytes = 0
     cur_dtype = None
-    for i in reversed(range(len(leaves))):
+    idx_order = (reversed(range(len(leaves))) if order == "backward"
+                 else range(len(leaves)))
+    for i in idx_order:
         nb = _leaf_nbytes(leaves[i])
         dt = np.dtype(leaves[i].dtype)
         if cur and (dt != cur_dtype or cur_bytes + nb > bb):
@@ -217,6 +225,16 @@ def _overlap_metrics():
                         "Wire seconds hidden behind caller compute "
                         "(in-flight union minus exposed) across "
                         "EagerBucketQueue finishes"),
+            reg.counter("hvd_zero_gather_exposed_seconds_total",
+                        "ZeRO-3 parameter-gather seconds the caller "
+                        "PAID (submission + blocked collection) across "
+                        "EagerGatherQueue takes — also folded into the "
+                        "overlap exposed counter so step attribution "
+                        "prices gathers like any overlap-managed comm"),
+            reg.counter("hvd_zero_gather_hidden_seconds_total",
+                        "ZeRO-3 parameter-gather seconds hidden behind "
+                        "caller compute (in-flight union minus exposed) "
+                        "across EagerGatherQueue takes"),
         )
     return _metrics_rec
 
@@ -549,6 +567,127 @@ def bucketed_reducescatter_tree(grads, op=None, axis_name=None,
 
 
 # ---------------------------------------------------------------------------
+# compiled plane: ZeRO-3 forward-prefetch parameter gather
+# ---------------------------------------------------------------------------
+
+def _bucket_allgather(shards, likes, axis_name, world: int):
+    """One bucket = one allgather: concatenate the per-rank flat param
+    shards, gather once, and slice each leaf's full value back out.
+
+    The gathered buffer is rank-major — ``(world, sum_k)`` with rank
+    *r*'s row holding its slice of every leaf — so a leaf's full flat
+    value is the column block ``[off, off+k)`` across all rows, exactly
+    the ``(world, k)`` padded layout ``_my_shard`` sliced at init."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    ks = [int(s.size) for s in shards]
+    cat = jnp.concatenate([jnp.ravel(s) for s in shards]) \
+        if len(shards) > 1 else jnp.ravel(shards[0])
+    full = lax.all_gather(cat, axis_name, tiled=True).reshape(world, -1)
+    outs, off = [], 0
+    for like, k in zip(likes, ks):
+        flat = full[:, off: off + k].reshape(-1)
+        outs.append(flat[:int(np.prod(like.shape))]
+                    .reshape(like.shape).astype(like.dtype))
+        off += k
+    return outs
+
+
+def _make_gather_tag(likes, op, axis_name, compression, world: int):
+    """An identity from a bucket's param SHARDS to its FULL params whose
+    forward is the bucket's allgather and whose VJP is the bucket's
+    gradient reduce-scatter — ZeRO-3 in one ``custom_vjp``: reverse-mode
+    AD through it yields gradient *shards* directly (full gradients
+    exist only transiently inside the backward), and each bucket's
+    gather is an independent collective the latency-hiding scheduler
+    can run ahead of the forward layers that consume it."""
+    import jax
+
+    @jax.custom_vjp
+    def tag(*shards):
+        return tuple(_bucket_allgather(list(shards), likes, axis_name,
+                                       world))
+
+    def fwd(*shards):
+        return tag(*shards), None
+
+    def bwd(_, cts):
+        # The cotangents are full-shaped; reduce-scatter them with the
+        # bucket's one exchange (optionally quantized wire, fp32
+        # accumulation) into this rank's gradient shards — the same
+        # math as the stage-1/2 gradient reduce-scatter.
+        return tuple(_bucket_reducescatter(list(cts), op, axis_name,
+                                           world, compression))
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def gather_in_forward(shards_tree, like, op=None, axis_name=None,
+                      compression=None, bucket_bytes: Optional[int] = None,
+                      prefetch: Optional[bool] = None):
+    """ZeRO-3 forward-prefetch: rebuild full parameters from per-rank
+    flat shards with one allgather per size-bounded bucket, emitted as
+    independent collectives XLA can schedule AHEAD of the forward layers
+    that consume them — the forward mirror of :func:`sync_in_backward`.
+    Differentiating through the result reduce-scatters the cotangents
+    per bucket, so gradients come back as shards (``compression`` rides
+    that reduce-scatter exactly as in the stage-1/2 path; the parameter
+    gather itself stays full-precision).
+
+    ``like`` supplies the static full shapes/dtypes (the params template
+    — live arrays or ``jax.eval_shape`` structs).  ``prefetch=False``
+    (or ``HVD_TPU_ZERO_PREFETCH=0``) collapses the plan to ONE
+    monolithic gather — the barrier schedule, for A/B measurement.
+    Buckets are planned in FORWARD order (first-consumed leaves first).
+    Must run inside ``shard_map``/``jit`` over ``axis_name``."""
+    import jax
+
+    from ..compat import axis_size
+    from . import collective as C
+    if op is None:
+        op = C.Average
+    ax = C._default_axis(axis_name)
+    world = axis_size(ax)
+    if prefetch is None:
+        from ..core.config import Config, get_bool
+        prefetch = get_bool("ZERO_PREFETCH", Config.zero_prefetch)
+    if bucket_bytes is None:
+        # Env-derived config ONLY — never plan_buckets' session-default
+        # fallback, which reads the autotuner's rank-LOCAL bucket choice:
+        # this runs inside compiled SPMD traces, and a mid-flip tuner
+        # value would plan different bucket counts on different ranks —
+        # mismatched all_gather emissions (the exact desync
+        # resolve_bucket_bytes(compiled=True) exists to prevent).
+        bucket_bytes = _config().overlap_bucket_bytes
+
+    s_leaves, s_def = jax.tree_util.tree_flatten(shards_tree)
+    l_leaves = jax.tree_util.tree_leaves(like)
+    if len(s_leaves) != len(l_leaves):
+        raise ValueError(
+            f"gather_in_forward: {len(s_leaves)} shard leaves vs "
+            f"{len(l_leaves)} template leaves; shards must mirror the "
+            "params structure")
+    if prefetch:
+        plan = plan_buckets(l_leaves, bucket_bytes, order="forward")
+    else:
+        # One bucket = one barrier gather (sized past the whole tree).
+        total = sum(_leaf_nbytes(x) for x in l_leaves) + 1
+        plan = plan_buckets(l_leaves, total, order="forward")
+    _overlap_metrics()[0].inc(float(plan.n_buckets))
+
+    out: List[Any] = [None] * len(s_leaves)
+    for idxs in plan.buckets:
+        tag = _make_gather_tag([l_leaves[i] for i in idxs], op, ax,
+                               compression, world)
+        fulls = tag(*[s_leaves[i] for i in idxs])
+        for j, i in enumerate(idxs):
+            out[i] = fulls[j]
+    return jax.tree_util.tree_unflatten(s_def, out)
+
+
+# ---------------------------------------------------------------------------
 # eager / negotiated plane: async bucket queue
 # ---------------------------------------------------------------------------
 
@@ -724,3 +863,144 @@ class EagerBucketQueue:
             mets[3].inc(min(exposed, union))
             mets[4].inc(max(union - exposed, 0.0))
         return out
+
+
+class EagerGatherQueue:
+    """ZeRO-3 forward-prefetch on the eager / negotiated plane: launch
+    per-bucket asynchronous parameter allgathers AHEAD of the layers
+    that consume them, collect each bucket just-in-time.
+
+    The caller drives the prefetch depth::
+
+        plan = plan_buckets(param_templates, order="forward")
+        q = EagerGatherQueue(plan, like=param_templates)
+        for b in range(plan.n_buckets):
+            q.launch(b, shards_of_bucket(b))    # wire starts NOW
+        for b in range(plan.n_buckets):
+            params_b = q.take(b)                # blocks only if not done
+            compute_layer(params_b)
+        q.drain()                               # records hidden/exposed
+
+    ``take`` returns the bucket's FULL leaves (plan order within the
+    bucket), reassembled from the rank-major gathered buffers exactly
+    like the compiled plane's ``_bucket_allgather``.  ``drain`` records
+    the measured exposed/hidden gather seconds in both the shared
+    overlap counters (so the PR 10 step attribution prices gathers like
+    any overlap-managed comm) and the ``hvd_zero_gather_*`` pair (so
+    the gather's own share stays separable for benches and drills).
+    Names follow the collective naming contract — identical call order
+    across ranks; pass a distinct ``name`` per step when two queues can
+    be in flight at once."""
+
+    def __init__(self, plan: BucketPlan, like: Sequence,
+                 name: Optional[str] = None, world: Optional[int] = None):
+        from . import collective as C
+        if len(like) != plan.n_leaves:
+            raise ValueError(
+                f"plan covers {plan.n_leaves} leaves, template has "
+                f"{len(like)}")
+        self._plan = plan
+        self._like = list(like)
+        self._world = int(world) if world else C.communicator_size()
+        self._base = name or "zero.gather"
+        # bucket -> (finisher, submit_s, wall_launched)
+        self._inflight = {}
+        self._taken: dict = {}
+        self._submit_total = 0.0
+        self._blocked = 0.0
+        self._spans: List[Tuple[float, float]] = []
+
+    def launch(self, bucket: int, shards: Sequence) -> None:
+        """Submit bucket ``bucket``'s shard allgather (one concatenated
+        buffer per bucket); returns once the transfer is in flight."""
+        from . import collective as C
+        idxs = self._plan.buckets[bucket]
+        if len(shards) != len(idxs):
+            raise ValueError(
+                f"bucket {bucket} holds {len(idxs)} leaves, "
+                f"got {len(shards)}")
+        cat = np.concatenate([np.asarray(s).reshape(-1) for s in shards]) \
+            if len(shards) > 1 else np.asarray(shards[0]).reshape(-1)
+        # Relaunch invalidates the bucket's cached result: without this
+        # a reused queue would serve the PREVIOUS step's params from
+        # _taken and never synchronize the fresh gather handle.
+        self._taken.pop(bucket, None)
+        _overlap_metrics()[0].inc()
+        _flight.record("overlap.gather_launch", f"{self._base}.b{bucket}",
+                       bucket=bucket, bytes=int(cat.nbytes),
+                       tensors=len(shards))
+        t0 = time.perf_counter()
+        with C.overlap_submit_scope():
+            h = C.allgather_async(cat, name=f"{self._base}.{idxs[0]}")
+        submit_s = time.perf_counter() - t0
+        self._submit_total += submit_s
+        self._inflight[bucket] = (h, submit_s, time.perf_counter())
+
+    def take(self, bucket: int) -> List[Any]:
+        """The bucket's full param leaves; blocks only for the part of
+        the gather the caller's compute did not already hide."""
+        from . import collective as C
+        if bucket in self._taken:
+            return self._taken[bucket]
+        h, submit_s, launched = self._inflight.pop(bucket)
+        t0 = time.perf_counter()
+        gathered = np.asarray(C.synchronize(h))
+        now = time.perf_counter()
+        self._blocked += now - t0
+        self._spans.append((launched - submit_s, now))
+        _flight.record("overlap.gather_done", f"{self._base}.b{bucket}",
+                       bucket=bucket, dur_s=now - launched)
+        idxs = self._plan.buckets[bucket]
+        # Rank-major reassembly: the gathered buffer is world
+        # concatenated copies of the bucket's shard layout.
+        ks = [self._shard_k(i) for i in idxs]
+        sum_k = sum(ks)
+        world = gathered.size // sum_k
+        grid = gathered.reshape(world, sum_k)
+        outs, off = [], 0
+        for i, k in zip(idxs, ks):
+            like = self._like[i]
+            size = int(np.prod(like.shape)) if hasattr(like, "shape") else k
+            flat = grid[:, off: off + k].reshape(-1)
+            outs.append(flat[:size].reshape(like.shape)
+                        .astype(like.dtype, copy=False))
+            off += k
+        self._taken[bucket] = outs
+        return outs
+
+    def _shard_k(self, leaf_idx: int) -> int:
+        # Shard length per leaf is not recoverable from the gathered
+        # buffer alone when leaves share a bucket; recompute it from
+        # the template exactly like _my_shard pads.
+        like = self._like[leaf_idx]
+        size = int(np.prod(like.shape)) if hasattr(like, "shape") else 0
+        return (size + (-size) % self._world) // self._world
+
+    def drain(self) -> None:
+        """Collect any untaken buckets and publish the measured
+        exposed/hidden gather seconds."""
+        for bucket in sorted(self._inflight):
+            self.take(bucket)
+        union, cursor = 0.0, None
+        for start, end in sorted(self._spans):
+            if cursor is None or start > cursor:
+                union += end - start
+            elif end > cursor:
+                union += end - cursor
+            cursor = end if cursor is None else max(cursor, end)
+        if union > 0:
+            exposed = min(self._submit_total + self._blocked, union)
+            hidden = max(union - exposed, 0.0)
+            mets = _overlap_metrics()
+            # NOT the hidden-ratio gauge: that gauge is documented as
+            # EagerBucketQueue's gradient-overlap figure, and a stage-3
+            # step runs BOTH queues — a destructive set here would make
+            # it read whichever queue drained last.  The gather's own
+            # ratio is derivable from the dedicated counter pair.
+            mets[3].inc(exposed)
+            mets[4].inc(hidden)
+            mets[5].inc(exposed)
+            mets[6].inc(hidden)
+        self._spans = []
+        self._submit_total = 0.0
+        self._blocked = 0.0

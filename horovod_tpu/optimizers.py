@@ -230,53 +230,94 @@ class _ZeroState(NamedTuple):
                               # reshard moments across world-size changes)
 
 
+def _is_zero_param_state(x) -> bool:
+    """Sharded-residency wrapper check: stage-3 params ride the SAME
+    ``_ZeroState`` shape as sharded moments (``inner`` = the
+    params-structured tree of flat shards), so every downstream plane —
+    checkpoint engine, peer recovery, elastic sync, broadcast refusal —
+    handles sharded params with zero new code."""
+    return isinstance(x, _ZeroState)
+
+
 class ZeroGradientTransformation(NamedTuple):
     """``optax.GradientTransformation`` surface (init/update) plus the
     checkpoint lifecycle hooks ZeRO state needs — rank-distinct shards
     cannot ride ``broadcast_optimizer_state``, they round-trip through
-    ``horovod_tpu.checkpoint`` instead."""
+    ``horovod_tpu.checkpoint`` instead.
+
+    Stages 2/3 add the weight-update-sharding surface (docs/zero.md):
+    ``reduce_grads`` turns full local gradients into per-rank flat
+    shards (the persistent gradient object at stage >= 2),
+    ``shard_params``/``gather_params`` move parameters between their
+    sharded residency and the full values forward consumes (stage 3 —
+    the gather is the forward-prefetch bucket schedule), and
+    ``apply_updates`` applies update shards to a sharded param state."""
 
     init: Callable
     update: Callable
     state_dict: Callable       # (path, state, step, mesh=...) -> Manifest
     load_state_dict: Callable  # (path, like, mesh=..., step=...) -> state
+    stage: int = 1
+    reduce_grads: Optional[Callable] = None   # full grads -> grad shards
+    shard_params: Optional[Callable] = None   # params -> _ZeroState shards
+    gather_params: Optional[Callable] = None  # shards, like -> full params
+    apply_updates: Optional[Callable] = None  # shards, updates -> shards
 
 
 def ZeroShardedOptimizer(optimizer, op: int = C.Average,
                          axis_name: Optional[str] = None,
-                         compression=None, overlap=None):
-    """ZeRO-1 optimizer-state sharding over the data-parallel axis — a
+                         compression=None, overlap=None,
+                         stage: Optional[int] = None):
+    """ZeRO weight-update sharding over the data-parallel axis — a
     TPU-native capability beyond the reference (Horovod replicates
     optimizer state on every rank; here each dp rank owns 1/N of it,
-    cutting Adam's state memory N-fold).
+    cutting Adam's state memory N-fold — and at stage 3, parameter
+    memory too; arXiv:2004.13336 automatic cross-replica weight-update
+    sharding).
 
-    Per leaf: the gradient is reduce-scattered (`lax.psum_scatter`) so
-    each rank holds one flat 1/N shard, the inner optax update runs on
-    that shard (with the matching param shard, so decoupled weight
-    decay sees real params), and the update shard is all-gathered back
-    to full shape.  reduce_scatter + all_gather move the same bytes as
-    the one allreduce they replace, riding ICI.
+    ``stage`` (default ``HVD_TPU_ZERO_STAGE``, 1):
+
+    * **1** — optimizer-state sharding.  Per leaf: the gradient is
+      reduce-scattered so each rank holds one flat 1/N shard, the inner
+      optax update runs on that shard (with the matching param shard,
+      so decoupled weight decay sees real params), and the update shard
+      is all-gathered back to full shape.  reduce_scatter + all_gather
+      move the same bytes as the one allreduce they replace.
+    * **2** — + gradient sharding: ``update`` takes gradient *shards*
+      (from ``reduce_grads`` or stage-2/3 autodiff), so the persistent
+      gradient object — e.g. a ``backward_passes_per_step``-style
+      accumulator — is 1/N, never the full tree.  Updates still
+      all-gather (params stay replicated).
+    * **3** — + parameter sharding: params live as flat 1/N shards
+      (``shard_params``); forward rebuilds them with the per-bucket
+      forward-prefetch gather (``gather_params`` →
+      ``ops.overlap.gather_in_forward``), whose VJP reduce-scatters
+      cotangents, so grads arrive as shards with no extra call;
+      ``update`` returns update *shards* and ``apply_updates`` keeps
+      params sharded — no update all-gather at all (the next step's
+      forward gather moves the fresh values).
 
     Both ``init`` and ``update`` MUST run inside ``jit``/``shard_map``
-    over ``axis_name`` (default "data") with replicated params and
-    per-shard gradients — both read the axis.  The inner transformation
-    must be elementwise (sgd, momentum, adam, adamw, rmsprop, ...);
-    cross-parameter reductions (e.g. global-norm clipping) would only
-    see the local shard.
+    over ``axis_name`` (default "data"; a TUPLE of axes shards over
+    their product — e.g. ``("data", "model")`` on a 2-D mesh) — both
+    read the axis.  The inner transformation must be elementwise (sgd,
+    momentum, adam, adamw, rmsprop, ...); cross-parameter reductions
+    (e.g. global-norm clipping) would only see the local shard.
 
     ``compression`` (``hvd.Compression.{bf16,int8,int4}``) routes the
     gradient reduce-scatter through the quantized/cast one-pass schedule
     (``ops.quantization.compressed_reducescatter``): contributions move
     compressed, accumulation is fp32, and the optimizer sees a
-    full-precision gradient shard.  The all_gather of update shards
-    stays full-precision — updates feed ``optax.apply_updates`` directly
-    and, unlike gradients, have no error-feedback channel to absorb
+    full-precision gradient shard.  The all_gathers (update shards at
+    stage <= 2, parameter shards at stage 3) stay full-precision —
+    their consumers have no error-feedback channel to absorb
     quantization loss.
 
     ``overlap`` (same semantics as ``DistributedOptimizer``) buckets the
-    gradient reduce-scatter: one wire exchange per size-bounded bucket
-    in reverse-autodiff order instead of one per leaf, bit-identical
-    shards, schedulable by XLA against the surrounding backward.
+    gradient reduce-scatter and the stage-3 parameter gather: one wire
+    exchange per size-bounded bucket instead of one per leaf,
+    bit-identical values, schedulable by XLA against the surrounding
+    compute.
     """
     import optax
     from jax import lax
@@ -284,6 +325,12 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     from .compat import axis_size
 
     ax = C._default_axis(axis_name)
+    if stage is None:
+        from .core.config import Config, get_int
+        stage = get_int("ZERO_STAGE", Config.zero_stage)
+    stage = int(stage)
+    if stage not in (1, 2, 3):
+        raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
 
     def _pad_flat(x, world):
         flat = x.reshape(-1)
@@ -297,43 +344,132 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
         flat = _pad_flat(x, world)
         return flat.reshape(world, flat.size // world)[idx]
 
-    def init_fn(params):
+    def _shard_tree(params):
         world = axis_size(ax)
         idx = lax.axis_index(ax)
-        shards = jax.tree_util.tree_map(
+        return jax.tree_util.tree_map(
             lambda p: _my_shard(p, world, idx), params)
+
+    def _check_shards(grads, what: str):
+        for leaf in jax.tree_util.tree_leaves(grads):
+            if getattr(leaf, "ndim", 1) != 1:
+                raise ValueError(
+                    f"ZeRO stage {stage} update expects {what} as flat "
+                    f"per-rank shards (got a leaf of shape "
+                    f"{getattr(leaf, 'shape', '?')}); reduce full "
+                    "gradients with the transformation's reduce_grads, "
+                    "or differentiate through gather_params so the VJP "
+                    "reduce-scatters them — see docs/zero.md")
+
+    def reduce_grads_fn(grads):
+        """Full per-rank local gradients → flat 1/N gradient shards,
+        one (optionally quantized) reduce-scatter exchange per bucket —
+        the stage-2/3 gradient wire.  Bit-identical to the per-leaf
+        schedule."""
+        world = axis_size(ax)
+        bucket_bytes = _overlap.resolve_bucket_bytes(overlap, compiled=True)
+        if bucket_bytes:
+            return _overlap.bucketed_reducescatter_tree(
+                grads, op=op, axis_name=ax, compression=compression,
+                bucket_bytes=bucket_bytes)
+        return jax.tree_util.tree_map(
+            lambda g: C.reducescatter(
+                _pad_flat(g, world), op=op, axis_name=ax,
+                compression=(compression if C._compressible(g, op)
+                             else None)), grads)
+
+    def init_fn(params):
+        # At stage 3 ``params`` may already be the sharded state
+        # (shard_params output) — init the moments straight on its
+        # shards; full params work at any stage.
+        if _is_zero_param_state(params):
+            return _ZeroState(inner=optimizer.init(params.inner),
+                              sizes=params.sizes)
+        shards = _shard_tree(params)
         # True (unpadded) flat sizes are static shape facts, recorded in
         # the state so the checkpoint engine can reshard the moments
         # when a restore lands on a different world size.
         sizes = jax.tree_util.tree_map(lambda p: p.size, params)
         return _ZeroState(inner=optimizer.init(shards), sizes=sizes)
 
-    def update_fn(grads, state: _ZeroState, params=None):
-        world = axis_size(ax)
-        idx = lax.axis_index(ax)
+    def shard_params_fn(params):
+        """Params → their sharded residency: a ``_ZeroState`` whose
+        ``inner`` is the params-structured tree of flat 1/N shards
+        (checkpoint/recovery/elastic planes treat it exactly like
+        sharded moments — rank-distinct, engine-committed, resharded on
+        restore).  Runs inside ``shard_map`` over the axis."""
+        return _ZeroState(
+            inner=_shard_tree(params),
+            sizes=jax.tree_util.tree_map(lambda p: p.size, params))
 
-        bucket_bytes = _overlap.resolve_bucket_bytes(overlap, compiled=True)
-        if bucket_bytes:
-            g_shards = _overlap.bucketed_reducescatter_tree(
-                grads, op=op, axis_name=ax, compression=compression,
-                bucket_bytes=bucket_bytes)
+    def gather_params_fn(pstate, like, prefetch: Optional[bool] = None):
+        """Sharded params → full values via the forward-prefetch bucket
+        schedule (``ops.overlap.gather_in_forward``): one allgather per
+        bucket emitted ahead of the layers that consume it, and a VJP
+        that reduce-scatters cotangents back into gradient shards.
+        ``like`` is the full-params template (live arrays or
+        ``jax.eval_shape`` structs — static shapes only)."""
+        shards = pstate.inner if _is_zero_param_state(pstate) else pstate
+        return _overlap.gather_in_forward(
+            shards, like, op=op, axis_name=ax, compression=compression,
+            bucket_bytes=_overlap.resolve_bucket_bytes(overlap,
+                                                       compiled=True),
+            prefetch=prefetch)
+
+    def apply_updates_fn(pstate, updates):
+        """Apply update shards to a sharded param state (params never
+        leave their 1/N residency)."""
+        shards = pstate.inner if _is_zero_param_state(pstate) else pstate
+        new = optax.apply_updates(shards, updates)
+        if _is_zero_param_state(pstate):
+            return pstate._replace(inner=new)
+        return new
+
+    def update_fn(grads, state: _ZeroState, params=None):
+        if stage == 1:
+            g_shards = reduce_grads_fn(grads)
+            p_shards = None if params is None else _shard_tree(params)
         else:
-            g_shards = jax.tree_util.tree_map(
-                lambda g: C.reducescatter(
-                    _pad_flat(g, world), op=op, axis_name=ax,
-                    compression=(compression if C._compressible(g, op)
-                                 else None)), grads)
-        p_shards = None if params is None else jax.tree_util.tree_map(
-            lambda p: _my_shard(p, world, idx), params)
+            # Stage 2/3 contract: gradients ARRIVE as shards — the full
+            # tree was consumed bucket-by-bucket inside the backward
+            # (gather_in_forward's VJP) or by an explicit reduce_grads,
+            # so no full-gradient object persists into the update.
+            _check_shards(grads, "gradients")
+            g_shards = grads
+            if params is None:
+                p_shards = None
+            elif stage == 3:
+                if _is_zero_param_state(params):
+                    p_shards = params.inner
+                else:
+                    _check_shards(params, "params")
+                    p_shards = params
+            else:
+                p_shards = _shard_tree(params)
         upd_shards, inner = optimizer.update(g_shards, state.inner,
                                              p_shards)
+        new_state = _ZeroState(inner=inner, sizes=state.sizes)
+        if stage == 3:
+            # Params stay sharded: return update shards for
+            # apply_updates; the next forward's gather moves the fresh
+            # values, so there is no update all-gather at all.
+            return upd_shards, new_state
 
         def _regather(u, ref):
             full = lax.all_gather(u, ax, tiled=True)
             return full[:ref.size].reshape(ref.shape).astype(ref.dtype)
 
-        updates = jax.tree_util.tree_map(_regather, upd_shards, grads)
-        return updates, _ZeroState(inner=inner, sizes=state.sizes)
+        if stage == 1:
+            updates = jax.tree_util.tree_map(_regather, upd_shards, grads)
+        else:
+            if params is None:
+                raise ValueError(
+                    "ZeRO stage 2 update needs the (replicated) params "
+                    "to regather full updates from shard-shaped "
+                    "gradients; pass params=")
+            updates = jax.tree_util.tree_map(_regather, upd_shards,
+                                             params)
+        return updates, new_state
 
     def state_dict(path: str, state, step: int, **kwargs):
         """Write one committed sharded-checkpoint step of this state
@@ -351,8 +487,10 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
         kwargs.setdefault("axis_name", ax)
         return restore_zero_state(path, like, **kwargs)
 
-    return ZeroGradientTransformation(init_fn, update_fn,
-                                      state_dict, load_state_dict)
+    return ZeroGradientTransformation(
+        init_fn, update_fn, state_dict, load_state_dict, stage=stage,
+        reduce_grads=reduce_grads_fn, shard_params=shard_params_fn,
+        gather_params=gather_params_fn, apply_updates=apply_updates_fn)
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,32 @@ def test_jax_transformer_lm_overlap_identical_losses():
     assert all(abs(a - b) <= 2e-4 for a, b in zip(lb, lo)), (lb, lo)
 
 
+def test_jax_transformer_lm_zero_stages_identical_losses():
+    """--zero-stage 1/2/3 end-to-end at world 1: ZeRO only changes the
+    wire schedule and residency, never the math — the seeded run's
+    printed losses must match the unsharded baseline at every stage."""
+    args = ["--layers", "1", "--d-model", "64", "--seq", "32",
+            "--batch", "4", "--steps", "3"]
+    runs = {s: _run([os.path.join(EXAMPLES, "jax_transformer_lm.py")]
+                    + args + ["--zero-stage", str(s)])
+            for s in (0, 1, 2, 3)}
+    for s, r in runs.items():
+        assert r.returncode == 0, (s, r.stderr[-2000:])
+
+    def losses(r):
+        return [float(ln.split("loss")[-1]) for ln in r.stdout.splitlines()
+                if "loss" in ln]
+
+    base = losses(runs[0])
+    assert len(base) == 3, runs[0].stdout
+    for s in (1, 2, 3):
+        ls = losses(runs[s])
+        assert len(ls) == 3, (s, runs[s].stdout)
+        # Printed at 4 decimals; one ulp of print rounding only.
+        assert all(abs(a - b) <= 2e-4 for a, b in zip(base, ls)), \
+            (s, base, ls)
+
+
 @pytest.mark.timeout(300)
 def test_pytorch_synthetic_benchmark_single_proc():
     pytest.importorskip("torch")

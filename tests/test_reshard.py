@@ -1,0 +1,128 @@
+"""Golden tests for the pure-numpy flat-shard math in
+``checkpoint/reshard.py`` (ISSUE 14 satellite: 53 lines of layout
+arithmetic every durability tier leans on, previously tested only
+through the engine).
+
+Covers the 1-D ZeRO layout (pad/slice/reassemble/reshard N→M), the
+(dp, mp) nested two-level layout and its mesh-change reshard, padded
+tails at both levels, and the refusal paths for incompatible inputs.
+"""
+
+import importlib
+
+import numpy as np
+import pytest
+
+# The package re-exports the reshard() FUNCTION under the submodule's
+# name, so attribute import would bind the function (the same shadowing
+# metrics.attribution documents); resolve the MODULE explicitly.
+R = importlib.import_module("horovod_tpu.checkpoint.reshard")
+
+
+# ---------------------------------------------------------------------------
+# 1-D layout goldens
+# ---------------------------------------------------------------------------
+
+def test_pad_flat_golden():
+    np.testing.assert_array_equal(
+        R.pad_flat(np.array([[1.0, 2.0], [3.0, 4.0]]), 3),
+        [1.0, 2.0, 3.0, 4.0, 0.0, 0.0])
+    # Already a multiple: no copy of semantics, same values.
+    np.testing.assert_array_equal(
+        R.pad_flat(np.arange(4.0), 2), [0.0, 1.0, 2.0, 3.0])
+
+
+def test_shard_of_golden():
+    x = np.arange(10.0)  # padded to 12 at world 4 -> k = 3
+    np.testing.assert_array_equal(R.shard_of(x, 4, 0), [0, 1, 2])
+    np.testing.assert_array_equal(R.shard_of(x, 4, 3), [9, 0, 0])
+
+
+def test_reshard_n_to_m_golden():
+    x = np.arange(10.0)
+    shards4 = [R.shard_of(x, 4, r) for r in range(4)]
+    shards2 = R.reshard(shards4, 10, 2)
+    np.testing.assert_array_equal(shards2[0], [0, 1, 2, 3, 4])
+    np.testing.assert_array_equal(shards2[1], [5, 6, 7, 8, 9])
+    # Grow path: 2 -> 3 re-pads the tail.
+    shards3 = R.reshard(shards2, 10, 3)
+    np.testing.assert_array_equal(
+        np.concatenate(shards3)[:10], x)
+    assert all(s.size == 4 for s in shards3)
+
+
+def test_reassemble_refuses_short_shards():
+    with pytest.raises(ValueError, match="< true_size"):
+        R.reassemble([np.arange(3.0)], true_size=7)
+
+
+# ---------------------------------------------------------------------------
+# (dp, mp) nested layout
+# ---------------------------------------------------------------------------
+
+def test_mesh_shard_golden_padded_both_levels():
+    # 23 elements over (dp=2, mp=3): mp pads 23 -> 24 (slices of 8),
+    # dp pads 8 -> 8 (k = 4).  Hand-checked corners.
+    x = np.arange(23.0)
+    assert R.mesh_shard_of(x, (2, 3), 0, 0).tolist() == [0, 1, 2, 3]
+    assert R.mesh_shard_of(x, (2, 3), 1, 0).tolist() == [4, 5, 6, 7]
+    assert R.mesh_shard_of(x, (2, 3), 0, 2).tolist() == [16, 17, 18, 19]
+    # The global tail: slice 2 holds elements 16..22 + one pad zero.
+    assert R.mesh_shard_of(x, (2, 3), 1, 2).tolist() == [20, 21, 22, 0]
+
+
+def test_mesh_layout_degrades_to_1d_at_mp1():
+    x = np.arange(10.0)
+    for r in range(4):
+        np.testing.assert_array_equal(
+            R.mesh_shard_of(x, (4, 1), r, 0), R.shard_of(x, 4, r))
+    shards = [R.shard_of(x, 4, r) for r in range(4)]
+    np.testing.assert_array_equal(
+        R.reassemble_mesh(shards, 10, (4, 1)), x)
+    for a, b in zip(R.reshard_mesh(shards, 10, (4, 1), (2, 1)),
+                    R.reshard(shards, 10, 2)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("true_size", [1, 7, 12, 23, 64])
+@pytest.mark.parametrize("old", [(4, 1), (2, 2), (1, 3), (3, 2)])
+@pytest.mark.parametrize("new", [(2, 2), (1, 1), (2, 3)])
+def test_mesh_reshard_roundtrip_bit_identical(true_size, old, new):
+    """Any (dp, mp) -> (dp', mp') move preserves every logical element
+    exactly — only the two padding levels differ."""
+    x = np.arange(true_size, dtype=np.float64) + 0.5
+    shards = [R.mesh_shard_of(x, old, d, m)
+              for d in range(old[0]) for m in range(old[1])]
+    moved = R.reshard_mesh(shards, true_size, old, new)
+    assert len(moved) == new[0] * new[1]
+    np.testing.assert_array_equal(
+        R.reassemble_mesh(moved, true_size, new), x)
+    # dp-major order: direct slicing at the new mesh agrees per shard.
+    direct = [R.mesh_shard_of(x, new, d, m)
+              for d in range(new[0]) for m in range(new[1])]
+    for a, b in zip(moved, direct):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# refusal paths
+# ---------------------------------------------------------------------------
+
+def test_reassemble_mesh_refuses_wrong_shard_count():
+    x = np.arange(8.0)
+    shards = [R.mesh_shard_of(x, (2, 2), d, m)
+              for d in range(2) for m in range(2)]
+    with pytest.raises(ValueError, match="4 shards per leaf, got 3"):
+        R.reassemble_mesh(shards[:3], 8, (2, 2))
+
+
+def test_reassemble_mesh_refuses_ragged_shards():
+    with pytest.raises(ValueError, match="ragged shard sizes"):
+        R.reassemble_mesh([np.arange(4.0), np.arange(3.0)], 7, (2, 1))
+
+
+def test_mesh_refuses_degenerate_sizes():
+    with pytest.raises(ValueError, match=">= 1"):
+        R.mesh_shard_of(np.arange(4.0), (0, 2), 0, 0)
+    with pytest.raises(ValueError, match=">= 1"):
+        R.reshard_mesh([np.arange(4.0)], 4, (1, 1), (2, 0))
