@@ -65,6 +65,11 @@ TIER_FAST=(
   # reshard arithmetic every durability tier leans on.
   test_reshard.py
   test_resnet.py test_response_cache.py test_timeline.py
+  # Serving plane (ISSUE 15): admission-policy goldens, prefill/decode
+  # parity vs the training-path logits, continuous-vs-static occupancy,
+  # hot-swap bit-parity, overload shed, and the train→serve handoff
+  # drill (`bench.py --bench serving` measures the batching win).
+  test_serving.py
   test_transformer.py
   # Closed-loop autotuning drill (ISSUE 12): injected comm regression →
   # drift → bounded re-tune → regression-gated rollback → resolution in

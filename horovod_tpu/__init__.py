@@ -51,6 +51,7 @@ from . import fleet
 from . import metrics
 from . import net
 from . import recovery
+from . import serving
 
 __all__ = [
     "__version__",
@@ -73,5 +74,5 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "mesh_lib", "checkpoint", "data", "debug", "elastic", "fleet",
-    "metrics", "net", "recovery",
+    "metrics", "net", "recovery", "serving",
 ]

@@ -148,6 +148,23 @@ FLEET_PREEMPT_GRACE_S = "FLEET_PREEMPT_GRACE_S"  # commit wait before forcing
 # series over GET /fleet/observe/<job> without touching worker disks.
 FLEET_OBSERVE_PUSH_S = "FLEET_OBSERVE_PUSH_S"  # push cadence; 0 = off
 FLEET_OBSERVE_RETAIN = "FLEET_OBSERVE_RETAIN"  # ring samples per job
+# Serving plane (horovod_tpu/serving/): continuous-batching inference
+# services on the fleet fabric — decode-slot geometry, the bounded
+# admission queue, checkpoint hot-swap polling, and queue/SLO-driven
+# replica autoscaling.  See docs/serving.md.
+SERVING_PORT = "SERVING_PORT"                  # request-plane HTTP port
+SERVING_ADDR = "SERVING_ADDR"                  # client default replica addr
+SERVING_SECRET = "SERVING_SECRET"              # request HMAC secret
+SERVING_SLOTS = "SERVING_SLOTS"                # decode slots per replica
+SERVING_PAGE_TOKENS = "SERVING_PAGE_TOKENS"    # tokens per KV page
+SERVING_MAX_LEN = "SERVING_MAX_LEN"            # context cap; 0 = model seq_len
+SERVING_MAX_NEW_TOKENS = "SERVING_MAX_NEW_TOKENS"  # default output cap
+SERVING_QUEUE_CAP = "SERVING_QUEUE_CAP"        # admission queue bound
+SERVING_SWAP_POLL_S = "SERVING_SWAP_POLL_S"    # checkpoint watch cadence
+SERVING_AUTOSCALE = "SERVING_AUTOSCALE"        # replica autoscaler on/off
+SERVING_TARGET_QUEUE = "SERVING_TARGET_QUEUE"  # queued reqs/replica target
+SERVING_SLO_TTFT_S = "SERVING_SLO_TTFT_S"      # TTFT target; 0 = none
+SERVING_SCALE_COOLDOWN_S = "SERVING_SCALE_COOLDOWN_S"  # resize hysteresis
 # Seeded wire chaos (both the native socket layer and the Python HTTP
 # planes read these; inert unless set).
 CHAOS_NET_SEED = "CHAOS_NET_SEED"              # wire-chaos schedule seed
@@ -342,6 +359,22 @@ class Config:
     fleet_preempt_grace_s: float = 30.0
     fleet_observe_push_s: float = 0.0
     fleet_observe_retain: int = 512
+    # Serving plane: decode-slot geometry (slots × pages × page tokens
+    # is the replica's whole KV budget), the request plane's bounded
+    # admission queue, the checkpoint-watch cadence of the hot-swap
+    # path, and the queue-depth/SLO autoscaler (off by default — a
+    # replica only resizes itself when asked to).  See docs/serving.md.
+    serving_port: int = 28643
+    serving_slots: int = 8
+    serving_page_tokens: int = 16
+    serving_max_len: int = 0          # 0 = the model's seq_len
+    serving_max_new_tokens: int = 64
+    serving_queue_cap: int = 64
+    serving_swap_poll_s: float = 2.0
+    serving_autoscale: bool = False
+    serving_target_queue: float = 4.0
+    serving_slo_ttft_s: float = 0.0
+    serving_scale_cooldown_s: float = 10.0
     net_resilience: bool = True
     net_probe_ms: float = 10000.0
     net_reconnect_s: float = 10.0
@@ -480,6 +513,27 @@ class Config:
             FLEET_OBSERVE_PUSH_S, cfg.fleet_observe_push_s))
         cfg.fleet_observe_retain = max(1, get_int(
             FLEET_OBSERVE_RETAIN, cfg.fleet_observe_retain))
+        cfg.serving_port = get_int(SERVING_PORT, cfg.serving_port)
+        cfg.serving_slots = max(1, get_int(SERVING_SLOTS,
+                                           cfg.serving_slots))
+        cfg.serving_page_tokens = max(1, get_int(SERVING_PAGE_TOKENS,
+                                                 cfg.serving_page_tokens))
+        cfg.serving_max_len = max(0, get_int(SERVING_MAX_LEN,
+                                             cfg.serving_max_len))
+        cfg.serving_max_new_tokens = max(1, get_int(
+            SERVING_MAX_NEW_TOKENS, cfg.serving_max_new_tokens))
+        cfg.serving_queue_cap = max(1, get_int(SERVING_QUEUE_CAP,
+                                               cfg.serving_queue_cap))
+        cfg.serving_swap_poll_s = max(0.05, get_float(
+            SERVING_SWAP_POLL_S, cfg.serving_swap_poll_s))
+        cfg.serving_autoscale = get_bool(SERVING_AUTOSCALE,
+                                         cfg.serving_autoscale)
+        cfg.serving_target_queue = max(0.5, get_float(
+            SERVING_TARGET_QUEUE, cfg.serving_target_queue))
+        cfg.serving_slo_ttft_s = max(0.0, get_float(
+            SERVING_SLO_TTFT_S, cfg.serving_slo_ttft_s))
+        cfg.serving_scale_cooldown_s = max(0.0, get_float(
+            SERVING_SCALE_COOLDOWN_S, cfg.serving_scale_cooldown_s))
         cfg.net_resilience = get_bool(NET_RESILIENCE, cfg.net_resilience)
         cfg.net_probe_ms = get_float(NET_PROBE_MS, cfg.net_probe_ms)
         cfg.net_reconnect_s = get_float(NET_RECONNECT_S,

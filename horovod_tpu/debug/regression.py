@@ -69,6 +69,11 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     # data.chaos_delay — a deliberately slowed eager plane reads as a
     # net-subsystem event, consistent with the comm_exposed component.
     "net.chaos_delay": "net",
+    # Serving plane (horovod_tpu/serving/): a weight hot-swap or an
+    # autoscale resize is a discrete config-changing moment on a
+    # colocated replica; admits/sheds corroborate load pressure.
+    "serving.swap": "serving", "serving.autoscale": "serving",
+    "serving.admit": "serving", "serving.shed": "serving",
     # Prefix families (trailing "."): any kind under these namespaces
     # classifies even when it has no exact entry — subsystems grow new
     # event kinds (checkpoint.extract.*, recovery.restore.miss, ...)
@@ -79,7 +84,7 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     # perf. (the diagnoser's own output).
     "autotune.": "autotune", "elastic.": "elastic", "fleet.": "fleet",
     "net.": "net", "recovery.": "recovery", "checkpoint.": "checkpoint",
-    "data.": "data", "dispatch.": "dispatch",
+    "data.": "data", "dispatch.": "dispatch", "serving.": "serving",
 }
 
 # Subsystems that can plausibly explain a given drifting component —
@@ -97,7 +102,10 @@ COMPONENT_SUBSYSTEMS: Dict[str, tuple] = {
 # (they corroborate a component, they don't name a cause).
 _CORROBORATING = {"data.wait", "elastic.commit", "checkpoint.save.begin",
                   "checkpoint.save.commit", "recovery.replicate",
-                  "overlap.plan"}
+                  "overlap.plan",
+                  # Per-request serving chatter: evidence of load, not
+                  # a discrete config change (swap/autoscale/shed are).
+                  "serving.admit", "serving.retire"}
 
 _last_report: Optional[dict] = None
 _last_lock = threading.Lock()

@@ -38,7 +38,7 @@ from typing import Callable, List, Optional
 
 from ..runner.hosts import HostInfo
 from ..runner.rendezvous import BackgroundHTTPServer, _signature
-from .job import DENIED, PREEMPTED, QUEUED, JobSpec
+from .job import DENIED, PREEMPTED, QUEUED, RUNNING, JobSpec
 from .queue import DurableJobQueue
 from .scheduler import Scheduler
 
@@ -117,6 +117,11 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 "queued": sum(1 for r in records
                               if r.state in (QUEUED, PREEMPTED)),
                 "running": gw.scheduler.running_count(),
+                # Long-lived inference replicas currently seated
+                # (JobSpec kind="service" — docs/serving.md).
+                "services": sum(1 for r in records
+                                if r.spec.kind == "service"
+                                and r.state == RUNNING),
             })
         if key == "jobs":
             return self._send(200, {

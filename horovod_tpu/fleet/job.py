@@ -58,6 +58,12 @@ class JobSpec:
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     checkpoint_dir: str = ""
     max_queue_s: float = 0.0
+    # "batch" jobs run to completion; "service" jobs (serving replicas,
+    # docs/serving.md) never complete — the scheduler seats them like
+    # any running job (shrinkable toward min_np by preemption, grown
+    # back when capacity frees), and only a DELETE or a preemption
+    # suspend ever ends one.
+    kind: str = "batch"
 
     def __post_init__(self):
         # Coerce the numeric fields at the boundary (JSON clients send
@@ -86,6 +92,8 @@ class JobSpec:
                 isinstance(k, str) and isinstance(v, str)
                 for k, v in self.env.items()):
             return "env must be a {str: str} mapping"
+        if self.kind not in ("batch", "service"):
+            return f"kind must be 'batch' or 'service', got {self.kind!r}"
         return None
 
     def to_dict(self) -> dict:

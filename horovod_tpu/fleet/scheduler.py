@@ -54,6 +54,7 @@ class ElasticJobRunner:
         env.update(extra_env)
         env["HVD_TPU_FLEET_JOB_ID"] = record.id
         env["HVD_TPU_FLEET_TENANT"] = record.spec.tenant
+        env["HVD_TPU_FLEET_JOB_KIND"] = record.spec.kind
         self._driver = ElasticDriver(
             self._discovery, list(record.spec.command),
             min_np=record.spec.min_np, max_np=record.spec.max_np,

@@ -45,6 +45,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--max-queue-s", type=float, default=0.0,
                    help="queue-wait SLO target in seconds (dashboard + "
                         "equal-priority ordering hint)")
+    p.add_argument("--kind", default="batch",
+                   choices=("batch", "service"),
+                   help="'service' marks a long-lived job that never "
+                        "completes (a serving replica — docs/serving.md)")
     p.add_argument("--env", action="append", default=[],
                    metavar="KEY=VALUE", help="worker env (repeatable)")
     p.add_argument("--wait", action="store_true",
@@ -74,7 +78,7 @@ def build_spec(args: argparse.Namespace) -> JobSpec:
                    max_np=max_np, priority=args.priority,
                    tenant=args.tenant, name=args.name, env=env,
                    checkpoint_dir=args.checkpoint_dir,
-                   max_queue_s=args.max_queue_s)
+                   max_queue_s=args.max_queue_s, kind=args.kind)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -285,12 +285,15 @@ def make_loss_fn(cfg: TransformerConfig, par: ParallelConfig, mesh):
     return loss_of
 
 
-def serial_forward_loss(cfg: TransformerConfig, params: Dict[str, Any],
-                        tokens: jax.Array, labels: jax.Array) -> jax.Array:
-    """Unsharded oracle computing the same math as ``forward_loss`` (dense
-    MLP only) — used by tests to validate the sharded step end to end."""
+def serial_forward_logits(cfg: TransformerConfig, params: Dict[str, Any],
+                          tokens: jax.Array) -> jax.Array:
+    """Unsharded training-path forward (dense MLP only): full fp32
+    logits (B, S, V).  The numerics oracle the sharded loss AND the
+    serving prefill/decode split are validated against."""
     assert cfg.n_experts == 0, "serial oracle covers the dense configuration"
-    x = (params["embed"][tokens] + params["pos"][None]).astype(cfg.dtype)
+    s_in = tokens.shape[1]
+    x = (params["embed"][tokens] + params["pos"][None, :s_in]).astype(
+        cfg.dtype)
     hd = cfg.head_dim
     n_pp, lps = params["layers"]["ln1"].shape[:2]
     for st in range(n_pp):
@@ -309,8 +312,15 @@ def serial_forward_loss(cfg: TransformerConfig, params: Dict[str, Any],
                                        lp["w1"].astype(x.dtype)))
             x = x + jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
     hidden = _rmsnorm(x, params["final_norm"])
-    logits = jnp.einsum("bsd,vd->bsv", hidden.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
+    return jnp.einsum("bsd,vd->bsv", hidden.astype(jnp.float32),
+                      params["embed"].astype(jnp.float32))
+
+
+def serial_forward_loss(cfg: TransformerConfig, params: Dict[str, Any],
+                        tokens: jax.Array, labels: jax.Array) -> jax.Array:
+    """Unsharded oracle computing the same math as ``forward_loss`` (dense
+    MLP only) — used by tests to validate the sharded step end to end."""
+    logits = serial_forward_logits(cfg, params, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
@@ -352,6 +362,176 @@ def synthetic_batch(key, cfg: TransformerConfig, batch: int):
                                 dtype=jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
     return tokens, labels
+
+
+# ---------------------------------------------------------------------------
+# Serving: prefill / decode split over a paged KV cache
+# ---------------------------------------------------------------------------
+#
+# Inference splits the training forward into two entry points sharing a
+# page-pool KV cache (``hvd.serving`` builds the continuous-batching
+# engine on top — docs/serving.md):
+#
+# * :func:`prefill` runs the ordinary causal forward over one prompt
+#   (the training path's math, layer by layer) while writing each
+#   layer's K/V into the prompt's cache pages, and returns the logits
+#   at the last prompt position — the first sampled token.
+# * :func:`decode_step` advances a whole BATCH of sequences by one
+#   token each: per layer it appends the new K/V at each slot's write
+#   position and attends the single query against that slot's gathered
+#   pages.  Shapes depend only on (slots, pages-per-slot, page size) —
+#   never on which requests occupy the slots — so the engine compiles
+#   it exactly once per geometry.
+#
+# Numerics: scores/softmax/PV accumulate in fp32 exactly like
+# ``ra.reference_attention``; normalization and the vocab head are fp32
+# like the training path.  Cache pages store K/V in the compute dtype.
+# Padded/masked positions score ``-1e30`` → their softmax weight
+# underflows to exactly 0.0, so a decode step reproduces the training
+# forward's next-token distribution up to fp32 summation-order effects
+# (the gathered key axis is the padded page extent, not the exact
+# prefix length) — goldens assert tight ``allclose`` + argmax equality,
+# not bit equality (see tests/test_serving.py).
+
+_NEG_INF = -1e30
+
+
+def init_kv_pages(cfg: TransformerConfig, n_pages: int,
+                  page_size: int) -> Dict[str, jax.Array]:
+    """Allocate the paged KV pool: ``k``/``v`` arrays of shape
+    (n_layers, n_pages, page_size, n_heads, head_dim) in the compute
+    dtype.  Pages are the allocation unit — a sequence's cache is the
+    ordered list of page rows its page table names."""
+    shape = (cfg.n_layers, int(n_pages), int(page_size),
+             cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _flat_layers(params: Dict[str, Any]) -> Dict[str, jax.Array]:
+    """Collapse the (n_pp, layers_per_stage, ...) stacked layer params
+    into (n_layers, ...) for layer-indexed serving loops."""
+    return {k: v.reshape((-1,) + v.shape[2:])
+            for k, v in params["layers"].items()}
+
+
+def prefill(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array, length: jax.Array,
+            kv: Dict[str, jax.Array],
+            page_rows: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the causal forward over one padded prompt, writing K/V into
+    the cache.
+
+    tokens: (S,) int32, S a static multiple of the page size (padding
+    past ``length`` is arbitrary — causality keeps it out of every
+    valid position's context).  length: dynamic scalar, 1 <= length <= S.
+    page_rows: (S // page_size,) int32 physical page indices receiving
+    positions [0, S).  Returns (fp32 logits (V,) at position length-1,
+    updated kv).
+    """
+    assert cfg.n_experts == 0, "serving covers the dense configuration"
+    s = tokens.shape[0]
+    page_size = kv["k"].shape[2]
+    n_rows = s // page_size
+    hd = cfg.head_dim
+    x = (params["embed"][tokens] + params["pos"][:s]).astype(cfg.dtype)
+    x = x[None]                                   # (1, S, d)
+    layers = _flat_layers(params)
+    for l in range(cfg.n_layers):
+        lp = {k: v[l] for k, v in layers.items()}
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = jnp.einsum("bsd,de->bse", h, lp["wqkv"].astype(x.dtype))
+        qkv = qkv.reshape(1, s, cfg.n_heads, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        kv["k"] = kv["k"].at[l, page_rows].set(
+            k[0].reshape(n_rows, page_size, cfg.n_heads, hd))
+        kv["v"] = kv["v"].at[l, page_rows].set(
+            v[0].reshape(n_rows, page_size, cfg.n_heads, hd))
+        o = ra.full_attention(q, k, v, causal=True)
+        x = x + jnp.einsum("bse,ed->bsd", o.reshape(1, s, -1),
+                           lp["wo"].astype(x.dtype))
+        h = _rmsnorm(x, lp["ln2"])
+        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                   lp["w1"].astype(x.dtype)))
+        x = x + jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
+    hidden = _rmsnorm(x, params["final_norm"])           # (1, S, d)
+    last = lax.dynamic_index_in_dim(hidden[0], length - 1, axis=0,
+                                    keepdims=False)      # (d,)
+    logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, kv
+
+
+def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
+                tokens: jax.Array, lengths: jax.Array,
+                kv: Dict[str, jax.Array],
+                page_tables: jax.Array
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance every slot by one token against its paged cache.
+
+    tokens: (B,) int32 — the input token each slot consumes this step
+    (written at position ``lengths[b]``).  lengths: (B,) int32 context
+    sizes BEFORE this step.  page_tables: (B, pages_per_slot) int32 —
+    logical position p of slot b lives in physical page
+    ``page_tables[b, p // page_size]`` at offset ``p % page_size``.
+    Returns (fp32 logits (B, V) predicting each slot's next token,
+    updated kv).  Slots the caller considers inactive should point
+    their page-table row at a scratch page — the math still runs, the
+    writes land somewhere harmless, and the logits are ignored.
+    """
+    assert cfg.n_experts == 0, "serving covers the dense configuration"
+    b, pages_per_slot = page_tables.shape
+    page_size = kv["k"].shape[2]
+    max_len = pages_per_slot * page_size
+    hd = cfg.head_dim
+    scale = 1.0 / (hd ** 0.5)
+    write_page = jnp.take_along_axis(
+        page_tables, (lengths // page_size)[:, None], axis=1)[:, 0]
+    write_off = lengths % page_size
+    x = (params["embed"][tokens] + params["pos"][lengths]).astype(cfg.dtype)
+    layers = _flat_layers(params)
+    k_pos = jnp.arange(max_len)
+    mask = k_pos[None] <= lengths[:, None]               # (B, max_len)
+    for l in range(cfg.n_layers):
+        lp = {k: v[l] for k, v in layers.items()}
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = jnp.einsum("bd,de->be", h, lp["wqkv"].astype(x.dtype))
+        qkv = qkv.reshape(b, cfg.n_heads, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        kv["k"] = kv["k"].at[l, write_page, write_off].set(k)
+        kv["v"] = kv["v"].at[l, write_page, write_off].set(v)
+        # Gather AFTER the write so position lengths[b] (this token) is
+        # in its own context, matching the causal training forward.
+        k_ctx = kv["k"][l][page_tables].reshape(
+            b, max_len, cfg.n_heads, hd)
+        v_ctx = kv["v"][l][page_tables].reshape(
+            b, max_len, cfg.n_heads, hd)
+        s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                       k_ctx.astype(jnp.float32)) * scale
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhk,bkhd->bhd", p,
+                       v_ctx.astype(jnp.float32)).astype(x.dtype)
+        x = x + jnp.einsum("be,ed->bd", o.reshape(b, -1),
+                           lp["wo"].astype(x.dtype))
+        h = _rmsnorm(x, lp["ln2"])
+        u = jax.nn.gelu(jnp.einsum("bd,df->bf", h,
+                                   lp["w1"].astype(x.dtype)))
+        x = x + jnp.einsum("bf,fd->bd", u, lp["w2"].astype(x.dtype))
+    hidden = _rmsnorm(x, params["final_norm"])           # (B, d)
+    logits = jnp.einsum("bd,vd->bv", hidden.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, kv
+
+
+def decode_flops_per_token(cfg: TransformerConfig, context: int) -> float:
+    """Matmul-FLOPs for one decode step of one sequence at the given
+    context size — the serving bench's audited accounting (projections
+    + vocab head + the query-against-context attention)."""
+    d, ff, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    dense = L * (8.0 * d * d + 4.0 * d * ff) + 2.0 * d * v
+    attn = L * 4.0 * context * d
+    return dense + attn
 
 
 def train_flops_per_seq(cfg: TransformerConfig) -> float:

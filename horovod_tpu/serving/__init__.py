@@ -1,0 +1,43 @@
+"""Serving plane — continuous-batching inference on the fleet fabric.
+
+The inference half of the north star: long-lived services multiplexed
+onto the same fleet the trainer owns.  One replica is
+
+* a **decode engine** (``engine.py``): prefill/decode split over the
+  flagship transformer (``models/transformer.py``) with a paged
+  per-slot KV cache and a token-level continuous-batching step loop —
+  finished sequences retire mid-batch, new requests admit into the
+  freed slots next iteration, and the decode step compiles exactly
+  once per (slot count, page geometry);
+* a **request plane** (``server.py`` + ``policy.py``): HMAC-gated
+  ``POST /serve/generate`` with streaming token responses, a bounded
+  admission queue, and a pure deterministic admission policy
+  (priority, per-tenant fair share, deadline-aware ordering, loud
+  shed-on-overload);
+* the **train→serve loop** (``service.py``): weights cold-load from a
+  committed training checkpoint over the engine's streaming read path,
+  and a watcher hot-swaps newer committed steps between decode
+  iterations, bit-identical to a cold load;
+* **autoscaling** (``autoscale.py``): queue-depth/TTFT-SLO pressure
+  drives ``ElasticDriver.request_resize``; the fleet's existing
+  grow/preemption machinery backfills freed slots to training jobs.
+
+See docs/serving.md.  Load clients: ``python -m
+horovod_tpu.serving.submit`` and ``examples/serving_client.py``.
+"""
+
+from .autoscale import Autoscaler, desired_np
+from .engine import DecodeEngine, Event, Request
+from .loadgen import drive, synthetic_workload
+from .policy import RequestView, plan
+from .server import ServingServer
+from .service import CheckpointWatcher, ServingService, load_params
+
+__all__ = [
+    "Autoscaler", "desired_np",
+    "DecodeEngine", "Event", "Request",
+    "drive", "synthetic_workload",
+    "RequestView", "plan",
+    "ServingServer",
+    "CheckpointWatcher", "ServingService", "load_params",
+]

@@ -1,0 +1,102 @@
+"""Replica autoscaling — queue-depth/SLO pressure → elastic resizes.
+
+The decision half, :func:`desired_np`, is pure and golden-testable:
+given the current width and the replica's live pressure signals (queue
+depth per replica vs the target, TTFT p95 vs the SLO) it returns the
+width the service *should* run at.  :class:`Autoscaler` executes those
+decisions against the ``ElasticDriver`` public resize carve-out
+(``request_resize(np, reason)`` — the PR-8 surface the fleet scheduler
+also drives), with cooldown hysteresis so pressure noise cannot flap
+the fleet.  When the service scales down, the freed slots return to
+the fleet's pool and the gateway's grow path backfills them to
+training jobs — the existing preemption/grow machinery, no new code.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+
+def desired_np(current_np: int, min_np: int, max_np: int,
+               queue_depth: int, target_queue: float,
+               ttft_p95: float = 0.0, slo_ttft_s: float = 0.0,
+               occupancy: float = 0.0) -> int:
+    """The width the service should run at.  Scale up one replica when
+    the queue holds more than ``target_queue`` requests per replica OR
+    TTFT p95 exceeds the SLO; scale down one only when the queue is
+    empty, the decode slots have real headroom (``occupancy`` — the
+    occupied-slot fraction — under half: a saturated replica whose
+    queue merely drained between ticks is NOT idle), and the SLO (when
+    set) has comfortable headroom (< half).  One step at a time — the
+    cooldown between calls is the ramp limiter."""
+    up = (queue_depth > target_queue * current_np
+          or (slo_ttft_s > 0 and ttft_p95 > slo_ttft_s))
+    down = (queue_depth == 0 and occupancy < 0.5
+            and (slo_ttft_s <= 0 or ttft_p95 < 0.5 * slo_ttft_s))
+    want = current_np + (1 if up else (-1 if down else 0))
+    return max(min_np, min(max_np, want))
+
+
+class Autoscaler:
+    """Drives ``driver.request_resize`` from a status callback.
+
+    ``status_fn()`` returns ``{"np": current width, "queue_depth": int,
+    "ttft_p95": seconds, "occupancy": occupied-slot fraction}``
+    (missing keys default sanely).  ``driver`` is anything with the
+    ElasticDriver resize carve-out."""
+
+    def __init__(self, driver, status_fn: Callable[[], Dict],
+                 min_np: int = 1, max_np: int = 1,
+                 target_queue: Optional[float] = None,
+                 slo_ttft_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None):
+        from ..core.config import Config, get_float
+        self.driver = driver
+        self.status_fn = status_fn
+        self.min_np = int(min_np)
+        self.max_np = int(max_np)
+        self.target_queue = max(0.5, (
+            get_float("SERVING_TARGET_QUEUE", Config.serving_target_queue)
+            if target_queue is None else float(target_queue)))
+        self.slo_ttft_s = max(0.0, (
+            get_float("SERVING_SLO_TTFT_S", Config.serving_slo_ttft_s)
+            if slo_ttft_s is None else float(slo_ttft_s)))
+        self.cooldown_s = max(0.0, (
+            get_float("SERVING_SCALE_COOLDOWN_S",
+                      Config.serving_scale_cooldown_s)
+            if cooldown_s is None else float(cooldown_s)))
+        self._last_resize = 0.0
+
+    def maybe_resize(self, now: Optional[float] = None) -> Optional[int]:
+        """Evaluate pressure once; returns the requested width when a
+        resize was issued, else None."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_resize < self.cooldown_s:
+            return None
+        st = self.status_fn() or {}
+        current = int(st.get("np", self.min_np))
+        want = desired_np(
+            current, self.min_np, self.max_np,
+            queue_depth=int(st.get("queue_depth", 0)),
+            target_queue=self.target_queue,
+            ttft_p95=float(st.get("ttft_p95", 0.0)),
+            slo_ttft_s=self.slo_ttft_s,
+            occupancy=float(st.get("occupancy", 0.0)))
+        if want == current:
+            return None
+        reason = (f"serving autoscale: queue_depth="
+                  f"{st.get('queue_depth', 0)}, ttft_p95="
+                  f"{st.get('ttft_p95', 0.0):.3f}s, {current}->{want}")
+        if not self.driver.request_resize(want, reason):
+            return None
+        self._last_resize = now
+        from ..metrics.registry import registry
+        registry().counter(
+            "hvd_serving_autoscale_total",
+            "Replica resizes issued by the serving autoscaler",
+            direction="up" if want > current else "down").inc()
+        from ..debug import flight
+        flight.record("serving.autoscale", None, np=want,
+                      was=current, queue=int(st.get("queue_depth", 0)))
+        return want

@@ -1,0 +1,434 @@
+"""Continuous-batching decode engine over a paged KV cache.
+
+One engine owns one replica's decode slots.  Its step loop is
+token-level batch recomposition: every iteration admits new requests
+into free slots (prefill), advances every occupied slot by one token
+(one batched ``decode_step``), and retires finished sequences
+mid-batch — there is no static-batch barrier, so a long generation
+never holds hostage the slots of its finished neighbors.
+
+Geometry is fixed at construction: ``slots`` decode slots, a page pool
+of ``page_tokens``-token KV pages, ``max_len`` context per slot.  The
+decode step is jit-compiled ONCE per (slot count, page geometry):
+admission only changes *array contents* (page tables, lengths, input
+tokens), never shapes, so admitting or retiring a request can never
+trigger a recompile (``decode_traces`` counts retraces; tests pin it
+at 1).  Prefill compiles once per power-of-two page-row bucket — a
+prompt is padded to its bucket with the surplus rows pointed at the
+scratch page, so padding never touches another slot's pages.
+
+Slot bookkeeping (page tables, lengths, free lists) lives on the host;
+only the page pool stays device-resident (donated through every call,
+so the cache updates in place in HBM).  Physical page 0 is the scratch
+page: unallocated page-table entries and inactive slots point at it,
+making their (masked, ignored) writes land somewhere harmless.
+
+Weight hot-swap: :meth:`swap_params` parks the new tree; it is applied
+at the top of the next iteration — between decode steps, never inside
+one — and is bit-identical to constructing a fresh engine from the
+same tree, because the engine never transforms params beyond passing
+them to the jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..models import transformer as tfm
+
+_serving_metrics = None
+
+# TTFT spans request-plane queueing; per-token latency is a decode step.
+_TTFT_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 60.0)
+_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.0)
+
+
+def _metrics():
+    """Cached serving metric children (hvd.metrics registry)."""
+    global _serving_metrics
+    if _serving_metrics is None:
+        from ..metrics.registry import registry
+        reg = registry()
+        _serving_metrics = {
+            "tokens": reg.counter(
+                "hvd_serving_tokens_total", "Generated tokens"),
+            "ttft": reg.histogram(
+                "hvd_serving_ttft_seconds",
+                "Arrival to first token (prefill + queue wait)",
+                buckets=_TTFT_BUCKETS),
+            "token_s": reg.histogram(
+                "hvd_serving_token_seconds",
+                "Per-token decode latency (one continuous-batching "
+                "iteration)", buckets=_TOKEN_BUCKETS),
+            "occupancy": reg.gauge(
+                "hvd_serving_batch_occupancy",
+                "Occupied decode slots / total slots at the last step"),
+            "swaps": reg.counter(
+                "hvd_serving_swaps_total",
+                "Weight hot-swaps applied between decode iterations"),
+            "ckpt_step": reg.gauge(
+                "hvd_serving_checkpoint_step",
+                "Checkpoint step of the weights currently serving"),
+        }
+    return _serving_metrics
+
+
+def _flight(kind: str, name: Optional[str] = None, **fields):
+    from ..debug import flight
+    flight.record(kind, name, **fields)
+
+
+def record_request(tenant: str) -> None:
+    """Count one request at ingress (HTTP handler or load driver)."""
+    from ..metrics.registry import registry
+    registry().counter("hvd_serving_requests_total",
+                       "Requests received", tenant=tenant).inc()
+
+
+def record_shed(request_id: str, tenant: str, reason: str) -> None:
+    """Count (and flight-record) one loudly shed request."""
+    from ..metrics.registry import registry
+    from ..utils import logging as log
+    registry().counter("hvd_serving_shed_total",
+                       "Requests shed instead of served",
+                       reason=reason).inc()
+    log.warning("serving: shed request %s (tenant %s): %s",
+                request_id, tenant, reason)
+    _flight("serving.shed", request_id, tenant=tenant, reason=reason)
+
+
+def set_queue_depth(depth: int) -> None:
+    from ..metrics.registry import registry
+    registry().gauge("hvd_serving_queue_depth",
+                     "Requests waiting for a decode slot").set(depth)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as the engine sees it."""
+
+    id: str
+    prompt: List[int]
+    max_new_tokens: int = 0        # 0 → HVD_TPU_SERVING_MAX_NEW_TOKENS
+    eos_id: Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: float = 0.0        # TTFT SLO; 0 = none
+    temperature: float = 0.0       # 0 = greedy
+    seed: int = 0
+    arrival_mono: float = 0.0      # time.monotonic() at ingress
+    submit_seq: int = 0
+
+    def pages_needed(self, page_tokens: int) -> int:
+        """KV pages reserved at admission: prompt + the full output
+        budget — conservative (a short generation frees early at
+        retire), but admission can then never deadlock on a page the
+        pool cannot produce."""
+        return -(-(len(self.prompt) + max(1, self.max_new_tokens))
+                 // page_tokens)
+
+
+@dataclasses.dataclass
+class Event:
+    """One engine output: a token landing on a request, or its end."""
+
+    request: Request
+    kind: str                      # "token" | "finish"
+    token: Optional[int] = None
+    first: bool = False
+    reason: str = ""               # finish: "eos" | "length"
+    tokens: Optional[List[int]] = None   # finish: the full output
+
+
+class _Slot:
+    __slots__ = ("request", "generated", "pages", "t_admit", "rng")
+
+    def __init__(self, request: Request, pages: List[int]):
+        self.request = request
+        self.generated: List[int] = []
+        self.pages = pages
+        self.t_admit = time.monotonic()
+        self.rng = (np.random.default_rng(request.seed)
+                    if request.temperature > 0 else None)
+
+
+class DecodeEngine:
+    """Single-threaded by contract: exactly one driver thread calls
+    :meth:`admit`/:meth:`step`; :meth:`swap_params` may be called from
+    any thread (it only parks the tree under a lock)."""
+
+    def __init__(self, cfg: tfm.TransformerConfig, params,
+                 slots: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 total_pages: Optional[int] = None,
+                 params_tag: Any = "cold"):
+        from ..core.config import Config, get_int
+        import jax
+        assert cfg.n_experts == 0, "serving covers the dense configuration"
+        self.cfg = cfg
+        # Same clamps Config.from_env applies: a garbage env knob must
+        # not zero-divide the engine (these read the raw env so an
+        # explicit constructor argument always wins).
+        self.slots = max(1, int(
+            slots if slots is not None else
+            get_int("SERVING_SLOTS", Config.serving_slots)))
+        self.page_tokens = max(1, int(
+            page_tokens if page_tokens is not None else
+            get_int("SERVING_PAGE_TOKENS", Config.serving_page_tokens)))
+        ml = (max_len if max_len is not None else
+              get_int("SERVING_MAX_LEN", Config.serving_max_len))
+        self.max_len = int(ml) if ml else cfg.seq_len
+        if self.max_len > cfg.seq_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's positional "
+                f"table ({cfg.seq_len})")
+        # Rounded DOWN to a page multiple: a partial tail page would
+        # make a full prompt's padded prefill extent overrun the
+        # positional table.
+        self.max_len -= self.max_len % self.page_tokens
+        if self.max_len < self.page_tokens:
+            raise ValueError(
+                f"max_len must be at least one page "
+                f"({self.page_tokens} tokens)")
+        self.pages_per_slot = self.max_len // self.page_tokens
+        n_pages = int(total_pages if total_pages is not None
+                      else self.slots * self.pages_per_slot)
+        self.total_pages = n_pages
+        # Physical page 0 is scratch; real pages are 1..n_pages.
+        self._kv = tfm.init_kv_pages(cfg, n_pages + 1, self.page_tokens)
+        self._free_pages: List[int] = list(range(1, n_pages + 1))
+        self._page_table = np.zeros((self.slots, self.pages_per_slot),
+                                    np.int32)
+        self._lengths = np.zeros((self.slots,), np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._params = params
+        self.params_tag = params_tag
+        self._pending: Optional[tuple] = None
+        self._swap_lock = threading.Lock()
+        self.decode_traces = 0
+        self.prefill_traces = 0
+        self.steps = 0
+        self.tokens_out = 0
+
+        def _decode(p, tokens, lengths, kv, page_tables):
+            self.decode_traces += 1      # trace-time side effect:
+            return tfm.decode_step(      # retrace == recompile evidence
+                cfg, p, tokens, lengths, kv, page_tables)
+
+        self._decode = jax.jit(_decode, donate_argnums=(3,))
+        self._prefill_fns: Dict[int, Any] = {}
+        self._jit = jax.jit
+
+    # -- capacity ----------------------------------------------------------
+
+    def free_slots(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    def active(self) -> int:
+        return self.slots - self.free_slots()
+
+    def occupancy(self) -> float:
+        return self.active() / self.slots
+
+    def running_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self._slots:
+            if s is not None:
+                t = s.request.tenant
+                out[t] = out.get(t, 0) + 1
+        return out
+
+    # -- weight hot-swap ---------------------------------------------------
+
+    def swap_params(self, params, tag: Any) -> None:
+        """Park a new weight tree; applied between decode iterations."""
+        with self._swap_lock:
+            self._pending = (params, tag)
+
+    def maybe_swap(self) -> None:
+        """Apply a parked swap now (the serving loop also calls this
+        while idle, so a drained replica still advances its weights)."""
+        self._maybe_swap()
+
+    def _maybe_swap(self) -> None:
+        with self._swap_lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        self._params, self.params_tag = pending
+        m = _metrics()
+        m["swaps"].inc()
+        if isinstance(self.params_tag, (int, float)):
+            m["ckpt_step"].set(float(self.params_tag))
+        _flight("serving.swap", str(self.params_tag),
+                active=self.active())
+
+    # -- admission (prefill) -----------------------------------------------
+
+    def _prefill_fn(self, n_rows_bucket: int):
+        fn = self._prefill_fns.get(n_rows_bucket)
+        if fn is None:
+            cfg = self.cfg
+
+            def _prefill(p, tokens, length, kv, rows):
+                self.prefill_traces += 1
+                return tfm.prefill(cfg, p, tokens, length, kv, rows)
+
+            fn = self._jit(_prefill, donate_argnums=(3,))
+            self._prefill_fns[n_rows_bucket] = fn
+        return fn
+
+    def admit(self, request: Request) -> List[Event]:
+        """Seat a request in a free slot: allocate its page
+        reservation, prefill its prompt, and sample its first token
+        (the TTFT moment).  The caller (the serving loop, driven by
+        ``policy.plan``) guarantees a slot and pages are free."""
+        import jax.numpy as jnp
+        self._maybe_swap()
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        if not request.max_new_tokens:
+            from ..core.config import Config, get_int
+            request.max_new_tokens = get_int(
+                "SERVING_MAX_NEW_TOKENS", Config.serving_max_new_tokens)
+        need = request.pages_needed(self.page_tokens)
+        if need > self.pages_per_slot:
+            raise ValueError(
+                f"request {request.id}: prompt + output budget "
+                f"({len(request.prompt)} + {request.max_new_tokens} "
+                f"tokens) exceeds the slot context ({self.max_len})")
+        if self.free_slots() == 0 or need > len(self._free_pages):
+            # The policy guarantees capacity before admitting; a caller
+            # bypassing it must fail loudly, not corrupt the free list.
+            raise RuntimeError(
+                f"request {request.id}: no capacity (free slots "
+                f"{self.free_slots()}, free pages "
+                f"{len(self._free_pages)} < {need})")
+        slot = next(i for i, s in enumerate(self._slots) if s is None)
+        pages = [self._free_pages.pop(0) for _ in range(need)]
+        self._page_table[slot, :] = 0
+        self._page_table[slot, :need] = pages
+        length = len(request.prompt)
+        self._lengths[slot] = length
+
+        prompt_rows = -(-length // self.page_tokens)
+        bucket = 1
+        while bucket < prompt_rows:
+            bucket *= 2
+        bucket = min(bucket, self.pages_per_slot)
+        s_pad = bucket * self.page_tokens
+        tokens = np.zeros((s_pad,), np.int32)
+        tokens[:length] = request.prompt
+        # Rows past the prompt's own pages write to scratch (page 0).
+        rows = np.zeros((bucket,), np.int32)
+        rows[:prompt_rows] = pages[:prompt_rows]
+        logits, self._kv = self._prefill_fn(bucket)(
+            self._params, jnp.asarray(tokens), jnp.int32(length),
+            self._kv, jnp.asarray(rows))
+        st = _Slot(request, pages)
+        self._slots[slot] = st
+        token = self._sample(st, np.asarray(logits))
+        now = time.monotonic()
+        m = _metrics()
+        if request.arrival_mono:
+            m["ttft"].observe(max(0.0, now - request.arrival_mono))
+        m["occupancy"].set(self.occupancy())
+        _flight("serving.admit", request.id, slot=slot,
+                prompt=length, pages=need, tenant=request.tenant)
+        return self._deliver(slot, st, token, first=True)
+
+    # -- the continuous-batching iteration ---------------------------------
+
+    def step(self) -> List[Event]:
+        """One decode iteration over every occupied slot.  Returns the
+        token/finish events it produced (empty when idle)."""
+        import jax.numpy as jnp
+        self._maybe_swap()
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        if not active:
+            _metrics()["occupancy"].set(0.0)
+            return []
+        t0 = time.perf_counter()
+        tokens = np.zeros((self.slots,), np.int32)
+        for i, st in active:
+            tokens[i] = st.generated[-1]
+        logits, self._kv = self._decode(
+            self._params, jnp.asarray(tokens),
+            jnp.asarray(self._lengths), self._kv,
+            jnp.asarray(self._page_table))
+        logits = np.asarray(logits)
+        wall = time.perf_counter() - t0
+        self.steps += 1
+        events: List[Event] = []
+        m = _metrics()
+        m["occupancy"].set(len(active) / self.slots)
+        for i, st in active:
+            self._lengths[i] += 1
+            token = self._sample(st, logits[i])
+            m["token_s"].observe(wall)
+            events.extend(self._deliver(i, st, token, first=False))
+        return events
+
+    def _sample(self, st: _Slot, logits: np.ndarray) -> int:
+        req = st.request
+        if req.temperature > 0:
+            z = logits.astype(np.float64) / req.temperature
+            z -= z.max()
+            p = np.exp(z)
+            p /= p.sum()
+            token = int(st.rng.choice(len(p), p=p))
+        else:
+            token = int(np.argmax(logits))
+        st.generated.append(token)
+        self.tokens_out += 1
+        _metrics()["tokens"].inc()
+        return token
+
+    def _deliver(self, slot: int, st: _Slot, token: int,
+                 first: bool) -> List[Event]:
+        req = st.request
+        events = [Event(req, "token", token=token, first=first)]
+        done_eos = req.eos_id is not None and token == req.eos_id
+        done_len = len(st.generated) >= req.max_new_tokens
+        if done_eos or done_len:
+            events.append(Event(
+                req, "finish", reason="eos" if done_eos else "length",
+                tokens=list(st.generated)))
+            self._retire(slot)
+        return events
+
+    def _retire(self, slot: int) -> None:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._free_pages.extend(st.pages)
+        self._page_table[slot, :] = 0
+        self._lengths[slot] = 0
+        _flight("serving.retire", st.request.id,
+                tokens=len(st.generated))
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "slots": self.slots,
+            "active": self.active(),
+            "free_pages": self.free_pages(),
+            "page_tokens": self.page_tokens,
+            "max_len": self.max_len,
+            "occupancy": round(self.occupancy(), 4),
+            "decode_traces": self.decode_traces,
+            "prefill_traces": self.prefill_traces,
+            "steps": self.steps,
+            "tokens_out": self.tokens_out,
+            "params_tag": self.params_tag,
+        }

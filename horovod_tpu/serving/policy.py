@@ -1,0 +1,115 @@
+"""Admission policy — pure, deterministic, golden-testable.
+
+The request-plane analog of ``fleet/policy.py``: one function,
+:func:`plan`, maps the queue's current view (waiting requests, free
+decode slots, free cache pages, per-tenant occupancy) to a list of
+decisions.  No I/O, no clocks (``now_s`` is an argument), no threads:
+the serving loop executes decisions; this module only chooses them.
+Two replicas restarted over the same queue admit identically.
+
+Policy, in order:
+
+* **Shed on overload** — loudly, never silently.  A request whose TTFT
+  deadline has already passed while queued is shed (serving it late
+  helps nobody and holds a slot a live request needs), a request whose
+  page reservation exceeds ``slot_pages`` — what any slot can EVER
+  hold — is shed as ``too_large`` (it would wait forever), and when
+  the queue exceeds ``queue_cap`` the lowest-priority newest
+  submissions beyond the cap are shed (the bounded-admission-queue
+  half lives at the HTTP ingress, which 503s before enqueueing; this
+  covers growth after admission control, e.g. a slot-starved backlog).
+* **Priority** — waiting requests are considered highest priority
+  first.
+* **Per-tenant fair share** — among equal priority, the tenant holding
+  the fewest decode slots goes first.
+* **Deadline-aware ordering** — ties break on the tightest absolute
+  deadline (arrival + deadline_s; no deadline sorts last) then
+  submission order.
+* **Slot assignment** — a request is admitted while a free slot AND
+  its page reservation fit; a request that does not fit *waits* without
+  blocking smaller requests behind it (head-of-line blocking would
+  idle slots a later request could use).  The known tradeoff: under
+  sustained small-request load a page-hungry request can wait
+  indefinitely — nothing reserves pages toward seating it.  Give such
+  requests a ``deadline_s`` (the wait is then bounded by a loud
+  deadline shed) or a dedicated replica; page-reservation aging is
+  deliberately out of scope for this plan function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+# Decision tuples (kind first):
+#   ("shed",  request_id, reason)   # "deadline" | "overload" | "too_large"
+#   ("admit", request_id)
+#   ("wait",  request_id, reason)   # "slots" | "pages"
+Decision = Tuple
+
+_INF = float("inf")
+
+
+@dataclasses.dataclass
+class RequestView:
+    """The policy-relevant projection of one queued request."""
+
+    id: str
+    tenant: str = "default"
+    priority: int = 0
+    submit_seq: int = 0
+    arrival_s: float = 0.0
+    deadline_s: float = 0.0    # TTFT SLO in seconds; 0 = no target
+    pages_needed: int = 1      # KV page reservation (prompt + output cap)
+
+
+def plan(queued: List[RequestView], free_slots: int, free_pages: int,
+         now_s: float, running: Optional[Dict[str, int]] = None,
+         queue_cap: int = 0, slot_pages: int = 0) -> List[Decision]:
+    running = dict(running or {})
+    decisions: List[Decision] = []
+    live: List[RequestView] = []
+    for v in queued:
+        if v.deadline_s > 0 and now_s - v.arrival_s > v.deadline_s:
+            decisions.append(("shed", v.id, "deadline"))
+        elif slot_pages > 0 and v.pages_needed > slot_pages:
+            # Larger than any slot can EVER hold: waiting would hold a
+            # queue position forever (and an idle engine hostage).
+            decisions.append(("shed", v.id, "too_large"))
+        else:
+            live.append(v)
+    if queue_cap > 0 and len(live) > queue_cap:
+        # Overload: shed the lowest-priority newest submissions beyond
+        # the cap, so what survives is exactly what the cap promises to
+        # eventually serve.
+        doomed = sorted(live, key=lambda v: (v.priority, -v.submit_seq))
+        for v in doomed[:len(live) - queue_cap]:
+            decisions.append(("shed", v.id, "overload"))
+        doomed_ids = {d[1] for d in decisions if d[0] == "shed"}
+        live = [v for v in live if v.id not in doomed_ids]
+
+    # Selection is one-at-a-time because each admit CHANGES the fair-
+    # share key (the admitted tenant now holds one more slot) — a
+    # precomputed sort would hand a burst tenant every free slot in
+    # one pass.
+    def key(v: RequestView):
+        return (-v.priority, running.get(v.tenant, 0),
+                (v.arrival_s + v.deadline_s) if v.deadline_s > 0
+                else _INF,
+                v.submit_seq)
+
+    pending = list(live)
+    while pending:
+        v = min(pending, key=key)
+        pending.remove(v)
+        if free_slots <= 0:
+            decisions.append(("wait", v.id, "slots"))
+            continue
+        if v.pages_needed > free_pages:
+            decisions.append(("wait", v.id, "pages"))
+            continue
+        decisions.append(("admit", v.id))
+        free_slots -= 1
+        free_pages -= v.pages_needed
+        running[v.tenant] = running.get(v.tenant, 0) + 1
+    return decisions

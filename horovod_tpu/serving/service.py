@@ -1,0 +1,171 @@
+"""Service composition — the train→serve loop closed.
+
+:func:`load_params` turns a committed training checkpoint step into a
+serving weight tree through the checkpoint engine's STREAMING read path
+(``open_step`` + ``rebuild_restored`` — per-leaf reads, transient
+memory O(largest leaf), the same shared ``_StepReader`` rebuild the
+elastic/peer-recovery restores go through), so a service replica's
+weights are bit-identical to what a training worker would restore from
+the same step *by construction*.
+
+:class:`CheckpointWatcher` is the hot-swap half: a daemon thread polls
+the checkpoint directory's ``latest_step`` on a cadence
+(``HVD_TPU_SERVING_SWAP_POLL_S``); when the training job commits a
+newer step the watcher loads it with the SAME :func:`load_params` and
+parks it on the engine, which applies it between decode iterations —
+hot-swapping is therefore bit-identical to cold-loading that step
+(tests/test_serving.py asserts float ``==``).
+
+:class:`ServingService` composes engine + request plane + watcher
+(+ optional autoscaler) into the long-lived process a
+``JobSpec(kind="service")`` fleet job runs.  Service jobs never
+complete: the fleet scheduler treats them as ordinary running jobs
+(shrinkable toward ``min_np`` by the existing checkpoint-mediated
+preemption; freed width backfilled to training jobs by the grow path).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..models import transformer as tfm
+from .engine import DecodeEngine
+from .server import ServingServer
+
+
+def load_params(ckpt_dir: str, like, step: Optional[int] = None
+                ) -> Tuple[Any, int]:
+    """Load a committed step's weight tree (streaming, mesh-free).
+
+    ``like`` supplies the pytree structure (e.g. a fresh
+    ``init_params``).  Returns (params as device arrays, step).
+    Raises FileNotFoundError when no committed step exists yet.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..checkpoint import engine as E
+    from ..checkpoint.zero import rebuild_restored
+    if step is None:
+        step = E.latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint step under {ckpt_dir}")
+    with E.open_step(ckpt_dir, int(step), 1) as restored:
+        params = rebuild_restored(
+            restored, like, source=f"step {step} under {ckpt_dir}")
+    return jax.tree_util.tree_map(jnp.asarray, params), int(step)
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint dir; park newer committed steps on the engine."""
+
+    def __init__(self, engine: DecodeEngine, ckpt_dir: str, like,
+                 poll_s: Optional[float] = None):
+        from ..core.config import Config, get_float
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.like = like
+        self.poll_s = max(0.05, (
+            get_float("SERVING_SWAP_POLL_S", Config.serving_swap_poll_s)
+            if poll_s is None else float(poll_s)))
+        self.current_step: Optional[int] = (
+            engine.params_tag if isinstance(engine.params_tag, int)
+            else None)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> Optional[int]:
+        """One poll: swap if a newer committed step exists.  Returns
+        the step parked on the engine, else None."""
+        from ..checkpoint import engine as E
+        try:
+            latest = E.latest_step(self.ckpt_dir)
+        except OSError:
+            return None
+        if latest is None or latest == self.current_step:
+            return None
+        try:
+            params, step = load_params(self.ckpt_dir, self.like,
+                                       step=latest)
+        except (OSError, ValueError) as e:
+            from ..utils import logging as log
+            log.warning("serving: checkpoint watch failed to load step "
+                        "%s from %s: %r", latest, self.ckpt_dir, e)
+            return None
+        self.engine.swap_params(params, step)
+        self.current_step = step
+        return step
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-tpu-serving-ckpt-watch",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception as e:  # noqa: BLE001 — the watch survives
+                from ..utils import logging as log
+                log.warning("serving: checkpoint watch error: %r", e)
+
+
+class ServingService:
+    """One replica: engine + request plane + hot-swap watcher."""
+
+    def __init__(self, cfg: tfm.TransformerConfig,
+                 checkpoint_dir: Optional[str] = None,
+                 params=None, params_tag: Any = "cold",
+                 like=None, port: Optional[int] = None,
+                 secret: Optional[str] = None,
+                 swap_poll_s: Optional[float] = None,
+                 watch: bool = True,
+                 **engine_kwargs):
+        import jax
+        self.cfg = cfg
+        if like is None:
+            like = tfm.init_params(jax.random.PRNGKey(0), cfg,
+                                   tfm.ParallelConfig())
+        self.like = like
+        if params is None:
+            if not checkpoint_dir:
+                raise ValueError(
+                    "ServingService needs params= or checkpoint_dir=")
+            params, params_tag = load_params(checkpoint_dir, like)
+        self.engine = DecodeEngine(cfg, params, params_tag=params_tag,
+                                   **engine_kwargs)
+        self.server = ServingServer(self.engine, port=port, secret=secret)
+        self.watcher = (CheckpointWatcher(self.engine, checkpoint_dir,
+                                          like, poll_s=swap_poll_s)
+                        if (checkpoint_dir and watch) else None)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def serve(self) -> int:
+        port = self.server.serve()
+        if self.watcher is not None:
+            self.watcher.start()
+        return port
+
+    def close(self) -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
+        self.server.close()
+
+    def status(self) -> Dict[str, Any]:
+        s = self.engine.stats()
+        s["queue_depth"] = self.server.queue_depth()
+        return s
