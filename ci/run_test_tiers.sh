@@ -46,6 +46,12 @@ TIER_FAST=(
   test_fleet.py
   test_launch_flags.py
   test_metrics.py
+  # Third mesh dimensions (ISSUE 16): MoE routing/capacity goldens, the
+  # (dp, ep) workload vs its no-capacity oracle and the FLOPs-matched
+  # dense baseline, 1F1B-vs-GPipe bit parity, the (2,2,2) -> (2,2,1)
+  # 3-axis reshard drill, pipeline_bubble attribution, and MoE serving
+  # (`bench.py --bench moe` prices the scaling/bubble/wire claims).
+  test_moe_pipeline.py
   test_net_resilience.py
   # Fleet-scale observability plane (ISSUE 13): digest merge algebra
   # goldens, flat-vs-tree straggler verdict parity, host observer

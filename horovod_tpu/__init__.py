@@ -42,6 +42,7 @@ from .optimizers import (
     broadcast_parameters, broadcast_optimizer_state,
     broadcast_object, allgather_object,
 )
+from . import parallel
 from .parallel import mesh as mesh_lib
 from . import checkpoint
 from . import data
@@ -73,6 +74,6 @@ __all__ = [
     "grad", "value_and_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
-    "mesh_lib", "checkpoint", "data", "debug", "elastic", "fleet",
-    "metrics", "net", "recovery", "serving",
+    "mesh_lib", "parallel", "checkpoint", "data", "debug", "elastic",
+    "fleet", "metrics", "net", "recovery", "serving",
 ]

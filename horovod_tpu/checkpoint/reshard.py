@@ -54,73 +54,94 @@ def reshard(shards: Sequence[np.ndarray], true_size: int,
 
 
 # ---------------------------------------------------------------------------
-# (dp, mp) mesh layouts — the nested two-level shard math
+# (dp, mp, ep/pp, ...) mesh layouts — the nested N-level shard math
 # ---------------------------------------------------------------------------
 #
-# A mesh with a model axis stores a leaf in two levels: the flat value
-# is zero-padded to a multiple of mp and split into mp contiguous MODEL
-# slices (rank-major: mp rank m owns slice m); each slice is then
-# zero-padded to a multiple of dp and split into dp DATA shards — the
-# ZeRO layout applied within each model slice.  The flat shard list is
-# dp-major: shard index = dp_rank * mp + mp_rank, matching
-# ``lax.axis_index(("data", "model"))`` inside shard_map.  With mp=1
-# every function below degrades exactly to the 1-D pair above.
+# A multi-axis mesh stores a leaf in nested levels, outermost split by
+# the LAST axis: the flat value is zero-padded to a multiple of the
+# last axis size and split into that many contiguous slices (rank-major:
+# rank m along the last axis owns slice m); each slice recurses on the
+# remaining axes, bottoming out in the ZeRO layout over the first axis.
+# For the classic (dp, mp) pair that is: mp model slices, each
+# ZeRO-sharded over dp.  A third axis — (dp, mp, ep) for expert
+# parallelism, (dp, mp, pp) for pipeline stages — just adds one more
+# split level; nothing else changes, which is why a mesh change across
+# ANY axis combination restores bit-identically as a plain reshard.
+# The flat shard list is row-major over the rank tuple: shard index =
+# ((r0 * n1) + r1) * n2 + r2 ..., matching ``lax.axis_index(axes)``
+# inside shard_map.  With trailing axes of size 1 every function below
+# degrades exactly to the lower-dimensional case.
 
 def _check_mesh(mesh) -> tuple:
-    dp, mp = int(mesh[0]), int(mesh[1])
-    if dp < 1 or mp < 1:
-        raise ValueError(f"mesh sizes must be >= 1, got {(dp, mp)}")
-    return dp, mp
+    dims = tuple(int(d) for d in mesh)
+    if not dims:
+        raise ValueError("mesh needs at least one axis")
+    if any(d < 1 for d in dims):
+        raise ValueError(f"mesh sizes must be >= 1, got {dims}")
+    return dims
 
 
-def mesh_shard_of(x: np.ndarray, mesh: Sequence[int], dp_rank: int,
-                  mp_rank: int) -> np.ndarray:
-    """Rank ``(dp_rank, mp_rank)``'s flat shard of a full value under a
-    ``(dp, mp)`` mesh."""
-    dp, mp = _check_mesh(mesh)
-    slice_ = pad_flat(x, mp).reshape(mp, -1)[mp_rank]
-    return shard_of(slice_, dp, dp_rank)
+def mesh_shard_of(x: np.ndarray, mesh: Sequence[int],
+                  *ranks: int) -> np.ndarray:
+    """Rank ``ranks``'s flat shard of a full value under an N-axis mesh
+    (``mesh_shard_of(x, (dp, mp), dp_rank, mp_rank)`` for the 2-D case,
+    one more rank per extra axis)."""
+    dims = _check_mesh(mesh)
+    if len(ranks) != len(dims):
+        raise ValueError(
+            f"mesh {dims} needs {len(dims)} ranks, got {len(ranks)}")
+    if len(dims) == 1:
+        return shard_of(x, dims[0], ranks[0])
+    last = dims[-1]
+    slice_ = pad_flat(x, last).reshape(last, -1)[ranks[-1]]
+    return mesh_shard_of(slice_, dims[:-1], *ranks[:-1])
 
 
 def reassemble_mesh(shards: Sequence[np.ndarray], true_size: int,
                     mesh: Sequence[int]) -> np.ndarray:
-    """Reassemble the logical value from a ``(dp, mp)`` mesh's dp-major
-    shard list, dropping both padding levels.
+    """Reassemble the logical value from an N-axis mesh's row-major
+    shard list, dropping every padding level.
 
     Refuses incompatible inputs loudly: a shard count that does not
     match the mesh, or ragged shard sizes (every shard of one leaf has
     the same length by construction — a mismatch means the shards come
     from different leaves or a different layout).
     """
-    dp, mp = _check_mesh(mesh)
-    if len(shards) != dp * mp:
+    dims = _check_mesh(mesh)
+    total = int(np.prod(dims))
+    if len(shards) != total:
         raise ValueError(
-            f"(dp={dp}, mp={mp}) mesh stores {dp * mp} shards per leaf, "
+            f"mesh {dims} stores {total} shards per leaf, "
             f"got {len(shards)}")
     sizes = {np.asarray(s).size for s in shards}
     if len(sizes) != 1:
         raise ValueError(
             f"ragged shard sizes {sorted(sizes)}: shards do not share "
-            "one (dp, mp) layout")
-    slice_padded = (true_size + (-true_size) % mp) // mp
+            f"one {dims} layout")
+    if len(dims) == 1:
+        return reassemble(shards, true_size)
+    last = dims[-1]
+    slice_padded = (true_size + (-true_size) % last) // last
     slices = []
-    for m in range(mp):
-        part = reassemble([shards[d * mp + m] for d in range(dp)],
-                          slice_padded)
-        slices.append(part)
+    for m in range(last):
+        # Row-major rank order: the last-axis rank is the fastest-
+        # varying index, so slice m's shards sit at indices ≡ m mod last.
+        sub = [shards[i] for i in range(total) if i % last == m]
+        slices.append(reassemble_mesh(sub, slice_padded, dims[:-1]))
     return np.concatenate(slices)[:true_size]
 
 
 def reshard_mesh(shards: Sequence[np.ndarray], true_size: int,
                  old_mesh: Sequence[int],
                  new_mesh: Sequence[int]) -> List[np.ndarray]:
-    """Re-slice a leaf's shards from an ``old_mesh = (dp, mp)`` layout
-    into ``new_mesh = (dp', mp')`` — the arbitrary-mesh-change
-    generalization of :func:`reshard` (which is the ``mp == mp' == 1``
-    special case).  Bit-identical logical elements; only the two
-    padding levels differ.  The returned list is dp-major for the new
-    mesh."""
-    dp2, mp2 = _check_mesh(new_mesh)
+    """Re-slice a leaf's shards from ``old_mesh`` into ``new_mesh`` —
+    the arbitrary-mesh-change generalization of :func:`reshard` (the
+    all-axes-but-one-equal-1 special case).  The meshes may differ in
+    rank count as well as axis sizes ((2, 2, 2) → (2, 2, 1) → (4,) all
+    hold the same logical elements); bit-identical logical values, only
+    the padding levels differ.  The returned list is row-major over the
+    new mesh's rank tuple."""
+    dims2 = _check_mesh(new_mesh)
     flat = reassemble_mesh(shards, true_size, old_mesh)
-    return [mesh_shard_of(flat, (dp2, mp2), d, m)
-            for d in range(dp2) for m in range(mp2)]
+    return [mesh_shard_of(flat, dims2, *rk)
+            for rk in np.ndindex(*dims2)]

@@ -74,9 +74,12 @@ def _keystr(path) -> str:
 def _axis_tuple(axis_name):
     """Normalize an axis argument to a tuple of axis names: ZeRO state
     may shard over ONE mesh axis (the classic dp layout) or over the
-    PRODUCT of several (``("data", "model")`` — every chip of a 2-D
-    mesh holds 1/(dp*mp), so a (dp, mp) mesh change is just an N→M
-    reshard of the same flat layout)."""
+    PRODUCT of arbitrarily many (``("data", "model")`` for a 2-D mesh,
+    ``("data", "model", "expert")`` / ``("data", "model", "pipe")`` for
+    a third axis — every chip holds 1/world of the flat layout, so a
+    mesh change across ANY axis combination, (2,2,2) → (2,2,1)
+    included, is just an N→M reshard of the same flat layout; the
+    peer/disk-free recovery path inherits this by construction)."""
     return axis_name if isinstance(axis_name, (tuple, list)) \
         else (axis_name,)
 

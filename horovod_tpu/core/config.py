@@ -165,6 +165,16 @@ SERVING_AUTOSCALE = "SERVING_AUTOSCALE"        # replica autoscaler on/off
 SERVING_TARGET_QUEUE = "SERVING_TARGET_QUEUE"  # queued reqs/replica target
 SERVING_SLO_TTFT_S = "SERVING_SLO_TTFT_S"      # TTFT target; 0 = none
 SERVING_SCALE_COOLDOWN_S = "SERVING_SCALE_COOLDOWN_S"  # resize hysteresis
+# Third mesh dimensions (parallel/moe.py, parallel/pipeline.py): MoE
+# routing geometry and the pipeline schedule.  Single-sourced here —
+# models read these through Config/the getters, never os.environ
+# directly.  See docs/parallel.md for the knob table.
+MOE_TOP_K = "MOE_TOP_K"                        # experts routed per token
+MOE_CAPACITY_FACTOR = "MOE_CAPACITY_FACTOR"    # dispatch slots / even share
+MOE_DISPATCH_BITS = "MOE_DISPATCH_BITS"        # 0 = fp32 wire; 8 | 4
+MOE_DISPATCH_BLOCK = "MOE_DISPATCH_BLOCK"      # quant scale-block length
+PP_SCHEDULE = "PP_SCHEDULE"                    # "gpipe" | "1f1b"
+PP_MICROBATCHES = "PP_MICROBATCHES"            # microbatches per step
 # Seeded wire chaos (both the native socket layer and the Python HTTP
 # planes read these; inert unless set).
 CHAOS_NET_SEED = "CHAOS_NET_SEED"              # wire-chaos schedule seed
@@ -375,6 +385,16 @@ class Config:
     serving_target_queue: float = 4.0
     serving_slo_ttft_s: float = 0.0
     serving_scale_cooldown_s: float = 10.0
+    # MoE / pipeline geometry: experts routed per token, dispatch-
+    # buffer headroom over the even share, the optional block-scaled
+    # quantized dispatch wire (0 = fp32; 8/4 ride ops/quantization.py),
+    # and the pipeline schedule + microbatch count.
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_bits: int = 0
+    moe_dispatch_block: int = 256
+    pp_schedule: str = "gpipe"
+    pp_microbatches: int = 1
     net_resilience: bool = True
     net_probe_ms: float = 10000.0
     net_reconnect_s: float = 10.0
@@ -534,6 +554,19 @@ class Config:
             SERVING_SLO_TTFT_S, cfg.serving_slo_ttft_s))
         cfg.serving_scale_cooldown_s = max(0.0, get_float(
             SERVING_SCALE_COOLDOWN_S, cfg.serving_scale_cooldown_s))
+        cfg.moe_top_k = max(1, get_int(MOE_TOP_K, cfg.moe_top_k))
+        cfg.moe_capacity_factor = max(0.0, get_float(
+            MOE_CAPACITY_FACTOR, cfg.moe_capacity_factor))
+        bits = get_int(MOE_DISPATCH_BITS, cfg.moe_dispatch_bits)
+        cfg.moe_dispatch_bits = bits if bits in (0, 4, 8) else 0
+        cfg.moe_dispatch_block = max(1, get_int(
+            MOE_DISPATCH_BLOCK, cfg.moe_dispatch_block))
+        sched = (get_env(PP_SCHEDULE, cfg.pp_schedule) or
+                 cfg.pp_schedule).strip().lower()
+        cfg.pp_schedule = sched if sched in ("gpipe", "1f1b") \
+            else cfg.pp_schedule
+        cfg.pp_microbatches = max(1, get_int(
+            PP_MICROBATCHES, cfg.pp_microbatches))
         cfg.net_resilience = get_bool(NET_RESILIENCE, cfg.net_resilience)
         cfg.net_probe_ms = get_float(NET_PROBE_MS, cfg.net_probe_ms)
         cfg.net_reconnect_s = get_float(NET_RECONNECT_S,

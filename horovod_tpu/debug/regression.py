@@ -95,6 +95,9 @@ COMPONENT_SUBSYSTEMS: Dict[str, tuple] = {
                      "fleet"),
     "checkpoint": ("checkpoint", "recovery", "elastic_commit"),
     "compute": ("autotune", "overlap", "fleet", "elastic"),
+    # Bubble grows when pipeline geometry changes (microbatch count,
+    # stage count) — an elastic resize or an autotune episode.
+    "pipeline_bubble": ("autotune", "elastic", "fleet"),
     "host": ("autotune", "data", "recovery"),
 }
 
@@ -259,6 +262,7 @@ def _verdict(component: str, suspect: Optional[dict]) -> str:
         "comm_exposed": "exposed communication",
         "checkpoint": "checkpoint/commit work",
         "compute": "compute (or an unmeasured residual)",
+        "pipeline_bubble": "pipeline-schedule bubble (fill/drain idle)",
         "host": "unattributed host time",
     }.get(component, component)
     if suspect is None:

@@ -71,7 +71,7 @@ from .exporters import (
 # import attribution`.
 from .attribution import (
     COMPONENTS, WALL_COMPONENTS, StepAttribution, compute_span,
-    last_attribution, peak_flops, set_step_flops,
+    last_attribution, note_pipeline_bubble, peak_flops, set_step_flops,
 )
 from .baseline import (
     DriftDetector, DriftEvent, drift_detector, last_drift_event,
@@ -91,6 +91,7 @@ __all__ = [
     "JsonlSink", "MetricsServer", "render_prometheus", "serve",
     "stop_serving",
     "COMPONENTS", "WALL_COMPONENTS", "StepAttribution", "compute_span",
-    "last_attribution", "peak_flops", "set_step_flops",
+    "last_attribution", "note_pipeline_bubble", "peak_flops",
+    "set_step_flops",
     "DriftDetector", "DriftEvent", "drift_detector", "last_drift_event",
 ]

@@ -65,14 +65,19 @@ from .registry import registry as _registry
 
 # The decomposition components, in the order reports print them.
 # "comm_hidden" is informational (overlapped wire time, not wall time);
-# the other five partition the step's wall clock.  WALL_COMPONENTS is
+# the others partition the step's wall clock.  WALL_COMPONENTS is
 # the single home — the drift detector (baseline.py) and the straggler
 # cause attribution (health.py) import it, so a future component is
-# considered everywhere or nowhere.
+# considered everywhere or nowhere.  "pipeline_bubble" is the schedule
+# fill/drain idle share of a pipeline-parallel step (reported by
+# parallel/pipeline.note_bubble via hvd_pipeline_bubble_seconds_total);
+# it is carved OUT of the measured compute span — the device is live
+# but idling, and a bubble that grows with a geometry change should
+# drift as its own component, not hide inside compute.
 COMPONENTS = ("compute", "comm_exposed", "comm_hidden", "input",
-              "checkpoint", "host")
+              "checkpoint", "pipeline_bubble", "host")
 WALL_COMPONENTS = ("compute", "comm_exposed", "input", "checkpoint",
-                   "host")
+                   "pipeline_bubble", "host")
 
 _enabled: Optional[bool] = None
 
@@ -217,6 +222,17 @@ class StepAttribution:
             with self._lock:
                 self._compute_total += float(seconds)
 
+    def note_pipeline_bubble(self, seconds: float) -> None:
+        """Credit measured pipeline-bubble seconds (schedule fill/drain
+        idle inside the compute span) to the source counter the
+        decomposition reads.  Callers: ``parallel/pipeline.note_bubble``
+        with ``bubble_fraction(...) * span``."""
+        if seconds > 0:
+            self._reg.counter(
+                "hvd_pipeline_bubble_seconds_total",
+                "Pipeline-schedule bubble (fill/drain idle) seconds"
+            ).inc(float(seconds))
+
     @contextlib.contextmanager
     def compute_span(self):
         """Bracket the device-blocking part of the step — the call that
@@ -246,7 +262,9 @@ class StepAttribution:
                 ("ovl_hidden",
                  "hvd_overlap_comm_hidden_seconds_total", False),
                 ("checkpoint",
-                 "hvd_checkpoint_blocking_seconds_total", False)):
+                 "hvd_checkpoint_blocking_seconds_total", False),
+                ("pipeline_bubble",
+                 "hvd_pipeline_bubble_seconds_total", False)):
             out[key], g = _family_read(reg, fam, histogram=hist)
             gen += g
         out["_gen"] = gen
@@ -289,10 +307,18 @@ class StepAttribution:
         input_s = d["input"]
         ckpt_s = d["checkpoint"]
         compute_meas = d["compute"]
-
-        attributed = input_s + ckpt_s + comm_exposed
+        # The bubble is reported as a share of the pipeline span, which
+        # lives INSIDE the compute span — split it out so schedule idle
+        # and useful compute drift independently.  Clamp to the measured
+        # compute when both are present (a bubble cannot exceed the span
+        # it was carved from).
+        bubble_s = d["pipeline_bubble"]
         if compute_meas > 0.0:
-            compute_s = compute_meas
+            bubble_s = min(bubble_s, compute_meas)
+
+        attributed = input_s + ckpt_s + comm_exposed + bubble_s
+        if compute_meas > 0.0:
+            compute_s = compute_meas - bubble_s
             host_s = dur_s - attributed - compute_s
         else:
             compute_s = max(dur_s - attributed, 0.0)
@@ -310,12 +336,14 @@ class StepAttribution:
                 input_s *= scale
                 ckpt_s *= scale
                 comm_exposed *= scale
+                bubble_s *= scale
                 compute_s *= scale
             host_s = 0.0
 
         comps = {"compute": compute_s, "comm_exposed": comm_exposed,
                  "comm_hidden": comm_hidden, "input": input_s,
-                 "checkpoint": ckpt_s, "host": host_s}
+                 "checkpoint": ckpt_s, "pipeline_bubble": bubble_s,
+                 "host": host_s}
         shares = {k: (comps[k] / dur_s) for k in WALL_COMPONENTS}
 
         with self._lock:
@@ -490,6 +518,13 @@ def last_attribution() -> Optional[dict]:
     """The most recent step's attribution record (None before the
     second ``step_end``)."""
     return attribution().last_record()
+
+
+def note_pipeline_bubble(seconds: float) -> None:
+    """Credit measured pipeline-bubble seconds to the ``pipeline_bubble``
+    wall component (see ``parallel/pipeline.note_bubble``, which computes
+    ``bubble_fraction(n_stages, n_micro) * span``)."""
+    attribution().note_pipeline_bubble(seconds)
 
 
 def window_shares() -> Optional[dict]:

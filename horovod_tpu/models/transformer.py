@@ -53,6 +53,7 @@ class TransformerConfig(NamedTuple):
     attn_mode: str = "megatron"   # "megatron" (tp heads) | "ring" | "ulysses" (sp)
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    top_k: int = 1                # MoE routes per token (serving + routing)
 
     @property
     def head_dim(self) -> int:
@@ -64,6 +65,7 @@ class ParallelConfig(NamedTuple):
     pp: int = 1
     mp: int = 1                   # shared tensor/sequence axis
     n_microbatches: int = 1
+    pp_schedule: str = "gpipe"    # "gpipe" | "1f1b" (bounded-stash backward)
 
     @property
     def axis_names(self) -> Tuple[str, str, str]:
@@ -199,7 +201,8 @@ def _mlp_block(cfg: TransformerConfig, lp: Dict[str, jax.Array],
             w_out=lp["w_out"],
         )
         y = moe_lib.moe_layer(mp_params, tok, "dp",
-                              capacity_factor=cfg.capacity_factor)
+                              capacity_factor=cfg.capacity_factor,
+                              top_k=cfg.top_k)
         return y.reshape(mb, s_local, d).astype(x.dtype)
     hg = tp.gather_sequence(hnorm, "mp", dim=1)
     u = jax.nn.gelu(tp.column_parallel(hg, lp["w1"].astype(x.dtype)))
@@ -248,8 +251,18 @@ def forward_loss(cfg: TransformerConfig, par: ParallelConfig,
     xs = pp_lib.stack_microbatches(x, par.n_microbatches)
     stage_params = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
     stage_fn = _make_stage_fn(cfg)
-    out = pp_lib.pipeline_apply(stage_fn, stage_params, xs, axis_name="pp",
-                                remat=cfg.remat)
+    if par.pp_schedule == "1f1b":
+        # Bounded-stash backward (O(n_stages) microbatch inputs, not
+        # O(n_micro) tick residuals); rematerializes inherently, so the
+        # remat flag does not apply.  Forward is bit-identical to GPipe.
+        out = pp_lib.pipeline_apply_1f1b(stage_fn, stage_params, xs,
+                                         axis_name="pp")
+    elif par.pp_schedule == "gpipe":
+        out = pp_lib.pipeline_apply(stage_fn, stage_params, xs,
+                                    axis_name="pp", remat=cfg.remat)
+    else:
+        raise ValueError(
+            f"unknown pp_schedule {par.pp_schedule!r} (gpipe | 1f1b)")
     hidden = pp_lib.unstack_microbatches(out)            # (B_local, s_local, d)
 
     # Final norm + tied logits + CE on the local sequence chunk.
@@ -415,6 +428,37 @@ def _flat_layers(params: Dict[str, Any]) -> Dict[str, jax.Array]:
             for k, v in params["layers"].items()}
 
 
+def _moe_mlp_serving(cfg: TransformerConfig, lp: Dict[str, jax.Array],
+                     tok: jax.Array) -> jax.Array:
+    """Per-token routed MoE MLP for serving.  tok: (T, d) → (T, d).
+
+    The router runs per token (fp32 softmax → top-k, same gating math
+    as training ``moe_layer``); combine weights are the raw top-k
+    softmax probabilities, matching training.  No capacity clamp:
+    capacity is a training-throughput construct (fixed dispatch
+    buffers), not part of the learned function — at inference every
+    token gets all of its routed experts.  The expert dim of the
+    all-experts einsums partitions over an ``ep`` mesh axis when
+    ``w_in``/``w_out`` are placed with a NamedSharding over experts
+    (serving/engine.py) — GSPMD inserts the dispatch/combine
+    collectives, so expert weights never gather onto one device.
+    """
+    e = cfg.n_experts
+    logits = jnp.einsum("td,de->te", tok.astype(jnp.float32),
+                        lp["gate"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # (T, E) fp32
+    top_p, top_i = lax.top_k(probs, cfg.top_k)
+    w = jnp.zeros_like(probs)
+    for j in range(cfg.top_k):
+        w = w + jax.nn.one_hot(top_i[:, j], e,
+                               dtype=probs.dtype) * top_p[:, j:j + 1]
+    h = jax.nn.gelu(jnp.einsum("td,edf->tef", tok.astype(jnp.float32),
+                               lp["w_in"].astype(jnp.float32)))
+    y = jnp.einsum("tef,efd->ted", h, lp["w_out"].astype(jnp.float32))
+    out = jnp.einsum("te,ted->td", w, y)             # fp32 combine
+    return out.astype(tok.dtype)
+
+
 def prefill(cfg: TransformerConfig, params: Dict[str, Any],
             tokens: jax.Array, length: jax.Array,
             kv: Dict[str, jax.Array],
@@ -429,7 +473,6 @@ def prefill(cfg: TransformerConfig, params: Dict[str, Any],
     positions [0, S).  Returns (fp32 logits (V,) at position length-1,
     updated kv).
     """
-    assert cfg.n_experts == 0, "serving covers the dense configuration"
     s = tokens.shape[0]
     page_size = kv["k"].shape[2]
     n_rows = s // page_size
@@ -451,9 +494,13 @@ def prefill(cfg: TransformerConfig, params: Dict[str, Any],
         x = x + jnp.einsum("bse,ed->bsd", o.reshape(1, s, -1),
                            lp["wo"].astype(x.dtype))
         h = _rmsnorm(x, lp["ln2"])
-        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
-                                   lp["w1"].astype(x.dtype)))
-        x = x + jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
+        if cfg.n_experts > 0:
+            y = _moe_mlp_serving(cfg, lp, h.reshape(s, -1))
+            x = x + y.reshape(1, s, -1)
+        else:
+            u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                       lp["w1"].astype(x.dtype)))
+            x = x + jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(x.dtype))
     hidden = _rmsnorm(x, params["final_norm"])           # (1, S, d)
     last = lax.dynamic_index_in_dim(hidden[0], length - 1, axis=0,
                                     keepdims=False)      # (d,)
@@ -479,7 +526,6 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
     their page-table row at a scratch page — the math still runs, the
     writes land somewhere harmless, and the logits are ignored.
     """
-    assert cfg.n_experts == 0, "serving covers the dense configuration"
     b, pages_per_slot = page_tables.shape
     page_size = kv["k"].shape[2]
     max_len = pages_per_slot * page_size
@@ -515,21 +561,36 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
         x = x + jnp.einsum("be,ed->bd", o.reshape(b, -1),
                            lp["wo"].astype(x.dtype))
         h = _rmsnorm(x, lp["ln2"])
-        u = jax.nn.gelu(jnp.einsum("bd,df->bf", h,
-                                   lp["w1"].astype(x.dtype)))
-        x = x + jnp.einsum("bf,fd->bd", u, lp["w2"].astype(x.dtype))
+        if cfg.n_experts > 0:
+            x = x + _moe_mlp_serving(cfg, lp, h)
+        else:
+            u = jax.nn.gelu(jnp.einsum("bd,df->bf", h,
+                                       lp["w1"].astype(x.dtype)))
+            x = x + jnp.einsum("bf,fd->bd", u, lp["w2"].astype(x.dtype))
     hidden = _rmsnorm(x, params["final_norm"])           # (B, d)
     logits = jnp.einsum("bd,vd->bv", hidden.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
     return logits, kv
 
 
+def _mlp_flops_per_token(cfg: TransformerConfig) -> float:
+    """Per-token per-layer MLP matmul-FLOPs: dense 4*d*ff; MoE routes
+    top_k experts per token (top_k * 4*d*ff) plus the 2*d*E gate."""
+    d, ff = cfg.d_model, cfg.d_ff
+    if cfg.n_experts > 0:
+        return cfg.top_k * 4.0 * d * ff + 2.0 * d * cfg.n_experts
+    return 4.0 * d * ff
+
+
 def decode_flops_per_token(cfg: TransformerConfig, context: int) -> float:
     """Matmul-FLOPs for one decode step of one sequence at the given
     context size — the serving bench's audited accounting (projections
-    + vocab head + the query-against-context attention)."""
-    d, ff, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
-    dense = L * (8.0 * d * d + 4.0 * d * ff) + 2.0 * d * v
+    + vocab head + the query-against-context attention).  MoE configs
+    count only the routed experts (top_k of E), not the all-experts
+    einsum the serving kernel evaluates — the accounting tracks the
+    algorithmic cost expert-parallel execution pays per token."""
+    d, L, v = cfg.d_model, cfg.n_layers, cfg.vocab_size
+    dense = L * (8.0 * d * d + _mlp_flops_per_token(cfg)) + 2.0 * d * v
     attn = L * 4.0 * context * d
     return dense + attn
 
@@ -541,9 +602,10 @@ def train_flops_per_seq(cfg: TransformerConfig) -> float:
     reports use.  Dense per token 8d^2 (qkv+proj) + 4*d*ff (mlp) per
     layer + 2dV vocab head; causal attention 2*S^2*d per layer per seq
     (half the bidirectional 4*S^2*d — the mask zeroes the upper
-    triangle)."""
-    d, ff, L, s, v = (cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.seq_len,
-                      cfg.vocab_size)
-    dense = s * (L * (8.0 * d * d + 4.0 * d * ff) + 2.0 * d * v)
+    triangle).  MoE configs count the routed top_k experts + gate per
+    token (``_mlp_flops_per_token``)."""
+    d, L, s, v = (cfg.d_model, cfg.n_layers, cfg.seq_len,
+                  cfg.vocab_size)
+    dense = s * (L * (8.0 * d * d + _mlp_flops_per_token(cfg)) + 2.0 * d * v)
     attn = L * 2.0 * s * s * d
     return 3.0 * (dense + attn)

@@ -4,27 +4,53 @@ token routing over a mesh axis.
 The reference's only layout-shuffling primitive is alltoall with uneven
 splits (operations.cc:1136-1198, SURVEY.md §2.3 "the only primitive that
 would serve EP/SP-style layouts").  TPU-native, expert parallelism is a
-first-class layer: top-1 gating with capacity, dispatch einsum into a
+first-class layer: top-k gating with capacity, dispatch einsum into a
 (experts, capacity, d) buffer — static shapes so XLA can tile the MXU — and
 two ``lax.all_to_all`` exchanges riding ICI.  Dropped tokens (over capacity)
 pass through on the residual path, standard Switch Transformer semantics.
+
+Wire format: the dispatch/combine exchanges optionally ride the EQuARX
+block-scaled int8/int4 wire from ``ops/quantization.py`` — each destination
+rank's chunk is quantized independently (payload + one fp32 scale per
+block travel as two all_to_alls), dequantized to fp32 on arrival.  The
+combine einsum always accumulates in fp32; the wire dtype is never the
+accumulation dtype (the module-wide contract of ops/quantization.py).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
 from ..compat import axis_size
+from ..ops.quantization import QuantSpec, dequantize, quantize, wire_bytes
 
 
 class MoEParams(NamedTuple):
     gate: jax.Array    # (d_model, n_experts_total) — replicated
     w_in: jax.Array    # (n_local, d_model, d_ff)   — sharded over expert axis
     w_out: jax.Array   # (n_local, d_ff, d_model)   — sharded over expert axis
+
+
+class RoutingInfo(NamedTuple):
+    """Static-shape routing decision for one batch of local tokens."""
+    dispatch: jax.Array   # (T, E, C) f32 in {0, 1} — token t → expert e slot c
+    combine: jax.Array    # (T, E, C) f32 — dispatch weighted by gate prob
+    aux_loss: jax.Array   # scalar f32 — load-balancing auxiliary loss
+    dropped: jax.Array    # scalar f32 — (token, route) slots over capacity
+    capacity: int         # static per-expert slot count
+
+
+class MoEStats(NamedTuple):
+    """Per-call accounting returned by ``moe_layer(..., return_stats=True)``."""
+    aux_loss: jax.Array   # scalar f32
+    dropped: jax.Array    # scalar f32 — dropped (token, route) assignments
+    routed: jax.Array     # scalar f32 — total (token, route) assignments (T*k)
+    capacity: int
 
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts_total: int,
@@ -42,9 +68,102 @@ def init_moe_params(key, d_model: int, d_ff: int, n_experts_total: int,
     )
 
 
+def expert_capacity(tokens: int, n_experts: int, capacity_factor: float,
+                    top_k: int = 1) -> int:
+    """Per-expert slot count: ``ceil(tokens * top_k / n_experts * factor)``,
+    clamped to at least 1 so a small ``capacity_factor`` (or tiny microbatch)
+    can never round the buffer to zero slots and drop every token."""
+    cap = int(math.ceil(tokens * top_k / n_experts * capacity_factor))
+    return max(1, cap)
+
+
+def top_k_routing(logits: jax.Array, capacity: int,
+                  top_k: int = 1) -> RoutingInfo:
+    """Top-k token→expert routing with capacity and drop accounting.
+
+    Args:
+      logits: (T, E) gating logits (any float dtype; softmax runs in fp32).
+      capacity: static per-expert slot count (see :func:`expert_capacity`).
+      top_k: routes per token.  Slots are filled greedily in gate-prob
+        order; each route's combine weight is its raw softmax prob (the
+        ``top_k=1`` case is exactly Switch Transformer semantics).
+
+    Expert positions are assigned in token order, k-th choices after all
+    (k-1)-th choices — an expert that overflows on earlier choices drops
+    later ones, and the dropped count includes both.
+    """
+    t, e = logits.shape
+    if top_k < 1 or top_k > e:
+        raise ValueError(f"top_k must be in [1, {e}], got {top_k}")
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_p, top_i = lax.top_k(probs, top_k)                       # (T, k)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)    # slots claimed so far per expert
+    kept = jnp.float32(0.0)
+    for j in range(top_k):
+        onehot = jax.nn.one_hot(top_i[:, j], e, dtype=jnp.float32)  # (T, E)
+        position = jnp.cumsum(onehot, axis=0) - 1.0 + counts[None, :]
+        keep = (position < capacity) & (onehot > 0)                 # (T, E)
+        pos_cap = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                                 dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + pos_cap
+        combine = combine + pos_cap * top_p[:, j][:, None, None]
+        kept = kept + jnp.sum(keep.astype(jnp.float32))
+        counts = counts + jnp.sum(onehot, axis=0)
+
+    routed = jnp.float32(t * top_k)
+    dropped = routed - kept
+    # GShard/Switch load-balancing loss: fraction-of-routes per expert
+    # (pre-drop, so overflow pressure is visible) × mean gate prob, scaled
+    # by E so a perfectly uniform router scores 1.0.
+    frac = counts / routed
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.float32(e) * jnp.sum(frac * mean_prob)
+    return RoutingInfo(dispatch=dispatch, combine=combine, aux_loss=aux,
+                       dropped=dropped, capacity=capacity)
+
+
+def _all_to_all_wire(v: jax.Array, axis_name: str,
+                     quant: Optional[QuantSpec]) -> jax.Array:
+    """Exchange rows of ``v`` (leading dim = mesh axis size) over
+    ``axis_name``, optionally on the block-scaled quantized wire.
+
+    Each destination's chunk ``v[p]`` is quantized independently so the
+    receiver can dequantize without cross-rank metadata: the int8/int4
+    payload and the fp32 per-block scales travel as two all_to_alls —
+    exactly the EQuARX first-pass wire.  Output is fp32.
+    """
+    if quant is None:
+        return lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    row_elems = int(v[0].size)
+    row_shape = v.shape[1:]
+    q, s = jax.vmap(lambda row: quantize(row, quant))(v)
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    return jax.vmap(lambda qi, si: dequantize(qi, si, quant, row_elems,
+                                              row_shape, jnp.float32))(q, s)
+
+
+def dispatch_wire_bytes(ep: int, n_local: int, capacity: int, d_model: int,
+                        quant: Optional[QuantSpec] = None) -> int:
+    """Analytic bytes one member puts on the wire for ONE dispatch (or
+    combine) all_to_all.  Quantization is per destination chunk, so the
+    quantized wire is ``ep`` independent payload+scales rows."""
+    chunk = n_local * capacity * d_model
+    if quant is None:
+        return 4 * ep * chunk
+    return ep * wire_bytes(chunk, quant)
+
+
 def moe_layer(params: MoEParams, x: jax.Array, axis_name: str,
               capacity_factor: float = 1.25,
-              activation: Callable = jax.nn.gelu) -> jax.Array:
+              activation: Callable = jax.nn.gelu,
+              top_k: int = 1,
+              quant: Optional[QuantSpec] = None,
+              return_stats: bool = False):
     """Apply an expert-parallel MoE MLP to local tokens.
 
     Args:
@@ -52,38 +171,32 @@ def moe_layer(params: MoEParams, x: jax.Array, axis_name: str,
       x: (tokens, d_model) local token activations.
       axis_name: the expert-parallel mesh axis (size P; total experts
         E = P * n_local).
+      capacity_factor: slack over the uniform-routing slot count; capacity
+        is clamped to >= 1 (see :func:`expert_capacity`).
+      top_k: routes per token (1 = Switch semantics, the default).
+      quant: optional block-scaled wire format for the two all_to_all
+        exchanges; compute and combine stay fp32.
+      return_stats: also return :class:`MoEStats` (aux loss, drop counts).
+
     Returns:
       (tokens, d_model) combined expert outputs (zeros for dropped tokens —
-      add the residual in the caller).
+      add the residual in the caller), or ``(out, MoEStats)`` when
+      ``return_stats`` is set.
     """
     ep = axis_size(axis_name)
     t, d = x.shape
     n_local = params.w_in.shape[0]
     n_experts = ep * n_local
-    capacity = max(1, int(math.ceil(t / n_experts * capacity_factor)))
+    capacity = expert_capacity(t, n_experts, capacity_factor, top_k)
 
-    # --- top-1 gating with capacity ------------------------------------
     logits = jnp.einsum("td,de->te", x, params.gate)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                      # (T,)
-    gate_prob = jnp.take_along_axis(probs, expert_idx[:, None],
-                                    axis=-1)[:, 0]               # (T,)
-    onehot = jax.nn.one_hot(expert_idx, n_experts,
-                            dtype=jnp.float32)                   # (T, E)
-    position = jnp.einsum("te,te->te", jnp.cumsum(onehot, axis=0) - 1.0,
-                          onehot)
-    keep = (position < capacity) & (onehot > 0)                  # (T, E)
-    pos_cap = jax.nn.one_hot(position.astype(jnp.int32), capacity,
-                             dtype=jnp.float32) * keep[..., None]
-    dispatch = pos_cap                                            # (T, E, C)
-    combine = dispatch * gate_prob[:, None, None]                 # (T, E, C)
+    route = top_k_routing(logits, capacity, top_k)
 
     # --- dispatch: (T,E,C) x (T,d) -> (E,C,d), exchange over experts ----
-    x_send = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    x_send = jnp.einsum("tec,td->ecd", route.dispatch, x.astype(jnp.float32))
     x_send = x_send.reshape(ep, n_local, capacity, d)
     # all_to_all: dim0 indexes destination rank before, source rank after.
-    x_recv = lax.all_to_all(x_send, axis_name, split_axis=0, concat_axis=0,
-                            tiled=False)                          # (P,L,C,d)
+    x_recv = _all_to_all_wire(x_send, axis_name, quant)           # (P,L,C,d)
     tokens = x_recv.transpose(1, 0, 2, 3).reshape(
         n_local, ep * capacity, d)                                # (L,P*C,d)
 
@@ -92,13 +205,17 @@ def moe_layer(params: MoEParams, x: jax.Array, axis_name: str,
                               params.w_in.astype(jnp.float32)))
     y = jnp.einsum("lcf,lfd->lcd", h, params.w_out.astype(jnp.float32))
 
-    # --- return route: reverse the exchange, combine ---------------------
+    # --- return route: reverse the exchange, combine (fp32 accumulate) --
     y = y.reshape(n_local, ep, capacity, d).transpose(1, 0, 2, 3)
-    y_back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
-                            tiled=False)                          # (P,L,C,d)
+    y_back = _all_to_all_wire(y, axis_name, quant)                # (P,L,C,d)
     y_back = y_back.reshape(n_experts, capacity, d)
-    out = jnp.einsum("tec,ecd->td", combine, y_back)
-    return out.astype(x.dtype)
+    out = jnp.einsum("tec,ecd->td", route.combine, y_back)
+    out = out.astype(x.dtype)
+    if not return_stats:
+        return out
+    stats = MoEStats(aux_loss=route.aux_loss, dropped=route.dropped,
+                     routed=jnp.float32(t * top_k), capacity=capacity)
+    return out, stats
 
 
 def moe_load_balancing_loss(x: jax.Array, gate: jax.Array,

@@ -170,7 +170,12 @@ class DecodeEngine:
                  params_tag: Any = "cold"):
         from ..core.config import Config, get_int
         import jax
-        assert cfg.n_experts == 0, "serving covers the dense configuration"
+        # MoE configs (cfg.n_experts > 0) serve through the same two
+        # entry points: tfm.prefill / tfm.decode_step route per token
+        # at inference and evaluate experts via all-experts einsums
+        # whose expert dim partitions over an ``ep`` mesh axis when the
+        # caller places w_in/w_out with a NamedSharding over experts —
+        # expert weights stay sharded through every decode_step.
         self.cfg = cfg
         # Same clamps Config.from_env applies: a garbage env knob must
         # not zero-divide the engine (these read the raw env so an
