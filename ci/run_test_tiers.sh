@@ -76,6 +76,12 @@ TIER_FAST=(
   # hot-swap bit-parity, overload shed, and the train→serve handoff
   # drill (`bench.py --bench serving` measures the batching win).
   test_serving.py
+  # Production-scale serving (ISSUE 18): radix prefix cache refcount
+  # lifecycle + bit-identity drills, chunked prefill, speculative
+  # acceptance identity/exactness, policy aging + prefill-budget
+  # goldens, and the KV-page migration codec + token-for-token handoff
+  # (`bench.py --bench serving` grows the four matching arms).
+  test_serving_scale.py
   test_transformer.py
   # Closed-loop autotuning drill (ISSUE 12): injected comm regression →
   # drift → bounded re-tune → regression-gated rollback → resolution in

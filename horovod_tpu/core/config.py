@@ -165,6 +165,13 @@ SERVING_AUTOSCALE = "SERVING_AUTOSCALE"        # replica autoscaler on/off
 SERVING_TARGET_QUEUE = "SERVING_TARGET_QUEUE"  # queued reqs/replica target
 SERVING_SLO_TTFT_S = "SERVING_SLO_TTFT_S"      # TTFT target; 0 = none
 SERVING_SCALE_COOLDOWN_S = "SERVING_SCALE_COOLDOWN_S"  # resize hysteresis
+# Production-scale serving (ISSUE 18): radix prefix cache, chunked
+# prefill, speculative decoding, disaggregated prefill/decode.
+SERVING_PREFIX_CACHE = "SERVING_PREFIX_CACHE"  # radix KV prefix cache on/off
+SERVING_PREFILL_CHUNK = "SERVING_PREFILL_CHUNK"  # prefill tokens/iter; 0 = all
+SERVING_AGING_S = "SERVING_AGING_S"            # page-reservation aging; 0 = off
+SERVING_MIGRATE_BITS = "SERVING_MIGRATE_BITS"  # KV wire quant: 0 = fp32; 8 | 4
+SPEC_K = "SPEC_K"                              # draft tokens/round; 0 = off
 # Third mesh dimensions (parallel/moe.py, parallel/pipeline.py): MoE
 # routing geometry and the pipeline schedule.  Single-sourced here —
 # models read these through Config/the getters, never os.environ
@@ -385,6 +392,16 @@ class Config:
     serving_target_queue: float = 4.0
     serving_slo_ttft_s: float = 0.0
     serving_scale_cooldown_s: float = 10.0
+    # Production-scale serving: the radix prefix cache rides every
+    # admission by default (it only ever SAVES prefill work); chunked
+    # prefill, reservation aging, and speculation are opt-in; the
+    # KV-migration wire int8-quantizes by default (~3.9x smaller,
+    # block-scaled — set 0 for the bit-exact fp32 wire).
+    serving_prefix_cache: bool = True
+    serving_prefill_chunk: int = 0    # prompt tokens/iteration; 0 = all
+    serving_aging_s: float = 0.0      # page-reservation aging; 0 = off
+    serving_migrate_bits: int = 8     # 0 = fp32 wire; 8 | 4
+    spec_k: int = 0                   # draft tokens/round; 0 = off
     # MoE / pipeline geometry: experts routed per token, dispatch-
     # buffer headroom over the even share, the optional block-scaled
     # quantized dispatch wire (0 = fp32; 8/4 ride ops/quantization.py),
@@ -554,6 +571,15 @@ class Config:
             SERVING_SLO_TTFT_S, cfg.serving_slo_ttft_s))
         cfg.serving_scale_cooldown_s = max(0.0, get_float(
             SERVING_SCALE_COOLDOWN_S, cfg.serving_scale_cooldown_s))
+        cfg.serving_prefix_cache = get_bool(SERVING_PREFIX_CACHE,
+                                            cfg.serving_prefix_cache)
+        cfg.serving_prefill_chunk = max(0, get_int(
+            SERVING_PREFILL_CHUNK, cfg.serving_prefill_chunk))
+        cfg.serving_aging_s = max(0.0, get_float(
+            SERVING_AGING_S, cfg.serving_aging_s))
+        mbits = get_int(SERVING_MIGRATE_BITS, cfg.serving_migrate_bits)
+        cfg.serving_migrate_bits = mbits if mbits in (0, 4, 8) else 8
+        cfg.spec_k = min(32, max(0, get_int(SPEC_K, cfg.spec_k)))
         cfg.moe_top_k = max(1, get_int(MOE_TOP_K, cfg.moe_top_k))
         cfg.moe_capacity_factor = max(0.0, get_float(
             MOE_CAPACITY_FACTOR, cfg.moe_capacity_factor))

@@ -74,6 +74,11 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     # colocated replica; admits/sheds corroborate load pressure.
     "serving.swap": "serving", "serving.autoscale": "serving",
     "serving.admit": "serving", "serving.shed": "serving",
+    # Production-scale serving (ISSUE 18): per-request cache/prefill/
+    # speculation chatter plus the KV-page migration moment (a
+    # migration IS a discrete placement change — suspect-eligible).
+    "serving.prefix_hit": "serving", "serving.chunk": "serving",
+    "serving.speculate": "serving", "serving.migrate": "serving",
     # Prefix families (trailing "."): any kind under these namespaces
     # classifies even when it has no exact entry — subsystems grow new
     # event kinds (checkpoint.extract.*, recovery.restore.miss, ...)
@@ -107,8 +112,11 @@ _CORROBORATING = {"data.wait", "elastic.commit", "checkpoint.save.begin",
                   "checkpoint.save.commit", "recovery.replicate",
                   "overlap.plan",
                   # Per-request serving chatter: evidence of load, not
-                  # a discrete config change (swap/autoscale/shed are).
-                  "serving.admit", "serving.retire"}
+                  # a discrete config change (swap/autoscale/shed are;
+                  # so is serving.migrate — a placement change).
+                  "serving.admit", "serving.retire",
+                  "serving.prefix_hit", "serving.chunk",
+                  "serving.speculate"}
 
 _last_report: Optional[dict] = None
 _last_lock = threading.Lock()

@@ -573,6 +573,119 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
     return logits, kv
 
 
+def decode_verify(cfg: TransformerConfig, params: Dict[str, Any],
+                  tokens: jax.Array, lengths: jax.Array,
+                  kv: Dict[str, jax.Array],
+                  page_tables: jax.Array
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance every slot by K tokens in ONE forward — the verify-k /
+    chunked-prefill kernel.
+
+    tokens: (B, K) int32 — token j of slot b is written at position
+    ``lengths[b] + j`` (its K/V land in the page the slot's table maps
+    that position to).  lengths: (B,) int32 context sizes BEFORE the
+    call.  Returns (fp32 logits (B, K, V), updated kv) where
+    ``logits[b, j]`` predicts the token AFTER ``tokens[b, j]`` —
+    position ``lengths[b] + j`` attends every cached position ``<=``
+    itself, so K = 1 computes exactly :func:`decode_step`'s math.
+
+    Three callers share this one entry point (docs/serving.md):
+
+    * **chunked prefill** — a prompt chunk at offset ``lengths[b]``
+      interleaves into decode iterations instead of stalling them;
+    * **prefix-cache suffix prefill** — ``lengths[b]`` > 0 names the
+      cached-prefix length, only the suffix recomputes;
+    * **speculative verify** — K = k+1 draft proposals are scored by
+      the target in one batched forward.
+
+    Padding/garbage contract: positions past a caller's valid chunk
+    (padded tail, rejected speculative proposals) DO write K/V, but
+    every such position is ≥ the slot's post-call valid length, so it
+    is masked out of every later read until the position is rewritten
+    with real content.  Positions at or past the table's extent route
+    their writes to scratch page 0.
+    """
+    b, kq = tokens.shape
+    pages_per_slot = page_tables.shape[1]
+    page_size = kv["k"].shape[2]
+    max_len = pages_per_slot * page_size
+    hd = cfg.head_dim
+    scale = 1.0 / (hd ** 0.5)
+    pos = lengths[:, None] + jnp.arange(kq, dtype=lengths.dtype)[None]
+    pos_c = jnp.minimum(pos, max_len - 1)
+    write_page = jnp.take_along_axis(page_tables, pos_c // page_size,
+                                     axis=1)
+    write_page = jnp.where(pos < max_len, write_page, 0)
+    write_off = pos_c % page_size
+    x = (params["embed"][tokens]
+         + params["pos"][jnp.minimum(pos, cfg.seq_len - 1)]
+         ).astype(cfg.dtype)                              # (B, K, d)
+    layers = _flat_layers(params)
+    k_pos = jnp.arange(max_len)
+    mask = k_pos[None, None, :] <= pos[:, :, None]        # (B, K, max_len)
+    for l in range(cfg.n_layers):
+        lp = {k: v[l] for k, v in layers.items()}
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = jnp.einsum("bkd,de->bke", h, lp["wqkv"].astype(x.dtype))
+        qkv = qkv.reshape(b, kq, cfg.n_heads, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        kv["k"] = kv["k"].at[l, write_page, write_off].set(k)
+        kv["v"] = kv["v"].at[l, write_page, write_off].set(v)
+        # Gather AFTER the write: the chunk attends to itself, with the
+        # per-query causal mask keeping later chunk positions out.
+        k_ctx = kv["k"][l][page_tables].reshape(b, max_len, cfg.n_heads,
+                                                hd)
+        v_ctx = kv["v"][l][page_tables].reshape(b, max_len, cfg.n_heads,
+                                                hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k_ctx.astype(jnp.float32)) * scale
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                       v_ctx.astype(jnp.float32)).astype(x.dtype)
+        x = x + jnp.einsum("bke,ed->bkd", o.reshape(b, kq, -1),
+                           lp["wo"].astype(x.dtype))
+        h = _rmsnorm(x, lp["ln2"])
+        if cfg.n_experts > 0:
+            y = _moe_mlp_serving(cfg, lp, h.reshape(b * kq, -1))
+            x = x + y.reshape(b, kq, -1)
+        else:
+            u = jax.nn.gelu(jnp.einsum("bkd,df->bkf", h,
+                                       lp["w1"].astype(x.dtype)))
+            x = x + jnp.einsum("bkf,fd->bkd", u,
+                               lp["w2"].astype(x.dtype))
+    hidden = _rmsnorm(x, params["final_norm"])           # (B, K, d)
+    logits = jnp.einsum("bkd,vd->bkv", hidden.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, kv
+
+
+def draft_config(cfg: TransformerConfig, n_layers: int) -> TransformerConfig:
+    """The speculative draft's config: the target's geometry with a
+    layer-prefix depth (same vocab and positional table, so the draft
+    and target share token/position spaces by construction)."""
+    if not (0 < n_layers <= cfg.n_layers):
+        raise ValueError(
+            f"draft n_layers {n_layers} not in 1..{cfg.n_layers}")
+    return cfg._replace(n_layers=n_layers, remat=False)
+
+
+def draft_params_from(params: Dict[str, Any],
+                      n_layers: int) -> Dict[str, Any]:
+    """Slice a target parameter tree down to its first ``n_layers``
+    layers (pp-restacked to one stage) for :func:`draft_config` —
+    embeddings, positional table and final norm are SHARED (no copy),
+    so a layer-prefix draft costs only the sliced layer stacks."""
+    flat = {k: v.reshape((-1,) + v.shape[2:])
+            for k, v in params["layers"].items()}
+    total = next(iter(flat.values())).shape[0]
+    if not (0 < n_layers <= total):
+        raise ValueError(f"draft n_layers {n_layers} not in 1..{total}")
+    out = dict(params)
+    out["layers"] = {k: v[:n_layers][None] for k, v in flat.items()}
+    return out
+
+
 def _mlp_flops_per_token(cfg: TransformerConfig) -> float:
     """Per-token per-layer MLP matmul-FLOPs: dense 4*d*ff; MoE routes
     top_k experts per token (top_k * 4*d*ff) plus the 2*d*E gate."""

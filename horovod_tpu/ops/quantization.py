@@ -173,6 +173,78 @@ def qdq_host(x, spec: QuantSpec):
 
 
 # ---------------------------------------------------------------------------
+# KV-page migration codec (numpy — never wakes the accelerator backend)
+# ---------------------------------------------------------------------------
+#
+# The disaggregated-serving wire format (serving/disagg.py): a host KV
+# page tensor quantizes to the same per-block absmax int8/int4 layout
+# the collective engine ships, serialized to raw bytes for the replica
+# transport.  ``spec=None`` selects a lossless fp32 wire (the exactness
+# arm of the migration drill).  ``page_wire_bytes`` is the audited
+# accounting the bench discloses.
+
+
+def encode_pages(x, spec: Optional[QuantSpec]):
+    """Serialize a host array for the migration wire.
+
+    Returns ``(payload, scales)`` bytes: block-scaled int8/int4 under
+    ``spec``, or (fp32 little-endian, b"") when ``spec`` is None.
+    Pure numpy — safe on the serving host path, where touching jnp
+    would wake the accelerator backend mid-decode."""
+    import numpy as np
+    arr = np.asarray(x)
+    if spec is None:
+        return np.ascontiguousarray(
+            arr.astype(np.float32)).tobytes(), b""
+    qmax = _qmax(spec.bits)
+    flat = np.ravel(arr).astype(np.float32)
+    pad = (-flat.size) % spec.block
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, spec.block)
+    absmax = np.max(np.abs(blocks), axis=-1)
+    scales = np.where(absmax > 0, absmax / qmax, 1.0).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -qmax, qmax)
+    q = q.astype(np.int8)
+    if spec.bits == 4:
+        u = q.astype(np.uint8) & 0xF
+        q = (u[..., 0::2] | (u[..., 1::2] << 4)).astype(np.int8)
+    return q.tobytes(), scales.tobytes()
+
+
+def decode_pages(payload: bytes, scales: bytes, spec: Optional[QuantSpec],
+                 n: int, shape=None):
+    """Inverse of :func:`encode_pages` → fp32 numpy array of the first
+    ``n`` elements (optionally reshaped).  The caller casts into the
+    destination pool's compute dtype when writing the pages."""
+    import numpy as np
+    if spec is None:
+        x = np.frombuffer(payload, dtype=np.float32)[:n].copy()
+        return x.reshape(shape) if shape is not None else x
+    s = np.frombuffer(scales, dtype=np.float32)
+    q = np.frombuffer(payload, dtype=np.int8)
+    if spec.bits == 4:
+        u = q.view(np.uint8)
+        nib = np.stack([(u & 0xF), (u >> 4)], axis=-1).reshape(-1)
+        nib = nib.astype(np.int16)
+        q = np.where(nib >= 8, nib - 16, nib).astype(np.int8)
+    x = (q.reshape(-1, spec.block).astype(np.float32)
+         * s[:, None]).reshape(-1)[:n]
+    return x.reshape(shape) if shape is not None else x
+
+
+def page_wire_bytes(n: int, spec: Optional[QuantSpec]) -> int:
+    """Bytes :func:`encode_pages` puts on the wire for ``n`` elements
+    (block padding included — unlike :func:`wire_bytes`, this is the
+    exact serialized size, the figure the migration bench discloses)."""
+    if spec is None:
+        return 4 * n
+    nblocks = math.ceil(n / spec.block)
+    per_block = spec.block if spec.bits == 8 else spec.block // 2
+    return nblocks * per_block + 4 * nblocks
+
+
+# ---------------------------------------------------------------------------
 # compiled-path schedules (inside jit/shard_map over a named mesh axis)
 # ---------------------------------------------------------------------------
 

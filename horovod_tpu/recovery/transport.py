@@ -11,6 +11,13 @@ idiom), published to the rendezvous KV as ``recovery/replica_addr_<rank>``.
   (operator tooling / targeted fetches; the elastic peer-restore path
   itself gathers over the collective plane, which every member already
   speaks).
+* ``PUT /recovery/kv/<key>`` / ``GET /recovery/kv/<key>`` — one-shot
+  mailbox for serving-plane KV-page migration bundles (disaggregated
+  prefill/decode, ``serving/disagg.py``): the prefill replica PUTs an
+  encoded bundle, the decode replica GETs it — the GET *pops* (a
+  bundle is adopted exactly once), and the mailbox is bounded
+  (:data:`_KV_MAILBOX_CAP` bundles, oldest dropped loudly) so a
+  crashed consumer cannot OOM the producer's transport.
 * ``GET /healthz`` — liveness.
 
 Requests are HMAC-gated with the launch secret exactly like the debug
@@ -35,6 +42,14 @@ from .store import store as _store
 from .chaos import chaos
 
 _SCOPE = "recovery"
+
+# KV-migration mailbox: key -> encoded bundle, insertion-ordered so
+# overflow drops the OLDEST (its producer will retry or time out
+# loudly; silently dropping the newest would starve fresh handoffs
+# behind abandoned ones).
+_KV_MAILBOX_CAP = 64
+_kv_mailbox: "dict[str, bytes]" = {}
+_kv_lock = threading.Lock()
 
 
 def _authorized(headers, method: str, key: str,
@@ -90,6 +105,22 @@ class _RecoveryHandler(BaseHTTPRequestHandler):
             except ValueError:
                 return self._send(400)
             return self._send(200)
+        if parts[:2] == [_SCOPE, "kv"] and len(parts) == 3:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = self.rfile.read(length)
+            if not _authorized(self.headers, "PUT",
+                               f"kv/{parts[2]}", payload):
+                return self._send(403)
+            with _kv_lock:
+                while len(_kv_mailbox) >= _KV_MAILBOX_CAP:
+                    dropped = next(iter(_kv_mailbox))
+                    del _kv_mailbox[dropped]
+                    from ..utils import logging as log
+                    log.warning(
+                        "recovery: kv mailbox full — dropped oldest "
+                        "bundle %s", dropped)
+                _kv_mailbox[parts[2]] = payload
+            return self._send(200)
         self._send(404)
 
     def do_GET(self):
@@ -108,6 +139,14 @@ class _RecoveryHandler(BaseHTTPRequestHandler):
             if entry is None or not entry.sealed:
                 return self._send(404)
             return self._send(200, entry_to_bytes(entry))
+        if parts[:2] == [_SCOPE, "kv"] and len(parts) == 3:
+            if not _authorized(self.headers, "GET", f"kv/{parts[2]}"):
+                return self._send(403)
+            with _kv_lock:
+                blob = _kv_mailbox.pop(parts[2], None)
+            if blob is None:
+                return self._send(404)
+            return self._send(200, blob)
         self._send(404)
 
 
@@ -268,4 +307,29 @@ def fetch_replica(addr: str, key: str, rank: int,
                                   name="recovery.fetch")
         return entry_from_bytes(body)
     except (urllib.error.HTTPError, OSError, ValueError):
+        return None
+
+
+def push_kv(addr: str, key: str, blob: bytes,
+            timeout: float = 10.0) -> bool:
+    """PUT one KV-migration bundle into a peer's one-shot mailbox
+    (serving-plane page handoff).  Rides the same signed request +
+    bounded-retry ladder as replica pushes."""
+    return _request(addr, f"/{_SCOPE}/kv/{key}", "PUT", f"kv/{key}",
+                    body=blob, timeout=timeout)
+
+
+def fetch_kv(addr: str, key: str,
+             timeout: float = 10.0) -> Optional[bytes]:
+    """GET (and consume — the server pops) one KV-migration bundle;
+    None when absent or unreachable."""
+    import urllib.error
+    import urllib.request
+    from .. import net as _net
+    req = urllib.request.Request(f"http://{addr}/{_SCOPE}/kv/{key}")
+    _sign(req, "GET", f"kv/{key}")
+    try:
+        return _net.request_bytes(req, timeout=timeout,
+                                  name="recovery.fetch_kv")
+    except (urllib.error.HTTPError, OSError):
         return None

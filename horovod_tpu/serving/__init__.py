@@ -12,8 +12,8 @@ onto the same fleet the trainer owns.  One replica is
 * a **request plane** (``server.py`` + ``policy.py``): HMAC-gated
   ``POST /serve/generate`` with streaming token responses, a bounded
   admission queue, and a pure deterministic admission policy
-  (priority, per-tenant fair share, deadline-aware ordering, loud
-  shed-on-overload);
+  (priority, per-tenant fair share, deadline-aware ordering,
+  page-reservation aging, loud shed-on-overload);
 * the **train→serve loop** (``service.py``): weights cold-load from a
   committed training checkpoint over the engine's streaming read path,
   and a watcher hot-swaps newer committed steps between decode
@@ -22,22 +22,45 @@ onto the same fleet the trainer owns.  One replica is
   drives ``ElasticDriver.request_resize``; the fleet's existing
   grow/preemption machinery backfills freed slots to training jobs.
 
+Production-scale serving (ISSUE 18) layers on the same geometry:
+
+* a **radix prefix cache** (``prefix.py``): prompts sharing a prefix
+  attach to refcounted cached KV pages (copy-on-write at divergence)
+  and prefill only their suffix — greedy outputs are bit-identical
+  cache-on vs cache-off;
+* **chunked prefill**: ``SERVING_PREFILL_CHUNK`` bounds prompt tokens
+  per iteration so long prompts interleave into decode instead of
+  stalling co-batched TTFT;
+* **speculative decoding** (``speculative.py``): a draft model
+  proposes k tokens per round, the flagship verifies them in one
+  batched forward — exact under greedy, distribution-preserving under
+  seeded sampling;
+* **disaggregated prefill/decode** (``disagg.py``): KV-page migration
+  between prefill and decode replica pools over the recovery
+  transport, pages int8-quantized on the wire.
+
 See docs/serving.md.  Load clients: ``python -m
 horovod_tpu.serving.submit`` and ``examples/serving_client.py``.
 """
 
 from .autoscale import Autoscaler, desired_np
+from .disagg import decode_bundle, encode_bundle, migrate, receive, send
 from .engine import DecodeEngine, Event, Request
 from .loadgen import drive, synthetic_workload
 from .policy import RequestView, plan
+from .prefix import RadixPrefixCache
 from .server import ServingServer
 from .service import CheckpointWatcher, ServingService, load_params
+from .speculative import DraftSpec
 
 __all__ = [
     "Autoscaler", "desired_np",
+    "decode_bundle", "encode_bundle", "migrate", "receive", "send",
     "DecodeEngine", "Event", "Request",
     "drive", "synthetic_workload",
     "RequestView", "plan",
+    "RadixPrefixCache",
     "ServingServer",
     "CheckpointWatcher", "ServingService", "load_params",
+    "DraftSpec",
 ]

@@ -65,7 +65,9 @@ def drive(engine: DecodeEngine,
           continuous: bool = True,
           wall_s: Optional[float] = None,
           queue_cap: int = 0,
-          on_event=None) -> Dict[str, object]:
+          on_event=None,
+          aging_s: float = 0.0,
+          prefill_budget: Optional[int] = None) -> Dict[str, object]:
     """Run one engine under an open-loop schedule until the work (or
     the wall budget) is exhausted.
 
@@ -75,6 +77,8 @@ def drive(engine: DecodeEngine,
     ``finish_s``; shed requests carry ``shed`` instead.
     """
     t0 = time.monotonic()
+    if prefill_budget is None:
+        prefill_budget = getattr(engine, "prefill_chunk", 0)
     pending = deque(sorted(schedule, key=lambda ar: (ar[0],
                                                      ar[1].submit_seq)))
     queued: List[Request] = []
@@ -101,13 +105,16 @@ def drive(engine: DecodeEngine,
             id=r.id, tenant=r.tenant, priority=r.priority,
             submit_seq=r.submit_seq, arrival_s=r.arrival_mono - t0,
             deadline_s=r.deadline_s,
-            pages_needed=r.pages_needed(engine.page_tokens))
+            pages_needed=r.pages_needed(engine.page_tokens),
+            prompt_tokens=len(r.prompt))
             for r in queued]
         decisions = P.plan(views, free, engine.free_pages(), now_s=now,
                            running=engine.running_by_tenant(),
                            queue_cap=queue_cap,
                            slot_pages=min(engine.pages_per_slot,
-                                          engine.total_pages))
+                                          engine.total_pages),
+                           aging_s=aging_s,
+                           prefill_budget=prefill_budget)
         events = []
         admitted = False
         for d in decisions:

@@ -28,12 +28,23 @@ Policy, in order:
 * **Slot assignment** — a request is admitted while a free slot AND
   its page reservation fit; a request that does not fit *waits* without
   blocking smaller requests behind it (head-of-line blocking would
-  idle slots a later request could use).  The known tradeoff: under
-  sustained small-request load a page-hungry request can wait
-  indefinitely — nothing reserves pages toward seating it.  Give such
-  requests a ``deadline_s`` (the wait is then bounded by a loud
-  deadline shed) or a dedicated replica; page-reservation aging is
-  deliberately out of scope for this plan function.
+  idle slots a later request could use).
+* **Page-reservation aging** (``aging_s`` > 0) — the bounded answer to
+  the starvation that head-of-line-free admission invites: under
+  sustained small-request load a page-hungry request could otherwise
+  wait forever.  When the FIRST selected-but-page-starved request has
+  waited at least ``aging_s``, its page reservation (up to what the
+  pool holds) is withheld from every candidate considered after it in
+  this plan — small requests stop leapfrogging it, the pool drains to
+  it as slots retire, and it seats as soon as its reservation fits.
+  Bounded deliberately: ONE aged request reserves per plan, so aging
+  can delay but never collapse throughput (``SERVING_AGING_S``).
+* **Prefill budget** (``prefill_budget`` > 0) — bounds the prompt
+  tokens admitted per plan so one burst of long prompts cannot enqueue
+  an unbounded prefill backlog ahead of the chunked-prefill loop
+  (``SERVING_PREFILL_CHUNK``); the first admission always fits (a
+  prompt longer than the whole budget must still be servable), later
+  ones wait as ``"prefill"`` until the next plan.
 """
 
 from __future__ import annotations
@@ -44,7 +55,7 @@ from typing import Dict, List, Optional, Tuple
 # Decision tuples (kind first):
 #   ("shed",  request_id, reason)   # "deadline" | "overload" | "too_large"
 #   ("admit", request_id)
-#   ("wait",  request_id, reason)   # "slots" | "pages"
+#   ("wait",  request_id, reason)   # "slots" | "pages" | "prefill"
 Decision = Tuple
 
 _INF = float("inf")
@@ -61,11 +72,14 @@ class RequestView:
     arrival_s: float = 0.0
     deadline_s: float = 0.0    # TTFT SLO in seconds; 0 = no target
     pages_needed: int = 1      # KV page reservation (prompt + output cap)
+    prompt_tokens: int = 0     # prefill cost (the prefill-budget unit)
 
 
 def plan(queued: List[RequestView], free_slots: int, free_pages: int,
          now_s: float, running: Optional[Dict[str, int]] = None,
-         queue_cap: int = 0, slot_pages: int = 0) -> List[Decision]:
+         queue_cap: int = 0, slot_pages: int = 0,
+         aging_s: float = 0.0,
+         prefill_budget: int = 0) -> List[Decision]:
     running = dict(running or {})
     decisions: List[Decision] = []
     live: List[RequestView] = []
@@ -99,6 +113,9 @@ def plan(queued: List[RequestView], free_slots: int, free_pages: int,
                 v.submit_seq)
 
     pending = list(live)
+    budget_left = prefill_budget
+    admitted_any = False
+    reserve_used = False
     while pending:
         v = min(pending, key=key)
         pending.remove(v)
@@ -107,8 +124,22 @@ def plan(queued: List[RequestView], free_slots: int, free_pages: int,
             continue
         if v.pages_needed > free_pages:
             decisions.append(("wait", v.id, "pages"))
+            if (aging_s > 0 and not reserve_used
+                    and now_s - v.arrival_s >= aging_s):
+                # Aged: withhold its reservation from everyone behind
+                # it this plan.  One reservation per plan keeps aging
+                # bounded — it ages the POOL toward one request, it
+                # does not serialize admission.
+                free_pages -= min(v.pages_needed, free_pages)
+                reserve_used = True
+            continue
+        if (prefill_budget > 0 and admitted_any
+                and budget_left < v.prompt_tokens):
+            decisions.append(("wait", v.id, "prefill"))
             continue
         decisions.append(("admit", v.id))
+        admitted_any = True
+        budget_left -= v.prompt_tokens
         free_slots -= 1
         free_pages -= v.pages_needed
         running[v.tenant] = running.get(v.tenant, 0) + 1

@@ -215,6 +215,15 @@ class ServingServer(BackgroundHTTPServer):
             get_int("SERVING_QUEUE_CAP", Config.serving_queue_cap)))
         self.continuous = continuous
         self._tick_s = tick_s
+        from ..core.config import get_float
+        # Policy planes for the plan() call: page-reservation aging
+        # and the per-plan prefill admission budget (the latter mirrors
+        # the engine's per-iteration chunk budget — one knob, two
+        # enforcement points).
+        self.aging_s = max(0.0, get_float(
+            "SERVING_AGING_S", Config.serving_aging_s))
+        self.prefill_budget = max(0, getattr(
+            engine, "prefill_chunk", 0))
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queued: List[Request] = []
@@ -343,14 +352,17 @@ class ServingServer(BackgroundHTTPServer):
             id=r.id, tenant=r.tenant, priority=r.priority,
             submit_seq=r.submit_seq, arrival_s=r.arrival_mono - t0,
             deadline_s=r.deadline_s,
-            pages_needed=r.pages_needed(self.engine.page_tokens))
+            pages_needed=r.pages_needed(self.engine.page_tokens),
+            prompt_tokens=len(r.prompt))
             for r in queued]
         decisions = P.plan(
             views, free, self.engine.free_pages(), now_s=now,
             running=self.engine.running_by_tenant(),
             queue_cap=self.queue_cap,
             slot_pages=min(self.engine.pages_per_slot,
-                           self.engine.total_pages))
+                           self.engine.total_pages),
+            aging_s=self.aging_s,
+            prefill_budget=self.prefill_budget)
         by_id = {r.id: r for r in queued}
         events = []
         for d in decisions:

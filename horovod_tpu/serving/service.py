@@ -131,6 +131,7 @@ class ServingService:
                  secret: Optional[str] = None,
                  swap_poll_s: Optional[float] = None,
                  watch: bool = True,
+                 draft_layers: int = 0,
                  **engine_kwargs):
         import jax
         self.cfg = cfg
@@ -143,6 +144,18 @@ class ServingService:
                 raise ValueError(
                     "ServingService needs params= or checkpoint_dir=")
             params, params_tag = load_params(checkpoint_dir, like)
+        if draft_layers > 0 and "draft" not in engine_kwargs:
+            # Self-drafting: a layer-prefix of the serving weights
+            # proposes SPEC_K tokens per round (exact under greedy, so
+            # this is safe to enable from a knob alone — no second
+            # checkpoint needed).  An explicit draft= kwarg wins.
+            from ..core.config import Config, get_int
+            from .speculative import DraftSpec
+            k = min(32, max(1, get_int("SPEC_K", Config.spec_k) or 4))
+            engine_kwargs["draft"] = DraftSpec(
+                cfg=tfm.draft_config(cfg, draft_layers),
+                params=tfm.draft_params_from(params, draft_layers),
+                k=k)
         self.engine = DecodeEngine(cfg, params, params_tag=params_tag,
                                    **engine_kwargs)
         self.server = ServingServer(self.engine, port=port, secret=secret)

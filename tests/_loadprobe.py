@@ -1,0 +1,63 @@
+"""Measured machine-load probe for wall-clock test deadlines.
+
+Multi-process drills (the native chaos drills in test_net_resilience,
+the 4-proc native matrix suite) size their harness deadlines against an
+idle machine; under concurrent sandbox load the drills' real work and
+the harness timeouts stretch TOGETHER, so the fix is not a bigger
+constant but a measured factor: time one spawn-context process
+round-trip (what every native drill pays per worker) and a fixed CPU
+workload, take the worse ratio against the idle-machine nominals, and
+scale every harness deadline by it.  Clamped to [1, 8] and disclosed on
+stderr so a flaking CI log shows what the machine looked like.
+
+Shared via ``import _loadprobe`` — tests/ has a conftest.py and no
+__init__.py, so pytest's rootdir insertion puts this directory on
+sys.path for every collected test module.  The measurement runs once
+per process and is cached module-globally (both suites in one pytest
+run pay for one probe).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import sys
+import time
+
+# Nominal probe costs on an idle machine (measured on this container:
+# spawn+join of a no-op process ~0.5 s, the 2M-add loop ~0.1 s).
+_NOMINAL_SPAWN_S = 0.6
+_NOMINAL_CPU_S = 0.12
+
+_LOAD_FACTOR = None
+
+
+def _probe_noop():
+    pass
+
+
+def load_factor(tag: str = "loadprobe") -> float:
+    """Per-machine deadline scale in [1, 8], measured once per process.
+    ``tag`` names the caller in the stderr disclosure."""
+    global _LOAD_FACTOR
+    if _LOAD_FACTOR is not None:
+        return _LOAD_FACTOR
+    ctx = mp.get_context("spawn")
+    t0 = time.perf_counter()
+    p = ctx.Process(target=_probe_noop)
+    p.start()
+    p.join()
+    spawn_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(2_000_000):
+        acc += i
+    cpu_s = time.perf_counter() - t0
+    factor = max(1.0, min(max(spawn_s / _NOMINAL_SPAWN_S,
+                              cpu_s / _NOMINAL_CPU_S), 8.0))
+    _LOAD_FACTOR = factor
+    sys.stderr.write(
+        f"{tag}: machine load factor {factor:.2f}x "
+        f"(spawn probe {spawn_s:.2f}s vs {_NOMINAL_SPAWN_S}s nominal, "
+        f"cpu probe {cpu_s:.2f}s vs {_NOMINAL_CPU_S}s nominal); "
+        "harness deadlines scaled accordingly\n")
+    return factor

@@ -44,6 +44,14 @@ def _worker(rank, size, port, fn_name, out_queue, env=None):
 
 
 def _run(fn_name, size=4, env=None):
+    # Harness deadlines scale by the measured machine-load factor
+    # (tests/_loadprobe.py): under concurrent sandbox load the spawned
+    # workers' real work stretches with the machine, and wall clocks
+    # sized for an idle box flake (the net_resilience drills hit this
+    # first; the 4-proc matrix sweep pays 4 spawns per case and flaked
+    # the same way).
+    import _loadprobe
+    factor = _loadprobe.load_factor("native_matrix")
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -54,11 +62,11 @@ def _run(fn_name, size=4, env=None):
         p.start()
     results = {}
     for _ in range(size):
-        rank, status, payload = q.get(timeout=120)
+        rank, status, payload = q.get(timeout=120 * factor)
         assert status == "ok", f"rank {rank}: {payload}"
         results[rank] = payload
     for p in procs:
-        p.join(timeout=30)
+        p.join(timeout=30 * factor)
         assert p.exitcode == 0
     return results
 

@@ -412,52 +412,13 @@ def _free_port():
     return port
 
 
-def _probe_noop():
-    pass
-
-
-_LOAD_FACTOR = None
-
-# Nominal probe costs on an idle machine (measured on this container:
-# spawn+join of a no-op process ~0.5 s, the 2M-add loop ~0.1 s).  The
-# drill deadlines below were sized against an idle machine too, so the
-# measured/nominal ratio is exactly the factor they need.
-_NOMINAL_SPAWN_S = 0.6
-_NOMINAL_CPU_S = 0.12
-
-
 def _load_factor():
-    """Per-machine deadline scale, measured once per module: time one
-    spawn-context process round-trip (what every native drill pays 4x)
-    and a fixed CPU workload.  Under concurrent sandbox load both
-    stretch together with the drill's real work, so scaling the
-    HARNESS deadlines by the same factor keeps the drills
-    deterministic-in-outcome instead of flaking on wall clocks sized
-    for an idle machine (PR 12 verification hit exactly that).  Clamped
-    to [1, 8] and disclosed on stderr."""
-    global _LOAD_FACTOR
-    if _LOAD_FACTOR is not None:
-        return _LOAD_FACTOR
-    ctx = mp.get_context("spawn")
-    t0 = time.perf_counter()
-    p = ctx.Process(target=_probe_noop)
-    p.start()
-    p.join()
-    spawn_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    acc = 0
-    for i in range(2_000_000):
-        acc += i
-    cpu_s = time.perf_counter() - t0
-    factor = max(1.0, min(max(spawn_s / _NOMINAL_SPAWN_S,
-                              cpu_s / _NOMINAL_CPU_S), 8.0))
-    _LOAD_FACTOR = factor
-    sys.stderr.write(
-        f"net_resilience: machine load factor {factor:.2f}x "
-        f"(spawn probe {spawn_s:.2f}s vs {_NOMINAL_SPAWN_S}s nominal, "
-        f"cpu probe {cpu_s:.2f}s vs {_NOMINAL_CPU_S}s nominal); "
-        "drill harness deadlines scaled accordingly\n")
-    return factor
+    """Measured machine-load deadline scale — shared probe in
+    tests/_loadprobe.py (PR 12 verification flaked on wall clocks
+    sized for an idle machine; the probe measures the stretch
+    instead)."""
+    import _loadprobe
+    return _loadprobe.load_factor("net_resilience")
 
 
 def _chaos_worker(rank, size, port, env, iters, out_queue):

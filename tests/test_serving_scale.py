@@ -1,0 +1,521 @@
+"""Production-scale serving: radix prefix cache, chunked prefill,
+speculative decoding, disaggregated prefill/decode (ISSUE 18).
+
+The correctness spine is exactness: greedy outputs must be
+BIT-identical with the prefix cache on vs off, with chunked prefill on
+vs off, with speculation on vs off, and token-for-token across an
+fp32-wire migration — every optimization here reshapes WHEN compute
+happens, never WHAT it computes.  Around that spine: the refcount
+lifecycle of the trie (eviction only at refcount 0, retire releases
+through the trie, full-pool admission evicts exactly the non-shared
+shortfall), the speculative acceptance identity (the emitted
+distribution IS the target distribution, integrated numerically), the
+policy's aging and prefill-budget goldens, the migration bundle codec
+(sha256-verified, quantized wire ratio disclosed), and the knob/metric
+/flight-vocabulary surface."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu.models import transformer as tfm  # noqa: E402
+from horovod_tpu.serving import disagg  # noqa: E402
+from horovod_tpu.serving import policy as P  # noqa: E402
+from horovod_tpu.serving import speculative as spec  # noqa: E402
+from horovod_tpu.serving.engine import DecodeEngine, Request  # noqa: E402
+from horovod_tpu.serving.prefix import RadixPrefixCache  # noqa: E402
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+    seq_len=64, dtype=jnp.float32, remat=False)
+PAGE = 8
+PROMPT = [5, 9, 13, 2, 7, 11, 3, 1, 6, 4, 12, 8, 10, 14, 15, 16, 17]
+N_OUT = 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG,
+                           tfm.ParallelConfig())
+
+
+def _engine(params, slots=2, **kw):
+    kw.setdefault("prefix_cache", False)
+    return DecodeEngine(CFG, params, slots=slots, page_tokens=PAGE,
+                        max_len=32, **kw)
+
+
+def _greedy(engine, prompt, n=N_OUT, rid="r", **req_kw):
+    out, done = [], False
+    evs = engine.admit(Request(id=rid, prompt=list(prompt),
+                               max_new_tokens=n, **req_kw))
+    while True:
+        for e in evs:
+            if e.request.id != rid:
+                continue
+            if e.kind == "token":
+                out.append(e.token)
+            elif e.kind == "finish":
+                done = True
+        if done:
+            return out
+        evs = engine.step()
+
+
+@pytest.fixture(scope="module")
+def ref_out(params):
+    """The no-optimizations greedy output every exactness drill
+    compares against."""
+    return _greedy(_engine(params), PROMPT)
+
+
+# ---------------------------------------------------------------------------
+# Radix trie: refcount lifecycle (pure host bookkeeping)
+# ---------------------------------------------------------------------------
+
+def test_trie_refcount_lifecycle():
+    c = RadixPrefixCache(4)
+    toks = list(range(12))
+    chunks = [tuple(toks[i:i + 4]) for i in range(0, 12, 4)]
+    nodes, dups = c.insert(None, chunks, [10, 11, 12])
+    assert [n.page for n in nodes] == [10, 11, 12] and not dups
+    assert c.evictable() == 0          # inserting slot holds the refs
+    path, partial = c.match(toks)
+    assert [n.page for n in path] == [10, 11, 12] and partial is None
+    c.acquire(path)                    # second slot pins the same path
+    assert c.release(nodes) == []      # first retires: still pinned
+    assert c.evictable() == 0
+    assert c.release(path) == []       # attached: cached, not freed
+    assert c.evictable() == 3
+    with pytest.raises(RuntimeError):  # underflow is loud
+        c.release(path)
+
+
+def test_trie_partial_match_is_cow_point():
+    c = RadixPrefixCache(4)
+    base = [1, 2, 3, 4, 5, 6, 7, 8]
+    c.insert(None, [tuple(base[:4]), tuple(base[4:])], [20, 21])
+    path, partial = c.match([1, 2, 3, 4, 5, 6, 99, 98])
+    assert [n.page for n in path] == [20]
+    node, r = partial
+    assert node.page == 21 and r == 2  # first 2 rows of page 21 valid
+    # A short tail (under one chunk) can still partially match.
+    path, partial = c.match([1, 2, 9])
+    assert path == [] and partial[1] == 2
+    # No overlap at all: pure miss.
+    assert c.match([9, 9, 9, 9]) == ([], None)
+
+
+def test_trie_eviction_only_at_refcount_zero():
+    c = RadixPrefixCache(2)
+    na, _ = c.insert(None, [(1, 2), (3, 4)], [30, 31])
+    nb, _ = c.insert(None, [(5, 6)], [32])
+    c.release(nb)                      # b's page cached at refcount 0
+    assert c.evictable() == 1
+    assert c.evict(5) == [32]          # pinned a-path survives demand 5
+    assert c.evictable() == 0 and c.evict(1) == []
+    c.release(na)
+    # Leaves before parents, LRU first: page 31 (leaf) then 30.
+    assert c.evict(2) == [31, 30]
+    assert c.cached_pages() == 0 and c.evictions == 3
+
+
+def test_trie_flush_detaches_pinned_frees_idle():
+    c = RadixPrefixCache(2)
+    na, _ = c.insert(None, [(1, 2)], [40])
+    nb, _ = c.insert(None, [(3, 4)], [41])
+    c.release(nb)
+    freed = c.flush()
+    assert freed == [41]               # idle page frees now
+    assert c.match([1, 2]) == ([], None)   # index gone
+    assert c.release(na) == [40]       # pinned frees on last release
+
+
+def test_trie_duplicate_insert_keeps_established_node():
+    c = RadixPrefixCache(2)
+    na, _ = c.insert(None, [(1, 2)], [50])
+    nb, dups = c.insert(None, [(1, 2)], [51])
+    assert nb[0] is na[0] and dups == [51]
+    assert nb[0].refs == 2 and c.cached_pages() == 1
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache through the engine: bit-identity + page accounting
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_bit_identity_and_page_accounting(params, ref_out):
+    e = _engine(params, prefix_cache=True)
+    total = 2 * 4                              # slots * pages_per_slot
+    assert _greedy(e, PROMPT, rid="cold") == ref_out
+    # Retire released the prompt's 2 full pages THROUGH the trie:
+    # cached at refcount 0, still counted free.
+    cs = e.stats()["prefix_cache"]
+    assert cs["cached_pages"] == 2 and cs["evictable_pages"] == 2
+    assert e.free_pages() == total
+    # Warm hit: 16 of 17 prompt positions served from cache (the last
+    # prompt position always recomputes — it samples the first token).
+    assert _greedy(e, PROMPT, rid="warm") == ref_out
+    cs = e.stats()["prefix_cache"]
+    assert cs["hits"] == 1 and cs["tokens_reused"] == 16
+    # Divergent prompt sharing one full page + 3 tokens: copy-on-write.
+    div = PROMPT[:11] + [30, 31, 32]
+    ref_div = _greedy(_engine(params), div)
+    assert _greedy(e, div, rid="div") == ref_div
+    assert e.stats()["prefix_cache"]["hits"] == 2
+    assert e.free_pages() == total             # everything released
+
+
+def test_full_pool_admission_evicts_exactly_the_shortfall(params,
+                                                          ref_out):
+    e = _engine(params, prefix_cache=True)
+    ref23 = _greedy(_engine(params), [23] * 17)
+    # Fill the pool with cached prefixes: each retired 17-token prompt
+    # leaves 2 cached pages (its suffix page frees immediately).
+    assert _greedy(e, PROMPT, rid="p0") == ref_out
+    _greedy(e, [21] * 17, rid="p1")
+    _greedy(e, [22] * 17, rid="p2")
+    cs = e.stats()["prefix_cache"]
+    assert cs["cached_pages"] == 6 == cs["evictable_pages"]
+    assert e.free_pages() == 8                 # all of it reclaimable
+    # Admission with 2 free-list pages and need 3: evicts EXACTLY the
+    # shortfall (1 page — the LRU leaf, PROMPT's second chunk), never
+    # the whole cache.  The slot is held so the pool stays saturated.
+    out_d = [ev.token for ev in
+             e.admit(Request(id="held", prompt=[23] * 17,
+                             max_new_tokens=N_OUT))
+             if ev.kind == "token"]
+    cs = e.stats()["prefix_cache"]
+    assert cs["evictions"] == 1 and cs["cached_pages"] == 7
+    assert len(e._free_pages) == 0
+    # Re-admit PROMPT against an EMPTY free list: its surviving first
+    # chunk is matched and acquired BEFORE allocation, so eviction can
+    # only claim the refcount-0 pages of other prefixes — exactly the
+    # 2-page shortfall.  Bit-identical output proves no shared page
+    # was corrupted, for the re-admitted prompt AND the held request
+    # decoding concurrently through the same pool.
+    out_e, done = [], False
+    evs = e.admit(Request(id="again", prompt=list(PROMPT),
+                          max_new_tokens=N_OUT))
+    while not done:
+        for ev in evs:
+            if ev.kind == "token":
+                (out_e if ev.request.id == "again"
+                 else out_d).append(ev.token)
+            elif ev.kind == "finish" and ev.request.id == "again":
+                done = True
+        if not done:
+            evs = e.step()
+    cs = e.stats()["prefix_cache"]
+    assert cs["hits"] == 1 and cs["tokens_reused"] == 8
+    assert cs["evictions"] == 3
+    assert out_e == ref_out
+    assert out_d == ref23[:len(out_d)]
+
+
+def test_swap_flushes_prefix_cache(params, ref_out):
+    e = _engine(params, prefix_cache=True)
+    _greedy(e, PROMPT, rid="a")
+    assert e.stats()["prefix_cache"]["cached_pages"] == 2
+    e.swap_params(params, tag=1)
+    e.maybe_swap()
+    cs = e.stats()["prefix_cache"]
+    assert cs["cached_pages"] == 0 and cs["flushes"] == 1
+    assert e.free_pages() == 2 * 4
+    # Same weights re-parked: output unchanged, now a cold miss.
+    assert _greedy(e, PROMPT, rid="b") == ref_out
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_bit_identity_and_backlog(params, ref_out):
+    e = _engine(params, prefill_chunk=4)
+    evs = e.admit(Request(id="c", prompt=list(PROMPT),
+                          max_new_tokens=N_OUT))
+    # 17-token prompt, 4-token budget: admission prefills one chunk
+    # and the backlog drains through step().
+    assert evs == [] and e.prefill_backlog() == len(PROMPT) - 4
+    assert e.stats()["prefill_backlog"] == 13
+    out, done = [], False
+    while not done:
+        for ev in e.step():
+            if ev.kind == "token":
+                out.append(ev.token)
+                if len(out) == 1:
+                    assert ev.first
+            elif ev.kind == "finish":
+                done = True
+    assert out == ref_out
+    assert e.prefill_backlog() == 0
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding
+# ---------------------------------------------------------------------------
+
+def test_acceptance_identity_preserves_target_distribution():
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        p = rng.dirichlet(np.full(16, 0.4))
+        q = rng.dirichlet(np.full(16, 0.4))
+        np.testing.assert_allclose(spec.acceptance_identity(p, q), p,
+                                   atol=1e-12)
+    # Degenerate corners: q == p accepts everything; disjoint support
+    # rejects into the residual, which is p renormalized off q.
+    np.testing.assert_allclose(spec.acceptance_identity(p, p), p,
+                               atol=1e-12)
+    assert spec.accept_prob(p, q, int(np.argmax(q))) <= 1.0
+
+
+def test_accept_greedy_matches_serial_argmax():
+    v = 8
+    logits = np.zeros((4, v))
+    logits[0, 3] = logits[1, 5] = logits[2, 1] = logits[3, 7] = 9.0
+    assert spec.accept_greedy(logits, [3, 5, 1]) == (3, 7)   # all + bonus
+    assert spec.accept_greedy(logits, [3, 4, 1]) == (1, 5)   # correct at 1
+    assert spec.accept_greedy(logits, [0, 5, 1]) == (0, 3)   # reject first
+
+
+def test_speculative_greedy_exact_and_counters(params, ref_out):
+    dcfg = tfm.draft_config(CFG, 1)
+    dparams = tfm.draft_params_from(params, 1)
+    e = _engine(params, draft=spec.DraftSpec(cfg=dcfg, params=dparams,
+                                             k=3))
+    assert _greedy(e, PROMPT, rid="sp") == ref_out
+    st = e.stats()["speculative"]
+    assert st["k"] == 3 and st["proposed"] >= 3
+    assert 0 <= st["accepted"] <= st["proposed"]
+    assert e.verify_traces >= 1
+    # Fewer target forwards than emitted tokens when anything accepts;
+    # never more than one verify round per emitted token.
+    assert e.steps <= len(ref_out)
+
+
+def test_draft_validation_is_loud(params):
+    with pytest.raises(ValueError):
+        tfm.draft_config(CFG, 0)
+    with pytest.raises(ValueError):
+        tfm.draft_config(CFG, CFG.n_layers + 1)
+    bad = spec.DraftSpec(
+        cfg=tfm.draft_config(CFG, 1)._replace(vocab_size=32),
+        params=None, k=2)
+    with pytest.raises(ValueError):
+        bad.validate(CFG, 32)
+
+
+# ---------------------------------------------------------------------------
+# Policy: aging + prefill budget (goldens, same style as test_serving)
+# ---------------------------------------------------------------------------
+
+def test_policy_aging_reserves_for_starved_request():
+    big = P.RequestView(id="big", submit_seq=1, arrival_s=0.0,
+                        pages_needed=4)
+    small = P.RequestView(id="small", submit_seq=2, arrival_s=9.0,
+                          pages_needed=1)
+    # Without aging the small request leapfrogs forever.
+    assert P.plan([big, small], free_slots=2, free_pages=2,
+                  now_s=10.0) == [
+        ("wait", "big", "pages"), ("admit", "small")]
+    # Aged past aging_s: big's reservation is withheld from small.
+    assert P.plan([big, small], free_slots=2, free_pages=2, now_s=10.0,
+                  aging_s=5.0) == [
+        ("wait", "big", "pages"), ("wait", "small", "pages")]
+    # Not yet aged: no reservation.
+    assert P.plan([big, small], free_slots=2, free_pages=2, now_s=4.0,
+                  aging_s=5.0) == [
+        ("wait", "big", "pages"), ("admit", "small")]
+    # Pool drained to it: big seats.
+    assert P.plan([big, small], free_slots=2, free_pages=5, now_s=10.0,
+                  aging_s=5.0) == [
+        ("admit", "big"), ("admit", "small")]
+
+
+def test_policy_aging_drains_the_pool_toward_the_aged_head():
+    b1 = P.RequestView(id="b1", submit_seq=1, arrival_s=0.0,
+                       pages_needed=4)
+    b2 = P.RequestView(id="b2", submit_seq=2, arrival_s=0.0,
+                       pages_needed=4)
+    tiny = P.RequestView(id="t", submit_seq=3, arrival_s=99.0,
+                         pages_needed=1)
+    # The aged head's reservation withholds the whole remaining pool
+    # from everything behind it in this plan...
+    assert P.plan([b1, b2, tiny], free_slots=3, free_pages=3,
+                  now_s=100.0, aging_s=5.0) == [
+        ("wait", "b1", "pages"), ("wait", "b2", "pages"),
+        ("wait", "t", "pages")]
+    # ...so a retire later drains pages to it: the aged request seats
+    # FIRST next plan, and only then does admission resume behind it.
+    assert P.plan([b1, b2, tiny], free_slots=3, free_pages=4,
+                  now_s=100.0, aging_s=5.0) == [
+        ("admit", "b1"), ("wait", "b2", "pages"),
+        ("wait", "t", "pages")]
+    assert P.plan([b2, tiny], free_slots=2, free_pages=5,
+                  now_s=100.0, aging_s=5.0) == [
+        ("admit", "b2"), ("admit", "t")]
+
+
+def test_policy_prefill_budget_golden():
+    a = P.RequestView(id="a", submit_seq=1, prompt_tokens=8)
+    b = P.RequestView(id="b", submit_seq=2, prompt_tokens=6)
+    c = P.RequestView(id="c", submit_seq=3, prompt_tokens=2)
+    assert P.plan([a, b, c], free_slots=3, free_pages=99, now_s=0.0,
+                  prefill_budget=10) == [
+        ("admit", "a"), ("wait", "b", "prefill"), ("admit", "c")]
+    # The first admission always fits — a prompt longer than the whole
+    # budget must still be servable.
+    huge = P.RequestView(id="h", submit_seq=1, prompt_tokens=50)
+    assert P.plan([huge], free_slots=1, free_pages=99, now_s=0.0,
+                  prefill_budget=10) == [("admit", "h")]
+    # budget 0 = unlimited (the existing behavior, golden-locked).
+    assert P.plan([a, b, c], free_slots=3, free_pages=99,
+                  now_s=0.0) == [
+        ("admit", "a"), ("admit", "b"), ("admit", "c")]
+
+
+# ---------------------------------------------------------------------------
+# Migration: bundle codec + token-for-token drills
+# ---------------------------------------------------------------------------
+
+def _state(n=4):
+    return {"id": "m", "prompt": [1, 2, 3], "max_new_tokens": 4,
+            "eos_id": None, "tenant": "default", "priority": 0,
+            "deadline_s": 0.0, "temperature": 0.0, "seed": 0,
+            "submit_seq": 1, "generated": [7], "length": 4,
+            "rng_state": None, "spec_rng_state": None}
+
+
+def test_bundle_codec_roundtrip_verify_and_ratio():
+    rng = np.random.default_rng(1)
+    k = rng.standard_normal((2, 3, PAGE, 4, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 3, PAGE, 4, 8)).astype(np.float32)
+    blob = disagg.encode_bundle(_state(), k, v, bits=0)
+    s2, k2, v2 = disagg.decode_bundle(blob)
+    assert s2["generated"] == [7]
+    np.testing.assert_array_equal(k2, k)       # fp32 wire is exact
+    np.testing.assert_array_equal(v2, v)
+    blob8 = disagg.encode_bundle(_state(), k, v, bits=8)
+    _, k8, _ = disagg.decode_bundle(blob8)
+    assert np.max(np.abs(k8 - k)) < 0.05       # block-scaled int8
+    assert len(blob8) < len(blob) / 3          # ~3.9x smaller payload
+    # Large-tensor wire ratio approaches 4·256/(256+4) ≈ 3.94.
+    assert 3.8 < disagg.wire_ratio(8, 1 << 20) < 4.0
+    # Corruption fails loudly: flipped payload byte, torn tail.
+    bad = bytearray(blob)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        disagg.decode_bundle(bytes(bad))
+    with pytest.raises(ValueError):
+        disagg.decode_bundle(blob[:-3])
+    with pytest.raises(ValueError):
+        disagg.decode_bundle(b"nope" + blob[4:])
+
+
+def test_migration_resumes_token_for_token_over_http(params, ref_out):
+    from horovod_tpu.recovery import transport
+    src = _engine(params)
+    dst = _engine(params)
+    server = transport.RecoveryServer(host="127.0.0.1")
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        evs = src.admit(Request(id="m", prompt=list(PROMPT),
+                                max_new_tokens=N_OUT))
+        toks = [e.token for e in evs if e.kind == "token"]
+        # Prefill replica pushes; its slot frees only after the push.
+        disagg.send(src, "m", addr, bits=0)
+        assert src.active() == 0 and src.free_pages() == 2 * 4
+        assert disagg.receive(dst, "m", addr)
+        assert not disagg.receive(dst, "m", addr)   # one-shot mailbox
+        done = False
+        while not done:
+            for e in dst.step():
+                if e.kind == "token":
+                    toks.append(e.token)
+                elif e.kind == "finish":
+                    done = True
+        assert toks == ref_out                       # token-for-token
+    finally:
+        server.stop()
+
+
+def test_migration_int8_wire_and_metrics(params):
+    src = _engine(params)
+    dst = _engine(params)
+    src.admit(Request(id="q", prompt=list(PROMPT),
+                      max_new_tokens=N_OUT))
+    nbytes = disagg.migrate(src, "q", dst, bits=8)
+    raw = 4 * 2 * CFG.n_layers * 3 * PAGE * CFG.n_heads * 8  # k+v fp32
+    assert nbytes < raw / 2.5                    # quantized wire wins
+    assert dst.active() == 1
+    # Migrating a half-prefilled request is refused loudly.
+    src2 = _engine(params, prefill_chunk=4)
+    src2.admit(Request(id="h", prompt=list(PROMPT),
+                       max_new_tokens=N_OUT))
+    with pytest.raises(ValueError):
+        src2.export_request("h")
+
+
+# ---------------------------------------------------------------------------
+# Knobs, stats, flight vocabulary
+# ---------------------------------------------------------------------------
+
+def test_new_knobs_single_sourced_and_clamped(monkeypatch):
+    from horovod_tpu.core.config import Config
+    monkeypatch.setenv("HVD_TPU_SERVING_PREFIX_CACHE", "0")
+    monkeypatch.setenv("HVD_TPU_SERVING_PREFILL_CHUNK", "-5")
+    monkeypatch.setenv("HVD_TPU_SERVING_AGING_S", "-1")
+    monkeypatch.setenv("HVD_TPU_SERVING_MIGRATE_BITS", "7")
+    monkeypatch.setenv("HVD_TPU_SPEC_K", "99")
+    cfg = Config.from_env()
+    assert cfg.serving_prefix_cache is False
+    assert cfg.serving_prefill_chunk == 0     # clamped, not negative
+    assert cfg.serving_aging_s == 0.0
+    assert cfg.serving_migrate_bits == 8      # invalid → default
+    assert cfg.spec_k == 32                   # clamped ceiling
+    monkeypatch.delenv("HVD_TPU_SERVING_PREFIX_CACHE")
+    assert Config.from_env().serving_prefix_cache is True
+
+
+def test_env_knobs_reach_engine(params, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_SERVING_PREFIX_CACHE", "0")
+    monkeypatch.setenv("HVD_TPU_SERVING_PREFILL_CHUNK", "6")
+    e = DecodeEngine(CFG, params, slots=2, page_tokens=PAGE,
+                     max_len=32)
+    assert e.prefix_cache is None and e.prefill_chunk == 6
+    st = e.stats()
+    assert "prefix_cache" not in st and st["prefill_chunk"] == 6
+
+
+def test_serve_stats_surface_new_families(params, ref_out):
+    e = _engine(params, prefix_cache=True)
+    _greedy(e, PROMPT, rid="s1")
+    _greedy(e, PROMPT, rid="s2")
+    st = e.stats()
+    assert st["prefix_cache"]["hit_rate"] == 0.5
+    assert st["prefill_backlog"] == 0
+    assert json.loads(json.dumps(st))          # /serve/stats-safe
+    from horovod_tpu.metrics.registry import registry
+    snap = registry().snapshot()
+    for fam in ("hvd_serving_prefix_hits_total",
+                "hvd_serving_prefix_tokens_reused_total",
+                "hvd_serving_prefill_backlog_tokens",
+                "hvd_serving_migrate_bytes_total"):
+        assert fam in snap, fam
+
+
+def test_flight_vocabulary_covers_serving_events():
+    from horovod_tpu.debug import regression as R
+    for kind in ("serving.prefix_hit", "serving.chunk",
+                 "serving.speculate", "serving.migrate"):
+        assert R.EVENT_SUBSYSTEM[kind] == "serving"
+    # Per-request chatter corroborates; a migration is a discrete
+    # placement change and stays suspect-eligible.
+    assert "serving.prefix_hit" in R._CORROBORATING
+    assert "serving.chunk" in R._CORROBORATING
+    assert "serving.speculate" in R._CORROBORATING
+    assert "serving.migrate" not in R._CORROBORATING
