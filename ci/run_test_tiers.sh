@@ -82,6 +82,12 @@ TIER_FAST=(
   # goldens, and the KV-page migration codec + token-for-token handoff
   # (`bench.py --bench serving` grows the four matching arms).
   test_serving_scale.py
+  # Request-scoped tracing + SLO error budgets (ISSUE 19): sampling
+  # determinism, burn-rate goldens, burn-aware policy/autoscaler,
+  # span coverage with tracing-on/off bit-identity, the migrated
+  # stitched-trace drill, merge --trace, loop-liveness surface
+  # (`bench.py --bench tracing` prices the <1% overhead bar).
+  test_tracing.py
   test_transformer.py
   # Closed-loop autotuning drill (ISSUE 12): injected comm regression →
   # drift → bounded re-tune → regression-gated rollback → resolution in

@@ -172,6 +172,13 @@ SERVING_PREFILL_CHUNK = "SERVING_PREFILL_CHUNK"  # prefill tokens/iter; 0 = all
 SERVING_AGING_S = "SERVING_AGING_S"            # page-reservation aging; 0 = off
 SERVING_MIGRATE_BITS = "SERVING_MIGRATE_BITS"  # KV wire quant: 0 = fp32; 8 | 4
 SPEC_K = "SPEC_K"                              # draft tokens/round; 0 = off
+# Request-scoped tracing + per-tenant SLO error budgets (ISSUE 19):
+# serving/tracing.py and serving/slo.py.  See docs/observability.md.
+TRACE_SAMPLE = "TRACE_SAMPLE"                  # sampled request fraction [0,1]
+TRACE_SEED = "TRACE_SEED"                      # trace-id derivation seed
+SLO_TARGET = "SLO_TARGET"                      # attainment target [0.5,0.9999]
+SLO_WINDOW_S = "SLO_WINDOW_S"                  # rolling budget window (s)
+SLO_BURN_THRESHOLD = "SLO_BURN_THRESHOLD"      # burn rate that trips action
 # Third mesh dimensions (parallel/moe.py, parallel/pipeline.py): MoE
 # routing geometry and the pipeline schedule.  Single-sourced here —
 # models read these through Config/the getters, never os.environ
@@ -402,6 +409,15 @@ class Config:
     serving_aging_s: float = 0.0      # page-reservation aging; 0 = off
     serving_migrate_bits: int = 8     # 0 = fp32 wire; 8 | 4
     spec_k: int = 0                   # draft tokens/round; 0 = off
+    # Request-scoped tracing + SLO budgets: a 1% default sample rate
+    # keeps the span stream within the flight recorder's <1% overhead
+    # bar; the budget window and burn threshold follow SRE convention
+    # (burn rate 1.0 = exactly spending the error budget).
+    trace_sample: float = 0.01        # sampled request fraction [0, 1]
+    trace_seed: int = 0               # trace-id derivation seed
+    slo_target: float = 0.99          # per-tenant attainment target
+    slo_window_s: float = 300.0       # rolling error-budget window (s)
+    slo_burn_threshold: float = 1.0   # burn rate that trips scale/shed
     # MoE / pipeline geometry: experts routed per token, dispatch-
     # buffer headroom over the even share, the optional block-scaled
     # quantized dispatch wire (0 = fp32; 8/4 ride ops/quantization.py),
@@ -580,6 +596,15 @@ class Config:
         mbits = get_int(SERVING_MIGRATE_BITS, cfg.serving_migrate_bits)
         cfg.serving_migrate_bits = mbits if mbits in (0, 4, 8) else 8
         cfg.spec_k = min(32, max(0, get_int(SPEC_K, cfg.spec_k)))
+        cfg.trace_sample = min(1.0, max(0.0, get_float(
+            TRACE_SAMPLE, cfg.trace_sample)))
+        cfg.trace_seed = get_int(TRACE_SEED, cfg.trace_seed)
+        cfg.slo_target = min(0.9999, max(0.5, get_float(
+            SLO_TARGET, cfg.slo_target)))
+        cfg.slo_window_s = max(1.0, get_float(
+            SLO_WINDOW_S, cfg.slo_window_s))
+        cfg.slo_burn_threshold = max(0.01, get_float(
+            SLO_BURN_THRESHOLD, cfg.slo_burn_threshold))
         cfg.moe_top_k = max(1, get_int(MOE_TOP_K, cfg.moe_top_k))
         cfg.moe_capacity_factor = max(0.0, get_float(
             MOE_CAPACITY_FACTOR, cfg.moe_capacity_factor))

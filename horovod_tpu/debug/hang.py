@@ -101,6 +101,14 @@ def build_hang_report(stalled: List[dict],
             entry["last_events"] = events
             entry["clock"] = dumpd.get("clock", {})
             entry["host"] = dumpd.get("host")
+            # Serving replicas publish their in-flight requests (and
+            # trace ids) in the recorder meta (engine._publish_slots):
+            # a wedged serving loop's report NAMES what it was holding,
+            # and each trace id is a merge --trace away from the
+            # request's own timeline.
+            slots = (dumpd.get("meta") or {}).get("serving_slots")
+            if slots:
+                entry["serving_in_flight"] = slots
         elif r in missing_union:
             entry["attribution"] = \
                 "unknown (rank unreachable: process dead or debug " \

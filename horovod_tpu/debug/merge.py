@@ -30,6 +30,12 @@ Completed collectives (``collective.done`` events with ``dur_s``) render
 as complete ("X") slices; everything else renders as instants — robust
 to interleaved async ops, where begin/end pairs would violate Chrome's
 per-thread stack nesting.
+
+Request-scoped traces (``serving/tracing.py``): ``--trace <trace_id>``
+filters every dump down to that one request's ``trace.*`` spans before
+merging — a migrated request's spans stitch across its prefill and
+decode replicas on the same clock-aligned axis, answering "where did
+THIS request's time go".  See docs/observability.md.
 """
 
 from __future__ import annotations
@@ -123,6 +129,23 @@ def load_timeline(path: str) -> List[dict]:
 
 def _aligned_wall(ev: dict, offset_s: float) -> float:
     return float(ev["t_wall"]) - offset_s
+
+
+def filter_trace(dumps: List[dict], trace_id: str) -> List[dict]:
+    """Pure filter: keep only ``trace.*`` flight events whose name is
+    ``trace_id`` (span events are NAMED by their trace id — one grep
+    key end to end).  Dumps left with no matching spans drop out
+    entirely; clock/meta/rank survive so the merge stays aligned."""
+    out = []
+    for d in dumps:
+        events = [ev for ev in d.get("events", [])
+                  if str(ev.get("kind", "")).startswith("trace.")
+                  and ev.get("name") == trace_id]
+        if events:
+            nd = {k: v for k, v in d.items() if k != "events"}
+            nd["events"] = events
+            out.append(nd)
+    return out
 
 
 def merge_dumps(dumps: List[dict],
@@ -234,6 +257,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "rendezvous KV (host:port) — one request per "
                         "host when per-host observers are running, "
                         "per-rank otherwise")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="emit only this request's trace.* spans "
+                        "(serving/tracing.py trace id) — one "
+                        "clock-aligned single-request trace across "
+                        "every replica that touched it")
     args = p.parse_args(argv)
     if not args.dumps and not args.from_fleet:
         p.error("give dump files or --from-fleet RDV_ADDR")
@@ -242,6 +270,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.from_fleet:
         fetched = fetch_fleet_dumps(args.from_fleet)
         dumps.extend(fetched[r] for r in sorted(fetched))
+    if args.trace:
+        n_in = len(dumps)
+        dumps = filter_trace(dumps, args.trace)
+        spans = sum(len(d.get("events", [])) for d in dumps)
+        sys.stderr.write(
+            f"trace {args.trace}: {spans} span(s) across "
+            f"{len(dumps)}/{n_in} dump(s)\n")
+        if not dumps:
+            sys.stderr.write(
+                "no spans found — was the request sampled? "
+                "(HVD_TPU_TRACE_SAMPLE, or force via x-hvd-trace)\n")
     timeline = load_timeline(args.timeline) if args.timeline else None
     trace = merge_dumps(dumps, timeline_events=timeline)
     with open(args.output, "w", encoding="utf-8") as f:

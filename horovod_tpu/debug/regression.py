@@ -90,6 +90,9 @@ EVENT_SUBSYSTEM: Dict[str, str] = {
     "autotune.": "autotune", "elastic.": "elastic", "fleet.": "fleet",
     "net.": "net", "recovery.": "recovery", "checkpoint.": "checkpoint",
     "data.": "data", "dispatch.": "dispatch", "serving.": "serving",
+    # Request-scoped spans (serving/tracing.py): serving-plane events
+    # named by trace id.
+    "trace.": "serving",
 }
 
 # Subsystems that can plausibly explain a given drifting component —
@@ -116,7 +119,14 @@ _CORROBORATING = {"data.wait", "elastic.commit", "checkpoint.save.begin",
                   # so is serving.migrate — a placement change).
                   "serving.admit", "serving.retire",
                   "serving.prefix_hit", "serving.chunk",
-                  "serving.speculate"}
+                  "serving.speculate",
+                  # Per-request trace spans: pure load chatter.  The
+                  # discrete-moment spans (trace.migrate*, .swap_stall,
+                  # .shed) stay suspect-eligible — they mirror
+                  # serving.migrate/swap/shed.
+                  "trace.ingress", "trace.plan", "trace.admit",
+                  "trace.prefix", "trace.prefill", "trace.decode",
+                  "trace.speculate", "trace.finish"}
 
 _last_report: Optional[dict] = None
 _last_lock = threading.Lock()

@@ -38,6 +38,37 @@ from typing import Dict, List, Optional
 from ..metrics import digest as _digest
 
 
+def _slo_summary(d: dict) -> Optional[dict]:
+    """Per-job SLO rollup from the digest's merged gauge map
+    (``hvd_slo_*{tenant=...}`` — serving/slo.py): the worst burn rate
+    and thinnest remaining budget across every tenant on every
+    reporting replica, plus which tenants are burning (>= 1.0).  None
+    for jobs that serve no SLO-tracked traffic (training jobs)."""
+    gauges = d.get("gauges") or {}
+    burn: Dict[str, float] = {}
+    budget: Dict[str, float] = {}
+    for key, v in gauges.items():
+        for prefix, dst in (("hvd_slo_burn_rate{", burn),
+                            ("hvd_slo_budget_remaining{", budget)):
+            if key.startswith(prefix):
+                tenant = key[len(prefix):-1]
+                tenant = tenant.partition("tenant=")[2] or tenant
+                last = float(v[2])   # gauges merge as [min, max, last]
+                # Merge rule across replicas: worst case wins.
+                if dst is burn:
+                    dst[tenant] = max(dst.get(tenant, 0.0), last)
+                else:
+                    dst[tenant] = min(dst.get(tenant, 1.0), last)
+    if not burn and not budget:
+        return None
+    return {
+        "burn_max": max(burn.values()) if burn else 0.0,
+        "budget_min": min(budget.values()) if budget else 1.0,
+        "tenants": len(set(burn) | set(budget)),
+        "burning": sorted(t for t, b in burn.items() if b >= 1.0),
+    }
+
+
 def _sample_from_digest(d: dict, ts: float) -> dict:
     """One retained timeline sample, derived (not stored raw — digests
     carry full scalar maps; the ring keeps only the series shape)."""
@@ -63,6 +94,7 @@ def _sample_from_digest(d: dict, ts: float) -> dict:
         "shares": _digest.digest_shares(d),
         "outlier_ranks": [int(s.get("rank", -1))
                           for s in d.get("outliers") or []],
+        "slo": _slo_summary(d),
     }
     return sample
 
@@ -235,6 +267,24 @@ class FleetSeriesStore:
                     continue
                 lines.append(f'{name}{{job="{_escape_label(job)}"}} '
                              f'{float(s[field])!r}')
+        slo_gauges = (
+            ("hvd_fleet_job_slo_burn_max", "burn_max",
+             "Worst per-tenant SLO burn rate across the job's serving "
+             "replicas (1.0 = spending budget exactly at rate)"),
+            ("hvd_fleet_job_slo_budget_min", "budget_min",
+             "Thinnest per-tenant SLO error budget remaining across "
+             "the job's serving replicas"),
+        )
+        for name, field, help_text in slo_gauges:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            for job in sorted(latest):
+                s = latest[job]
+                slo = s.get("slo") if s else None
+                if not slo or slo.get(field) is None:
+                    continue
+                lines.append(f'{name}{{job="{_escape_label(job)}"}} '
+                             f'{float(slo[field])!r}')
         lines.append("# HELP hvd_fleet_job_component_share Wall-time "
                      "share by component in the job's last window")
         lines.append("# TYPE hvd_fleet_job_component_share gauge")

@@ -146,7 +146,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "labels", "buckets", "_counts", "_sum", "_count",
-                 "_lock")
+                 "_exemplars", "_lock")
 
     def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
                  buckets: Sequence[float]):
@@ -161,9 +161,10 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._count = 0
+        self._exemplars: Optional[Dict[int, Tuple[float, str]]] = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         if not _enabled:
             return
         i = bisect.bisect_left(self.buckets, value)
@@ -171,6 +172,25 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                # Last-writer-wins per bucket: exemplars are trace-id
+                # breadcrumbs (OpenMetrics semantics), not statistics —
+                # the freshest reference is the debuggable one.
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (value, exemplar)
+
+    def exemplars(self) -> Dict[str, Dict[str, object]]:
+        """{le label: {"value": observed, "ref": exemplar}} for every
+        bucket that has one.  ``le`` follows the exposition format
+        (bucket upper bound, ``+Inf`` for the tail)."""
+        with self._lock:
+            ex = dict(self._exemplars) if self._exemplars else {}
+        out: Dict[str, Dict[str, object]] = {}
+        for i, (value, ref) in sorted(ex.items()):
+            le = "+Inf" if i >= len(self.buckets) else repr(self.buckets[i])
+            out[le] = {"value": value, "ref": ref}
+        return out
 
     @property
     def sum(self) -> float:
@@ -198,6 +218,7 @@ class Histogram:
             self._counts = [0] * (len(self.buckets) + 1)
             self._sum = 0.0
             self._count = 0
+            self._exemplars = None
 
 
 _KIND_OF = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}

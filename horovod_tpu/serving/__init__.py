@@ -39,6 +39,21 @@ Production-scale serving (ISSUE 18) layers on the same geometry:
   between prefill and decode replica pools over the recovery
   transport, pages int8-quantized on the wire.
 
+Request-scoped observability (ISSUE 19) closes the loop:
+
+* **distributed tracing** (``tracing.py``): a deterministic 128-bit
+  trace context minted at ingress (or accepted from the
+  ``x-hvd-trace`` header) rides every stage — queue wait, plan
+  decisions, prefix-cache walk, prefill chunks, decode ticks,
+  speculative rounds, hot-swap stalls, KV migration — as ``trace.*``
+  flight events; ``debug/merge.py --trace <id>`` renders one request's
+  clock-aligned Chrome trace across replicas;
+* **SLO error budgets** (``slo.py``): per-tenant rolling TTFT/deadline
+  attainment → burn rate, exported as ``hvd_slo_*`` gauges with
+  trace-id exemplars, feeding ``policy.plan`` and
+  ``autoscale.desired_np`` so a burning tenant deterministically gets
+  scale-up/shed priority.
+
 See docs/serving.md.  Load clients: ``python -m
 horovod_tpu.serving.submit`` and ``examples/serving_client.py``.
 """
@@ -51,7 +66,9 @@ from .policy import RequestView, plan
 from .prefix import RadixPrefixCache
 from .server import ServingServer
 from .service import CheckpointWatcher, ServingService, load_params
+from .slo import SloTracker, budget_remaining, burn_rate
 from .speculative import DraftSpec
+from .tracing import TraceContext, mint, parse_header, span
 
 __all__ = [
     "Autoscaler", "desired_np",
@@ -62,5 +79,7 @@ __all__ = [
     "RadixPrefixCache",
     "ServingServer",
     "CheckpointWatcher", "ServingService", "load_params",
+    "SloTracker", "budget_remaining", "burn_rate",
     "DraftSpec",
+    "TraceContext", "mint", "parse_header", "span",
 ]

@@ -21,19 +21,25 @@ from typing import Callable, Dict, Optional
 def desired_np(current_np: int, min_np: int, max_np: int,
                queue_depth: int, target_queue: float,
                ttft_p95: float = 0.0, slo_ttft_s: float = 0.0,
-               occupancy: float = 0.0) -> int:
+               occupancy: float = 0.0, burn_rate: float = 0.0,
+               burn_threshold: float = 1.0) -> int:
     """The width the service should run at.  Scale up one replica when
     the queue holds more than ``target_queue`` requests per replica OR
-    TTFT p95 exceeds the SLO; scale down one only when the queue is
+    TTFT p95 exceeds the SLO OR any tenant's error-budget burn rate
+    (``burn_rate`` — the max across tenants, from serving/slo.py) is
+    at/over its threshold; scale down one only when the queue is
     empty, the decode slots have real headroom (``occupancy`` — the
     occupied-slot fraction — under half: a saturated replica whose
-    queue merely drained between ticks is NOT idle), and the SLO (when
-    set) has comfortable headroom (< half).  One step at a time — the
+    queue merely drained between ticks is NOT idle), the SLO (when
+    set) has comfortable headroom (< half), and no tenant is burning
+    anywhere near threshold (< half).  One step at a time — the
     cooldown between calls is the ramp limiter."""
     up = (queue_depth > target_queue * current_np
-          or (slo_ttft_s > 0 and ttft_p95 > slo_ttft_s))
+          or (slo_ttft_s > 0 and ttft_p95 > slo_ttft_s)
+          or burn_rate >= burn_threshold)
     down = (queue_depth == 0 and occupancy < 0.5
-            and (slo_ttft_s <= 0 or ttft_p95 < 0.5 * slo_ttft_s))
+            and (slo_ttft_s <= 0 or ttft_p95 < 0.5 * slo_ttft_s)
+            and burn_rate < 0.5 * burn_threshold)
     want = current_np + (1 if up else (-1 if down else 0))
     return max(min_np, min(max_np, want))
 
@@ -42,15 +48,17 @@ class Autoscaler:
     """Drives ``driver.request_resize`` from a status callback.
 
     ``status_fn()`` returns ``{"np": current width, "queue_depth": int,
-    "ttft_p95": seconds, "occupancy": occupied-slot fraction}``
-    (missing keys default sanely).  ``driver`` is anything with the
-    ElasticDriver resize carve-out."""
+    "ttft_p95": seconds, "occupancy": occupied-slot fraction,
+    "burn_rate": max per-tenant SLO burn rate}`` (missing keys default
+    sanely).  ``driver`` is anything with the ElasticDriver resize
+    carve-out."""
 
     def __init__(self, driver, status_fn: Callable[[], Dict],
                  min_np: int = 1, max_np: int = 1,
                  target_queue: Optional[float] = None,
                  slo_ttft_s: Optional[float] = None,
-                 cooldown_s: Optional[float] = None):
+                 cooldown_s: Optional[float] = None,
+                 burn_threshold: Optional[float] = None):
         from ..core.config import Config, get_float
         self.driver = driver
         self.status_fn = status_fn
@@ -66,6 +74,9 @@ class Autoscaler:
             get_float("SERVING_SCALE_COOLDOWN_S",
                       Config.serving_scale_cooldown_s)
             if cooldown_s is None else float(cooldown_s)))
+        self.burn_threshold = max(0.01, (
+            get_float("SLO_BURN_THRESHOLD", Config.slo_burn_threshold)
+            if burn_threshold is None else float(burn_threshold)))
         self._last_resize = 0.0
 
     def maybe_resize(self, now: Optional[float] = None) -> Optional[int]:
@@ -82,7 +93,9 @@ class Autoscaler:
             target_queue=self.target_queue,
             ttft_p95=float(st.get("ttft_p95", 0.0)),
             slo_ttft_s=self.slo_ttft_s,
-            occupancy=float(st.get("occupancy", 0.0)))
+            occupancy=float(st.get("occupancy", 0.0)),
+            burn_rate=float(st.get("burn_rate", 0.0)),
+            burn_threshold=self.burn_threshold)
         if want == current:
             return None
         reason = (f"serving autoscale: queue_depth="

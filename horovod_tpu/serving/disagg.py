@@ -139,7 +139,7 @@ def migrate(src, request_id: str, dst, bits: Optional[int] = None
     m = _metrics()
     m["bytes"].inc(len(blob))
     m["migrations"].inc()
-    _flight(request_id, len(blob), state["length"])
+    _flight(request_id, len(blob), state["length"], state.get("trace"))
     return len(blob)
 
 
@@ -160,7 +160,7 @@ def send(src, request_id: str, addr: str,
     m = _metrics()
     m["bytes"].inc(len(blob))
     m["migrations"].inc()
-    _flight(request_id, len(blob), state["length"])
+    _flight(request_id, len(blob), state["length"], state.get("trace"))
     return len(blob)
 
 
@@ -178,7 +178,11 @@ def receive(dst, request_id: str, addr: str,
     return True
 
 
-def _flight(request_id: str, nbytes: int, length: int) -> None:
+def _flight(request_id: str, nbytes: int, length: int,
+            trace_state: Optional[Dict[str, Any]] = None) -> None:
     from ..debug import flight
+    from . import tracing as _tracing
     flight.record("serving.migrate", request_id, bytes=nbytes,
                   length=length)
+    _tracing.span(_tracing.from_state(trace_state), "migrate",
+                  request=request_id, bytes=nbytes, length=length)

@@ -64,6 +64,7 @@ import numpy as np
 from ..models import transformer as tfm
 from .prefix import RadixPrefixCache
 from . import speculative as spec
+from . import tracing as _tracing
 
 _serving_metrics = None
 
@@ -167,6 +168,10 @@ class Request:
     seed: int = 0
     arrival_mono: float = 0.0      # time.monotonic() at ingress
     submit_seq: int = 0
+    # Trace context (tracing.TraceContext) minted at ingress; None =
+    # untraced.  Rides the migration wire so spans stitch across
+    # replicas.  Never consulted by the model math.
+    trace: Optional[Any] = None
 
     def pages_needed(self, page_tokens: int) -> int:
         """KV pages reserved at admission: prompt + the full output
@@ -295,6 +300,7 @@ class DecodeEngine:
         self._admit_seq = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        self._last_evicted = 0       # pages evicted by the last alloc
 
         def _decode(p, tokens, lengths, kv, page_tables):
             self.decode_traces += 1      # trace-time side effect:
@@ -387,6 +393,12 @@ class DecodeEngine:
             m["ckpt_step"].set(float(self.params_tag))
         _flight("serving.swap", str(self.params_tag),
                 active=self.active())
+        for s in self._slots:
+            if s is not None:
+                # The swap stalls every in-flight request for the
+                # duration of the flush + first retraced step.
+                _tracing.span(s.request.trace, "swap_stall",
+                              tag=str(self.params_tag))
 
     # -- compiled entry points ---------------------------------------------
 
@@ -442,11 +454,14 @@ class DecodeEngine:
         cached prefix pages (LRU, leaves-first) to cover a shortfall —
         exactly the shortfall, so a hot cache survives admission
         pressure as long as the pool allows."""
+        self._last_evicted = 0
         if n <= 0:
             return []
         short = n - len(self._free_pages)
         if short > 0 and self.prefix_cache is not None:
-            self._free_pages.extend(self.prefix_cache.evict(short))
+            evicted = self.prefix_cache.evict(short)
+            self._last_evicted = len(evicted)   # trace: eviction debt
+            self._free_pages.extend(evicted)
         if len(self._free_pages) < n:
             raise RuntimeError(
                 f"page pool exhausted: need {n}, have "
@@ -545,6 +560,18 @@ class DecodeEngine:
         _flight("serving.admit", request.id, slot=slot,
                 prompt=plen, pages=need, tenant=request.tenant,
                 cached=start)
+        tr = request.trace
+        if tr is not None and tr.sampled:
+            wait = (time.monotonic() - request.arrival_mono
+                    if request.arrival_mono else 0.0)
+            _tracing.span(tr, "admit", request=request.id, slot=slot,
+                          prompt=plen, pages=need,
+                          tenant=request.tenant,
+                          queue_wait_s=round(max(0.0, wait), 6))
+            _tracing.span(tr, "prefix", hit=start > 0, tokens=start,
+                          pages=m_pages, cow=bool(partial),
+                          evicted=self._last_evicted)
+        self._publish_slots()
         events, _ = self._advance_prefill(slot, st, self.prefill_chunk)
         _metrics()["prefill_backlog"].set(self.prefill_backlog())
         return events
@@ -582,6 +609,10 @@ class DecodeEngine:
                 self._draft.params, jnp.asarray(tokens),
                 jnp.asarray(start), self._draft_kv, jnp.asarray(table))
         st.prefill_pos += take
+        tr = req.trace
+        if tr is not None and tr.sampled:
+            _tracing.span(tr, "prefill", pos=st.prefill_pos,
+                          tokens=take, done=st.prefill_pos >= plen)
         if st.prefill_pos < plen:
             _flight("serving.chunk", req.id, pos=st.prefill_pos,
                     tokens=take)
@@ -609,7 +640,11 @@ class DecodeEngine:
         now = time.monotonic()
         m = _metrics()
         if req.arrival_mono:
-            m["ttft"].observe(max(0.0, now - req.arrival_mono))
+            tr = req.trace
+            m["ttft"].observe(
+                max(0.0, now - req.arrival_mono),
+                exemplar=(tr.trace_id
+                          if tr is not None and tr.sampled else None))
         m["occupancy"].set(self.occupancy())
         return self._deliver(slot, st, token, first=True)
 
@@ -674,11 +709,18 @@ class DecodeEngine:
         wall = time.perf_counter() - t0
         self.steps += 1
         m = _metrics()
-        m["occupancy"].set(len(active) / self.slots)
+        occ = len(active) / self.slots
+        m["occupancy"].set(occ)
         for i, st in decoding:
             self._lengths[i] += 1
             token = self._sample(st, logits[i])
             m["token_s"].observe(wall)
+            tr = st.request.trace
+            if tr is not None and tr.sampled:
+                _tracing.span(tr, "decode",
+                              token_index=len(st.generated),
+                              occupancy=round(occ, 4),
+                              step=self.steps)
             events.extend(self._deliver(i, st, token, first=False))
         return events
 
@@ -753,6 +795,11 @@ class DecodeEngine:
             m["token_s"].observe(wall)
             _flight("serving.speculate", req.id, proposed=k,
                     accepted=j)
+            tr = req.trace
+            if tr is not None and tr.sampled:
+                _tracing.span(tr, "speculate", proposed=k, accepted=j,
+                              occupancy=round(n_active / self.slots,
+                                              4))
             events.extend(self._deliver_tokens(i, st,
                                                props[:j] + [nxt]))
         return events
@@ -784,6 +831,9 @@ class DecodeEngine:
             events.append(Event(
                 req, "finish", reason="eos" if done_eos else "length",
                 tokens=list(st.generated)))
+            _tracing.span(req.trace, "finish",
+                          reason="eos" if done_eos else "length",
+                          tokens=len(st.generated))
             self._retire(slot)
         return events
 
@@ -809,6 +859,9 @@ class DecodeEngine:
                     req, "finish",
                     reason="eos" if done_eos else "length",
                     tokens=list(st.generated)))
+                _tracing.span(req.trace, "finish",
+                              reason="eos" if done_eos else "length",
+                              tokens=len(st.generated))
                 self._retire(slot)
                 break
         return events
@@ -831,6 +884,22 @@ class DecodeEngine:
         self._lengths[slot] = 0
         _flight("serving.retire", st.request.id,
                 tokens=len(st.generated))
+        self._publish_slots()
+
+    def _publish_slots(self) -> None:
+        """Name the in-flight requests (and their trace ids) in the
+        flight recorder's meta, so hang reports can say WHICH requests
+        a wedged serving loop was holding."""
+        from ..debug import flight
+        meta = {}
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                tr = s.request.trace
+                meta[str(i)] = {
+                    "request": s.request.id,
+                    "trace": tr.trace_id if tr is not None else None,
+                }
+        flight.set_meta("serving_slots", meta)
 
     # -- KV-page migration (disaggregated prefill/decode) -------------------
 
@@ -866,7 +935,12 @@ class DecodeEngine:
                           if st.rng is not None else None),
             "spec_rng_state": (st.spec_rng.bit_generator.state
                                if st.spec_rng is not None else None),
+            # The trace context rides the bundle header so the
+            # destination replica's spans stitch onto this trace.
+            "trace": _tracing.to_state(req.trace),
         }
+        _tracing.span(req.trace, "migrate_export", length=length,
+                      pages=n_used, generated=len(st.generated))
         return state, k_pages, v_pages
 
     def release_request(self, request_id: str) -> None:
@@ -892,7 +966,8 @@ class DecodeEngine:
             deadline_s=float(state.get("deadline_s", 0.0)),
             temperature=float(state.get("temperature", 0.0)),
             seed=int(state.get("seed", 0)),
-            submit_seq=int(state.get("submit_seq", 0)))
+            submit_seq=int(state.get("submit_seq", 0)),
+            trace=_tracing.from_state(state.get("trace")))
         need = req.pages_needed(self.page_tokens)
         length = int(state["length"])
         n_used = -(-length // self.page_tokens)
@@ -945,6 +1020,9 @@ class DecodeEngine:
         _flight("serving.admit", req.id, slot=slot,
                 prompt=len(req.prompt), pages=need, tenant=req.tenant,
                 migrated=True)
+        _tracing.span(req.trace, "migrate_adopt", slot=slot,
+                      length=length, generated=len(st.generated))
+        self._publish_slots()
 
     def _find(self, request_id: str):
         for i, s in enumerate(self._slots):

@@ -45,6 +45,13 @@ Policy, in order:
   (``SERVING_PREFILL_CHUNK``); the first admission always fits (a
   prompt longer than the whole budget must still be servable), later
   ones wait as ``"prefill"`` until the next plan.
+* **SLO burn priority** (``burn`` / ``burn_threshold``) — per-tenant
+  error-budget burn rates from ``serving/slo.py``: a tenant at/over
+  the threshold is missing its SLO *right now*, so among equal
+  priorities its requests select ahead of healthy tenants' (and shed
+  last under overload).  The signal is a plain input dict, so the
+  function stays pure and two replicas fed the same budgets decide
+  identically (``HVD_TPU_SLO_*``).
 """
 
 from __future__ import annotations
@@ -79,8 +86,18 @@ def plan(queued: List[RequestView], free_slots: int, free_pages: int,
          now_s: float, running: Optional[Dict[str, int]] = None,
          queue_cap: int = 0, slot_pages: int = 0,
          aging_s: float = 0.0,
-         prefill_budget: int = 0) -> List[Decision]:
+         prefill_budget: int = 0,
+         burn: Optional[Dict[str, float]] = None,
+         burn_threshold: float = 1.0) -> List[Decision]:
     running = dict(running or {})
+    burn = burn or {}
+
+    def burning(tenant: str) -> bool:
+        # SLO error-budget signal (serving/slo.py): a tenant at/over
+        # its burn threshold is already missing its target — deferring
+        # it further digs the hole.  Pure input, same as ``running``.
+        return burn.get(tenant, 0.0) >= burn_threshold
+
     decisions: List[Decision] = []
     live: List[RequestView] = []
     for v in queued:
@@ -96,7 +113,10 @@ def plan(queued: List[RequestView], free_slots: int, free_pages: int,
         # Overload: shed the lowest-priority newest submissions beyond
         # the cap, so what survives is exactly what the cap promises to
         # eventually serve.
-        doomed = sorted(live, key=lambda v: (v.priority, -v.submit_seq))
+        # A burning tenant's requests shed LAST among equals: shedding
+        # them spends error budget that is already gone.
+        doomed = sorted(live, key=lambda v: (
+            1 if burning(v.tenant) else 0, v.priority, -v.submit_seq))
         for v in doomed[:len(live) - queue_cap]:
             decisions.append(("shed", v.id, "overload"))
         doomed_ids = {d[1] for d in decisions if d[0] == "shed"}
@@ -107,7 +127,9 @@ def plan(queued: List[RequestView], free_slots: int, free_pages: int,
     # precomputed sort would hand a burst tenant every free slot in
     # one pass.
     def key(v: RequestView):
-        return (-v.priority, running.get(v.tenant, 0),
+        return (-v.priority,
+                0 if burning(v.tenant) else 1,
+                running.get(v.tenant, 0),
                 (v.arrival_s + v.deadline_s) if v.deadline_s > 0
                 else _INF,
                 v.submit_seq)

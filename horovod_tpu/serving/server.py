@@ -41,8 +41,10 @@ from typing import Dict, List, Optional
 
 from ..runner.rendezvous import BackgroundHTTPServer, _signature
 from . import policy as P
+from . import tracing as _tracing
 from .engine import (DecodeEngine, Request, record_request, record_shed,
                      set_queue_depth)
+from .slo import SloTracker
 
 SERVICE_NAME = "horovod_tpu_serving"
 
@@ -82,12 +84,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if key is None:
             return self._send(404, {"error": "not found"})
         if key == "healthz":
+            health = sv.loop_health()
             return self._send(200, {
-                "service": SERVICE_NAME, "ok": True,
+                "service": SERVICE_NAME, "ok": not health["stalled"],
                 "slots": sv.engine.slots,
                 "active": sv.engine.active(),
                 "queue_depth": sv.queue_depth(),
                 "params_tag": str(sv.engine.params_tag),
+                "last_iteration_age_s": health["last_iteration_age_s"],
+                "loop_stalled": health["stalled"],
             })
         if not self._authorized("GET", key):
             return self._send(403, {"error": "bad or missing signature"})
@@ -95,6 +100,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
             stats = dict(sv.engine.stats())
             stats["queue_depth"] = sv.queue_depth()
             stats["continuous"] = sv.continuous
+            health = sv.loop_health()
+            stats["last_iteration_age_s"] = health["last_iteration_age_s"]
+            stats["loop_stalled"] = health["stalled"]
+            stats["slo"] = sv.slo.stats(time.monotonic())
+            stats["ttft_exemplars"] = sv.ttft_exemplars()
             return self._send(200, stats)
         return self._send(404, {"error": "not found"})
 
@@ -108,7 +118,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if not self._authorized("POST", key, body):
             return self._send(403, {"error": "bad or missing signature"})
         try:
-            req, stream, timeout_s = sv.parse_request(body)
+            req, stream, timeout_s = sv.parse_request(body, self.headers)
         except (ValueError, TypeError, KeyError) as e:
             return self._send(400, {"error": f"malformed request: {e}"})
         events: _queue.Queue = _queue.Queue()
@@ -140,6 +150,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 return self._send(200, {
                     "id": req.id, "tokens": ev["tokens"],
                     "reason": ev["reason"], "ttft_s": ttft,
+                    "trace": (req.trace.header()
+                              if req.trace is not None else None),
                     "params_tag": str(sv.engine.params_tag)})
 
     def _stream(self, req: Request, events: _queue.Queue,
@@ -178,6 +190,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
             if ev["kind"] == "finish":
                 _line({"done": True, "id": req.id, "tokens": ev["tokens"],
                        "reason": ev["reason"],
+                       "trace": (req.trace.header()
+                                 if req.trace is not None else None),
                        "params_tag": str(sv_tag(self))})
                 return
 
@@ -224,6 +238,19 @@ class ServingServer(BackgroundHTTPServer):
             "SERVING_AGING_S", Config.serving_aging_s))
         self.prefill_budget = max(0, getattr(
             engine, "prefill_chunk", 0))
+        # Per-tenant SLO error budgets: a request's first token is a
+        # good/bad event against its deadline (or the replica-wide
+        # SERVING_SLO_TTFT_S target); sheds always count bad.  The
+        # burn-rate dict feeds policy.plan and the autoscaler.
+        self.slo = SloTracker()
+        self.slo_ttft_s = max(0.0, get_float(
+            "SERVING_SLO_TTFT_S", Config.serving_slo_ttft_s))
+        # Plan-decision dedup for trace spans: a queued request is
+        # re-planned every tick — emit a span only when its decision
+        # (or reason) changes, so a long wait is one span, not one per
+        # tick.
+        self._plan_last: Dict[str, tuple] = {}
+        self._last_iter_mono = time.monotonic()
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queued: List[Request] = []
@@ -254,9 +281,11 @@ class ServingServer(BackgroundHTTPServer):
 
     # -- ingress -----------------------------------------------------------
 
-    def parse_request(self, body: bytes):
+    def parse_request(self, body: bytes, headers=None):
         """Parse one /serve/generate body into (Request, stream,
-        timeout_s); raises ValueError on malformed input."""
+        timeout_s); raises ValueError on malformed input.  ``headers``
+        (when given) is consulted for the ``x-hvd-trace`` propagation
+        header — a client-supplied context wins over local minting."""
         from ..core.config import Config, get_int
         d = json.loads(body.decode())
         toks = d.get("tokens")
@@ -280,6 +309,12 @@ class ServingServer(BackgroundHTTPServer):
             seed=int(d.get("seed") or 0),
             arrival_mono=time.monotonic(),
             submit_seq=seq)
+        req.trace = _tracing.mint(
+            req.id, header=(headers.get(_tracing.HEADER)
+                            if headers is not None else None))
+        _tracing.span(req.trace, "ingress", request=req.id,
+                      tenant=req.tenant, prompt=len(req.prompt),
+                      queue_depth=self.queue_depth())
         if req.pages_needed(self.engine.page_tokens) \
                 > self.engine.pages_per_slot:
             raise ValueError(
@@ -313,6 +348,34 @@ class ServingServer(BackgroundHTTPServer):
         with self._lock:
             return len(self._queued)
 
+    def loop_health(self) -> Dict[str, object]:
+        """Serving-loop liveness: seconds since the loop last completed
+        an iteration, and whether that age says "wedged" rather than
+        "idle" (an idle loop still iterates every tick).  Exported as
+        the ``hvd_serving_loop_stalled`` gauge so a dead loop is
+        visible behind an otherwise-healthy HTTP plane."""
+        age = time.monotonic() - self._last_iter_mono
+        running = (self._loop_thread is not None
+                   and self._loop_thread.is_alive())
+        stalled = bool(running and age > max(1.0, 20 * self._tick_s))
+        from ..metrics.registry import registry
+        registry().gauge(
+            "hvd_serving_loop_stalled",
+            "1 when the serving loop has not completed an iteration "
+            "for >20 ticks (wedged, not idle)").set(1.0 if stalled
+                                                    else 0.0)
+        return {"last_iteration_age_s": round(age, 4),
+                "stalled": stalled}
+
+    def ttft_exemplars(self) -> Dict[str, Dict[str, object]]:
+        """Trace-id exemplars on the TTFT histogram's buckets — the
+        tail-latency breadcrumbs ``/serve/stats`` surfaces."""
+        from ..metrics.registry import registry
+        out: Dict[str, Dict[str, object]] = {}
+        for child in registry().children_of("hvd_serving_ttft_seconds"):
+            out.update(child.exemplars())
+        return out
+
     # -- the serving loop --------------------------------------------------
 
     def _emit(self, req_id: str, payload: dict, final: bool) -> None:
@@ -333,6 +396,7 @@ class ServingServer(BackgroundHTTPServer):
                 from ..utils import logging as log
                 log.warning("serving loop iteration failed: %r", e)
                 time.sleep(self._tick_s)
+            self._last_iter_mono = time.monotonic()
 
     def _tick(self, t0: float) -> None:
         with self._wake:
@@ -355,6 +419,7 @@ class ServingServer(BackgroundHTTPServer):
             pages_needed=r.pages_needed(self.engine.page_tokens),
             prompt_tokens=len(r.prompt))
             for r in queued]
+        now_abs = time.monotonic()
         decisions = P.plan(
             views, free, self.engine.free_pages(), now_s=now,
             running=self.engine.running_by_tenant(),
@@ -362,20 +427,39 @@ class ServingServer(BackgroundHTTPServer):
             slot_pages=min(self.engine.pages_per_slot,
                            self.engine.total_pages),
             aging_s=self.aging_s,
-            prefill_budget=self.prefill_budget)
+            prefill_budget=self.prefill_budget,
+            burn=self.slo.burn_rates(now_abs),
+            burn_threshold=self.slo.burn_threshold)
         by_id = {r.id: r for r in queued}
         events = []
+        for d in decisions:
+            req = by_id.get(d[1])
+            if req is not None and req.trace is not None \
+                    and req.trace.sampled:
+                key = (d[0], d[2] if len(d) > 2 else "")
+                if self._plan_last.get(req.id) != key:
+                    self._plan_last[req.id] = key
+                    _tracing.span(req.trace, "plan", decision=d[0],
+                                  reason=key[1], request=req.id)
         for d in decisions:
             if d[0] == "admit":
                 req = by_id[d[1]]
                 with self._lock:
                     self._queued.remove(req)
+                self._plan_last.pop(req.id, None)
                 events.extend(self.engine.admit(req))
             elif d[0] == "shed":
                 req = by_id[d[1]]
                 with self._lock:
                     self._queued.remove(req)
+                self._plan_last.pop(req.id, None)
                 record_shed(req.id, req.tenant, d[2])
+                _tracing.span(req.trace, "shed", reason=d[2],
+                              tenant=req.tenant)
+                self.slo.record(req.tenant, False, now_abs,
+                                trace_id=(req.trace.trace_id
+                                          if req.trace is not None
+                                          else None))
                 self._emit(req.id, {"kind": "shed", "reason": d[2]},
                            final=True)
         with self._lock:
@@ -387,9 +471,20 @@ class ServingServer(BackgroundHTTPServer):
                 payload = {"kind": "token", "token": ev.token}
                 if ev.first:
                     payload["first"] = True
-                    payload["ttft_s"] = (
-                        now_mono - ev.request.arrival_mono
-                        if ev.request.arrival_mono else None)
+                    ttft = (now_mono - ev.request.arrival_mono
+                            if ev.request.arrival_mono else None)
+                    payload["ttft_s"] = ttft
+                    # First token = the SLO moment: good when it beat
+                    # the request's own deadline (or the replica-wide
+                    # TTFT target; no target at all = always good).
+                    target = (ev.request.deadline_s
+                              or self.slo_ttft_s or 0.0)
+                    ok = ttft is None or target <= 0.0 or ttft <= target
+                    self.slo.record(
+                        ev.request.tenant, ok, now_mono,
+                        trace_id=(ev.request.trace.trace_id
+                                  if ev.request.trace is not None
+                                  else None))
                 self._emit(ev.request.id, payload, final=False)
             else:
                 self._emit(ev.request.id,

@@ -11,7 +11,22 @@ import sys
 import numpy as np
 import pytest
 
+import _loadprobe
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The conftest SIGALRM marks below must stretch with the machine just
+# like _run's internal q.get/join deadlines do: a 4-proc case under
+# load legitimately takes 120·factor s of queue wait, so a nominal
+# 180 s alarm fires first and reads as a hang (the
+# body_duplicate_name_error flake).  Probe ONLY in the pytest process:
+# the spawn-context workers re-import this module during their
+# multiprocessing bootstrap, where starting the probe's own process is
+# forbidden (and wedges the worker before it ever posts a result).
+if mp.current_process().name == "MainProcess":
+    _FACTOR = _loadprobe.load_factor("native_matrix")
+else:  # spawn-child re-import: marks are never evaluated here
+    _FACTOR = 1.0
 
 try:
     import ml_dtypes
@@ -50,8 +65,7 @@ def _run(fn_name, size=4, env=None):
     # sized for an idle box flake (the net_resilience drills hit this
     # first; the 4-proc matrix sweep pays 4 spawns per case and flaked
     # the same way).
-    import _loadprobe
-    factor = _loadprobe.load_factor("native_matrix")
+    factor = _FACTOR
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -324,6 +338,7 @@ def body_reducescatter(ctl, rank, size):
     "body_device_placement_mismatch_error", "body_alltoall_dtype_matrix",
     "body_minmaxprod_dtype_matrix",
 ])
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_native_matrix_4proc(body):
     _run(body, size=4)
 
@@ -347,7 +362,7 @@ def body_cache_eviction_churn(ctl, rank, size):
     return True
 
 
-@pytest.mark.timeout(180)
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_cache_bit_determinism_across_eviction():
     _run("body_cache_eviction_churn", size=4,
          env={"HVD_TPU_CACHE_CAPACITY": "4"})
@@ -356,10 +371,12 @@ def test_cache_bit_determinism_across_eviction():
 @pytest.mark.parametrize("body", [
     "body_dtype_matrix_allreduce", "body_op_matrix",
 ])
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_native_matrix_3proc(body):
     # Non-power-of-two world: ring math must not assume 2^k ranks.
     _run(body, size=3)
 
 
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_reducescatter_through_public_api():
     _run("body_reducescatter", size=4)

@@ -10,7 +10,16 @@ import textwrap
 
 import pytest
 
+import _loadprobe
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Harness deadlines (the worker communicate() wait AND the SIGALRM
+# timeout marks below) scale by the measured machine-load factor —
+# each case pays two spawned interpreters plus a 4 MiB allreduce, and
+# wall clocks sized for an idle box flake under concurrent sandbox
+# load exactly like the native 4-proc matrix did.
+_FACTOR = _loadprobe.load_factor("shm_transport")
 
 
 def _free_port():
@@ -55,25 +64,25 @@ def _run_pair(env0, env1):
             [sys.executable, "-c", script, str(rank), str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env))
-    outs = [p.communicate(timeout=90) for p in procs]
+    outs = [p.communicate(timeout=90 * _FACTOR) for p in procs]
     for p, (o, e) in zip(procs, outs):
         assert p.returncode == 0, (o, e)
     assert "DONE 0" in outs[0][0] and "DONE 1" in outs[1][0]
 
 
-@pytest.mark.timeout(180)
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_asymmetric_shm_disable_falls_back_to_tcp():
     # One rank opts out of shm: the pair must agree (handshake stays
     # aligned) and all traffic rides TCP correctly.
     _run_pair({"HVD_TPU_DISABLE_SHM": "1"}, {})
 
 
-@pytest.mark.timeout(180)
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_shm_disabled_everywhere():
     _run_pair({"HVD_TPU_DISABLE_SHM": "1"}, {"HVD_TPU_DISABLE_SHM": "1"})
 
 
-@pytest.mark.timeout(180)
+@pytest.mark.timeout(int(180 * _FACTOR))
 def test_shm_enabled_no_segment_leak():
     _run_pair({}, {})
     leaked = [f for f in os.listdir("/dev/shm") if f.startswith("hvt_")]

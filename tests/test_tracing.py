@@ -1,0 +1,603 @@
+"""Request-scoped tracing + per-tenant SLO error budgets (ISSUE 19):
+sampling determinism under a fixed seed, header/state roundtrips, SLO
+burn-rate goldens, burn-aware policy/autoscaler goldens, engine span
+coverage with tracing-on/off bit-identity, THE migration drill — a
+traced request's spans stitched across two replicas' clock-offset
+flight dumps into one Chrome trace — plus the HTTP surface
+(``x-hvd-trace`` honored, ``/serve/stats`` SLO + exemplars,
+``last_iteration_age_s``/``loop_stalled``), the ``merge --trace`` CLI,
+hang-report in-flight trace ids, knob clamps, and the flight-event
+vocabulary."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu.debug import flight  # noqa: E402
+from horovod_tpu.debug import hang  # noqa: E402
+from horovod_tpu.debug import merge  # noqa: E402
+from horovod_tpu.debug import regression as R  # noqa: E402
+from horovod_tpu.metrics.registry import registry  # noqa: E402
+from horovod_tpu.models import transformer as tfm  # noqa: E402
+from horovod_tpu.runner.rendezvous import _signature  # noqa: E402
+from horovod_tpu.serving import disagg  # noqa: E402
+from horovod_tpu.serving import policy as P  # noqa: E402
+from horovod_tpu.serving import slo  # noqa: E402
+from horovod_tpu.serving import tracing  # noqa: E402
+from horovod_tpu.serving.autoscale import desired_np  # noqa: E402
+from horovod_tpu.serving.engine import DecodeEngine, Request  # noqa: E402
+from horovod_tpu.serving.server import ServingServer  # noqa: E402
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+    seq_len=64, dtype=jnp.float32, remat=False)
+PAGE = 8
+PROMPT = [5, 9, 13, 2, 7, 11, 3, 1, 6, 4, 12, 8, 10, 14, 15, 16, 17]
+N_OUT = 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG,
+                           tfm.ParallelConfig())
+
+
+def _engine(params, slots=2, **kw):
+    kw.setdefault("prefix_cache", False)
+    return DecodeEngine(CFG, params, slots=slots, page_tokens=PAGE,
+                        max_len=32, **kw)
+
+
+def _greedy(engine, prompt, n=N_OUT, rid="r", **req_kw):
+    out, done = [], False
+    evs = engine.admit(Request(id=rid, prompt=list(prompt),
+                               max_new_tokens=n, **req_kw))
+    while True:
+        for e in evs:
+            if e.request.id != rid:
+                continue
+            if e.kind == "token":
+                out.append(e.token)
+            elif e.kind == "finish":
+                done = True
+        if done:
+            return out
+        evs = engine.step()
+
+
+@pytest.fixture(scope="module")
+def ref_out(params):
+    return _greedy(_engine(params), PROMPT)
+
+
+def _ctx(rid="r"):
+    """A forced-sampled context (explicit rate, no env dependence)."""
+    return tracing.mint(rid, rate=1.0, seed=0)
+
+
+def _trace_events(trace_id):
+    return [ev for ev in flight.recorder().snapshot()
+            if str(ev.get("kind", "")).startswith("trace.")
+            and ev.get("name") == trace_id]
+
+
+# ---------------------------------------------------------------------------
+# Trace context: determinism, sampling, header/state roundtrips
+# ---------------------------------------------------------------------------
+
+def test_trace_id_deterministic_under_seed():
+    a = tracing.derive_trace_id("req-1", seed=0)
+    assert a == tracing.derive_trace_id("req-1", seed=0)
+    assert a != tracing.derive_trace_id("req-1", seed=1)
+    assert a != tracing.derive_trace_id("req-2", seed=0)
+    assert len(a) == 32 and int(a, 16) >= 0
+    s = tracing.derive_span_id(a, "decode", seq=3)
+    assert s == tracing.derive_span_id(a, "decode", seq=3)
+    assert s != tracing.derive_span_id(a, "decode", seq=4)
+    assert s != tracing.derive_span_id(a, "prefill", seq=3)
+    assert len(s) == 16
+
+
+def test_sampling_deterministic_and_rate_shaped():
+    ids = [tracing.derive_trace_id(f"r{i}", seed=7) for i in range(2000)]
+    assert all(tracing.sampled(t, rate=1.0) for t in ids)
+    assert not any(tracing.sampled(t, rate=0.0) for t in ids)
+    picked = [t for t in ids if tracing.sampled(t, rate=0.1)]
+    # Deterministic: the SAME subset on a second pass (and on any
+    # replica — the decision is a pure function of the trace id).
+    assert picked == [t for t in ids if tracing.sampled(t, rate=0.1)]
+    assert 0.05 < len(picked) / len(ids) < 0.2
+
+
+def test_header_roundtrip_and_malformed():
+    ctx = _ctx("h")
+    back = tracing.parse_header(ctx.header())
+    assert back == ctx
+    off = tracing.TraceContext(trace_id=ctx.trace_id,
+                               span_id=ctx.span_id, sampled=False)
+    assert tracing.parse_header(off.header()).sampled is False
+    for bad in (None, "", "zz", "nothex" * 8,
+                "ab" * 16,                       # missing parts
+                "ab" * 16 + "-" + "cd" * 8,      # missing flag
+                "ab" * 15 + "-" + "cd" * 8 + "-01",   # short trace id
+                "ab" * 16 + "-" + "cd" * 7 + "-01"):  # short span id
+        assert tracing.parse_header(bad) is None
+
+
+def test_mint_header_wins_over_local_rate():
+    hdr = _ctx("upstream").header()
+    ctx = tracing.mint("local-id", header=hdr, rate=0.0, seed=0)
+    assert ctx.trace_id == _ctx("upstream").trace_id
+    assert ctx.sampled is True          # client's flag wins over rate=0
+    # Malformed header falls back to local minting.
+    ctx = tracing.mint("local-id", header="garbage", rate=1.0, seed=0)
+    assert ctx.trace_id == tracing.derive_trace_id("local-id", seed=0)
+
+
+def test_state_roundtrip_for_migration():
+    ctx = _ctx("mig")
+    d = tracing.to_state(ctx)
+    assert json.loads(json.dumps(d)) == d        # wire-safe
+    assert tracing.from_state(d) == ctx
+    assert tracing.to_state(None) is None
+    assert tracing.from_state(None) is None
+    assert tracing.from_state({"trace_id": "xx"}) is None
+
+
+def test_span_is_noop_unless_sampled():
+    flight.recorder().clear()
+    ctx = _ctx("sampled-span")
+    off = tracing.TraceContext(trace_id=ctx.trace_id,
+                               span_id=ctx.span_id, sampled=False)
+    tracing.span(None, "decode", x=1)
+    tracing.span(off, "decode", x=1)
+    assert _trace_events(ctx.trace_id) == []
+    tracing.span(ctx, "decode", x=1)
+    evs = _trace_events(ctx.trace_id)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["kind"] == "trace.decode" and ev["name"] == ctx.trace_id
+    assert ev["parent"] == ctx.span_id and ev["x"] == 1
+    assert ev["span"] == tracing.derive_span_id(ctx.trace_id, "decode")
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets: pure goldens + tracker window semantics
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_goldens():
+    assert slo.burn_rate(0, 0, 0.99) == 0.0
+    assert slo.burn_rate(100, 0, 0.99) == 0.0
+    assert slo.burn_rate(99, 1, 0.99) == pytest.approx(1.0)
+    assert slo.burn_rate(98, 2, 0.99) == pytest.approx(2.0)
+    assert slo.burn_rate(999, 1, 0.99) == pytest.approx(0.1)
+    assert slo.burn_rate(0, 1, 1.0) == float("inf")
+    assert slo.budget_remaining(999, 1, 0.99) == pytest.approx(0.9)
+    assert slo.budget_remaining(90, 10, 0.99) == 0.0    # clamped at 0
+    assert slo.budget_remaining(0, 0, 0.99) == 1.0
+
+
+def test_slo_tracker_window_and_burning():
+    tr = slo.SloTracker(target=0.9, window_s=10.0, burn_threshold=1.0)
+    t0 = 1000.0
+    for i in range(8):
+        tr.record("a", True, t0 + i * 0.1)
+    tr.record("a", False, t0 + 1.0, trace_id="deadbeef" * 4)
+    # 8 good + 1 bad at target 0.9: burn = (1/9)/0.1 = 10/9.
+    assert tr.burn("a", t0 + 1.0) == pytest.approx(10.0 / 9.0)
+    assert tr.burn_rates(t0 + 1.0) == {"a": pytest.approx(10.0 / 9.0)}
+    assert "a" in tr.burning(t0 + 1.0)
+    assert tr.max_burn(t0 + 1.0) == pytest.approx(10.0 / 9.0)
+    st = tr.stats(t0 + 1.0)
+    assert st["target"] == 0.9 and st["window_s"] == 10.0
+    ten = st["tenants"]["a"]
+    assert ten["good"] == 8 and ten["bad"] == 1
+    assert ten["last_miss_trace"] == "deadbeef" * 4
+    assert ten["budget_remaining"] == 0.0
+    # The window forgets: everything expires after window_s.
+    assert tr.burn("a", t0 + 100.0) == 0.0
+    assert tr.burning(t0 + 100.0) == {}
+    # Gauges were exported per tenant.
+    g = registry().gauge("hvd_slo_burn_rate", tenant="a")
+    assert g.value == 0.0 or g.value >= 0.0   # exists; numeric
+
+
+def test_slo_gauges_exported():
+    tr = slo.SloTracker(target=0.99, window_s=60.0)
+    tr.record("gold", False, 5.0)
+    burn = registry().gauge("hvd_slo_burn_rate", tenant="gold")
+    budget = registry().gauge("hvd_slo_budget_remaining", tenant="gold")
+    assert burn.value == pytest.approx(100.0)
+    assert budget.value == 0.0
+    tr.record("gold", True, 100.0)           # first event expired
+    assert registry().gauge("hvd_slo_burn_rate",
+                            tenant="gold").value == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Burn-aware policy + autoscaler goldens
+# ---------------------------------------------------------------------------
+
+def test_plan_burning_tenant_admitted_first():
+    vs = [P.RequestView(id="b1", tenant="b", submit_seq=1,
+                        arrival_s=0.0),
+          P.RequestView(id="a1", tenant="a", submit_seq=2,
+                        arrival_s=0.0)]
+    # Without a burn signal, FIFO wins: b1 (earlier submit) admits.
+    d = {x[1]: x[0] for x in P.plan(vs, free_slots=1, free_pages=8,
+                                    now_s=1.0)}
+    assert d["b1"] == "admit" and d["a1"] == "wait"
+    # With tenant a burning, a1 jumps the line — deterministically.
+    d = {x[1]: x[0] for x in P.plan(vs, free_slots=1, free_pages=8,
+                                    now_s=1.0, burn={"a": 1.5},
+                                    burn_threshold=1.0)}
+    assert d["a1"] == "admit" and d["b1"] == "wait"
+    # Under threshold the signal is inert.
+    d = {x[1]: x[0] for x in P.plan(vs, free_slots=1, free_pages=8,
+                                    now_s=1.0, burn={"a": 0.99},
+                                    burn_threshold=1.0)}
+    assert d["b1"] == "admit"
+
+
+def test_plan_overload_sheds_burning_tenant_last():
+    vs = [P.RequestView(id="a1", tenant="a", submit_seq=1),
+          P.RequestView(id="b2", tenant="b", submit_seq=2),
+          P.RequestView(id="b3", tenant="b", submit_seq=3)]
+    d = {x[1]: x[0] for x in P.plan(vs, free_slots=0, free_pages=0,
+                                    now_s=1.0, queue_cap=1,
+                                    burn={"a": 2.0}, burn_threshold=1.0)}
+    shed = {k for k, v in d.items() if v == "shed"}
+    assert shed == {"b2", "b3"}          # burning a1 survives overload
+    assert d["a1"] == "wait"
+
+
+def test_desired_np_burn_goldens():
+    # Burn at/over threshold forces scale-up even with an empty queue.
+    assert desired_np(2, 1, 4, queue_depth=0, target_queue=4.0,
+                      burn_rate=1.0, burn_threshold=1.0) == 3
+    # Burn above half-threshold blocks scale-down.
+    assert desired_np(2, 1, 4, queue_depth=0, target_queue=4.0,
+                      occupancy=0.0, burn_rate=0.6,
+                      burn_threshold=1.0) == 2
+    # Cool tenant set: idle replica scales down as before.
+    assert desired_np(2, 1, 4, queue_depth=0, target_queue=4.0,
+                      occupancy=0.0, burn_rate=0.1,
+                      burn_threshold=1.0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine span coverage + bit-identity
+# ---------------------------------------------------------------------------
+
+def test_engine_emits_spans_and_output_is_bit_identical(params, ref_out):
+    flight.recorder().clear()
+    ctx = _ctx("traced")
+    eng = _engine(params, prefix_cache=True, prefill_chunk=4)
+    out = _greedy(eng, PROMPT, rid="traced", trace=ctx)
+    assert out == ref_out                # tracing-on == tracing-off
+    kinds = {ev["kind"] for ev in _trace_events(ctx.trace_id)}
+    assert {"trace.admit", "trace.prefix", "trace.prefill",
+            "trace.decode", "trace.finish"} <= kinds
+    # Decode spans carry batch occupancy; prefill spans chunk progress.
+    dec = [ev for ev in _trace_events(ctx.trace_id)
+           if ev["kind"] == "trace.decode"]
+    assert dec and all(0.0 < ev["occupancy"] <= 1.0 for ev in dec)
+    pre = [ev for ev in _trace_events(ctx.trace_id)
+           if ev["kind"] == "trace.prefill"]
+    assert len(pre) >= 2                 # 17-token prompt, chunk=4
+    assert pre[-1]["done"] is True
+    # An unsampled request leaves NOTHING in the ring.
+    flight.recorder().clear()
+    off = tracing.TraceContext(trace_id=ctx.trace_id,
+                               span_id=ctx.span_id, sampled=False)
+    out2 = _greedy(_engine(params), PROMPT, rid="t2", trace=off)
+    assert out2 == ref_out
+    assert _trace_events(ctx.trace_id) == []
+
+
+def test_speculative_rounds_emit_spans(params, ref_out):
+    from horovod_tpu.serving import speculative as spec
+    flight.recorder().clear()
+    ctx = _ctx("spec")
+    dcfg = tfm.draft_config(CFG, 1)
+    dparams = tfm.draft_params_from(params, 1)
+    eng = _engine(params, draft=spec.DraftSpec(cfg=dcfg, params=dparams,
+                                               k=3))
+    out = _greedy(eng, PROMPT, rid="spec", trace=ctx)
+    assert out == ref_out
+    rounds = [ev for ev in _trace_events(ctx.trace_id)
+              if ev["kind"] == "trace.speculate"]
+    assert rounds
+    for ev in rounds:
+        assert 0 <= ev["accepted"] <= ev["proposed"]
+
+
+# ---------------------------------------------------------------------------
+# THE drill: migration over real HTTP, stitched across two replicas
+# ---------------------------------------------------------------------------
+
+def test_migrated_trace_stitches_across_replicas(params, ref_out):
+    """A traced request prefills on replica A, migrates over the real
+    recovery transport, finishes on replica B.  Each replica's flight
+    dump carries a DIFFERENT clock-offset estimate; ``filter_trace`` +
+    ``merge_dumps`` must still produce one Chrome trace whose aligned
+    timeline orders A's export before B's adopt."""
+    from horovod_tpu.recovery import transport
+    rec = flight.recorder()
+    src = _engine(params)
+    dst = _engine(params)
+    server = transport.RecoveryServer(host="127.0.0.1")
+    port = server.start()
+    addr = f"127.0.0.1:{port}"
+    ctx = _ctx("mig")
+    try:
+        # --- replica A (prefill): admit + export + push ----------------
+        rec.clear()
+        evs = src.admit(Request(id="mig", prompt=list(PROMPT),
+                                max_new_tokens=N_OUT, trace=ctx))
+        toks = [e.token for e in evs if e.kind == "token"]
+        disagg.send(src, "mig", addr, bits=0)
+        rec.set_clock(0.25, rtt_s=0.001, method="test")
+        dump_a = rec.dump_obj()
+        dump_a["rank"] = 0
+        dump_a["host"] = "prefill-replica"
+
+        # --- replica B (decode): adopt + finish -------------------------
+        rec.clear()
+        assert disagg.receive(dst, "mig", addr)
+        done = False
+        while not done:
+            for e in dst.step():
+                if e.kind == "token":
+                    toks.append(e.token)
+                elif e.kind == "finish":
+                    done = True
+        rec.set_clock(-0.25, rtt_s=0.001, method="test")
+        dump_b = rec.dump_obj()
+        dump_b["rank"] = 1
+        dump_b["host"] = "decode-replica"
+        assert toks == ref_out           # migration stayed exact
+    finally:
+        server.stop()
+        rec.set_clock(0.0, method="none")
+
+    # The trace context rode the wire: B's spans carry A's trace id.
+    kinds_a = {ev["kind"] for ev in dump_a["events"]
+               if ev.get("name") == ctx.trace_id}
+    kinds_b = {ev["kind"] for ev in dump_b["events"]
+               if ev.get("name") == ctx.trace_id}
+    assert {"trace.admit", "trace.migrate_export",
+            "trace.migrate"} <= kinds_a
+    assert {"trace.migrate_adopt", "trace.decode",
+            "trace.finish"} <= kinds_b
+
+    # Filter + merge: one single-request trace, two process rows.
+    filtered = merge.filter_trace([dump_a, dump_b], ctx.trace_id)
+    assert len(filtered) == 2
+    assert all(str(ev.get("kind")).startswith("trace.")
+               for d in filtered for ev in d["events"])
+    trace = merge.merge_dumps(filtered)
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    by_cat = {}
+    for e in spans:
+        by_cat.setdefault(e["cat"], e)
+    # Clock alignment: with A's clock read as +0.25s ahead and B's as
+    # -0.25s behind, the raw wall times are ~0.5s apart but the ALIGNED
+    # timeline must still put A's export strictly before B's adopt.
+    assert (by_cat["trace.migrate_export"]["ts"]
+            < by_cat["trace.migrate_adopt"]["ts"])
+    assert all(e["ts"] >= 0 for e in spans)
+
+    # A non-matching trace id filters to nothing.
+    assert merge.filter_trace([dump_a, dump_b], "f" * 32) == []
+
+
+def test_merge_cli_trace_flag(params, tmp_path, capsys):
+    rec = flight.recorder()
+    rec.clear()
+    ctx = _ctx("cli")
+    _greedy(_engine(params), PROMPT, rid="cli", trace=ctx)
+    dump = rec.dump_obj()
+    dump["rank"] = 0
+    path = tmp_path / "flight_rank0.json"
+    path.write_text(json.dumps(dump))
+    out = tmp_path / "one_request.json"
+    assert merge.main([str(path), "-o", str(out),
+                       "--trace", ctx.trace_id]) == 0
+    trace = json.loads(out.read_text())
+    cats = {e.get("cat") for e in trace["traceEvents"]}
+    assert "trace.admit" in cats and "trace.finish" in cats
+    assert all(str(c).startswith("trace.") for c in cats if c)
+    # Unknown trace id: empty trace + a loud hint on stderr.
+    assert merge.main([str(path), "-o", str(out),
+                       "--trace", "e" * 32]) == 0
+    err = capsys.readouterr().err
+    assert "no spans found" in err
+
+
+def test_hang_report_names_in_flight_traces(params):
+    rec = flight.recorder()
+    rec.clear()
+    ctx = _ctx("stuck")
+    eng = _engine(params)
+    eng.admit(Request(id="stuck", prompt=list(PROMPT),
+                      max_new_tokens=N_OUT, trace=ctx))
+    dump = rec.dump_obj()
+    report = hang.build_hang_report([], {0: dump}, world=1, step=0)
+    slots = report["ranks"]["0"]["serving_in_flight"]
+    assert any(s.get("request") == "stuck"
+               and s.get("trace") == ctx.trace_id
+               for s in slots.values())
+    # Retire clears the slot from the published meta.
+    _drain(eng, "stuck")
+    report = hang.build_hang_report([], {0: rec.dump_obj()},
+                                    world=1, step=0)
+    slots = report["ranks"]["0"].get("serving_in_flight", {})
+    assert not any(s.get("request") == "stuck" for s in slots.values())
+
+
+def _drain(engine, rid):
+    while True:
+        for e in engine.step():
+            if e.kind == "finish" and e.request.id == rid:
+                return
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: header in, trace id out, SLO stats, loop health
+# ---------------------------------------------------------------------------
+
+def _post(port, body, headers=None, secret="s3cret"):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/serve/generate", data=data,
+        headers={"Content-Type": "application/json"})
+    req.add_header("X-HVD-Signature",
+                   _signature(secret, "POST", "serve", "generate", data))
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(port, path, secret="s3cret"):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/serve/{path}")
+    req.add_header("X-HVD-Signature",
+                   _signature(secret, "GET", "serve", path, b""))
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_http_trace_header_and_slo_stats(params):
+    flight.recorder().clear()
+    eng = _engine(params)
+    srv = ServingServer(eng, port=0, secret="s3cret", queue_cap=8)
+    port = srv.serve()
+    try:
+        ctx = _ctx("client-chosen")
+        out = _post(port, {"tokens": list(PROMPT), "max_new_tokens": 2},
+                    headers={"x-hvd-trace": ctx.header()})
+        # The response echoes the propagated context verbatim.
+        assert out["trace"] == ctx.header()
+        evs = _trace_events(ctx.trace_id)
+        kinds = {ev["kind"] for ev in evs}
+        assert "trace.ingress" in kinds and "trace.finish" in kinds
+        # An ok request lands a good SLO event for its tenant.
+        stats = _get(port, "stats")
+        ten = stats["slo"]["tenants"]["default"]
+        assert ten["good"] >= 1 and ten["burn_rate"] == 0.0
+        assert stats["slo"]["target"] > 0.5
+        assert "ttft_exemplars" in stats
+        assert stats["last_iteration_age_s"] < 60.0
+        assert stats["loop_stalled"] is False
+        # A sampled request's trace id is the TTFT exemplar.
+        ex = stats["ttft_exemplars"]
+        assert any(v.get("ref") == ctx.trace_id for v in ex.values())
+        # An impossible deadline burns its tenant's budget...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, {"tokens": list(PROMPT), "max_new_tokens": 2,
+                         "tenant": "slow", "deadline_s": 1e-9})
+        assert ei.value.code == 503
+        stats = _get(port, "stats")
+        slow = stats["slo"]["tenants"]["slow"]
+        assert slow["bad"] >= 1
+        assert slow["burn_rate"] >= stats["slo"]["burn_threshold"]
+        assert slow["budget_remaining"] == 0.0
+        # ...and the burn signal reaches the autoscaler's math.
+        burn_max = max(t["burn_rate"]
+                       for t in stats["slo"]["tenants"].values())
+        assert desired_np(1, 1, 4, queue_depth=0, target_queue=4.0,
+                          burn_rate=burn_max,
+                          burn_threshold=stats["slo"]["burn_threshold"]
+                          ) == 2
+        # Health surface: alive loop, fresh iteration age.
+        hz = _get(port, "healthz")
+        assert hz["ok"] is True and hz["loop_stalled"] is False
+        assert hz["last_iteration_age_s"] < 60.0
+        assert registry().gauge("hvd_serving_loop_stalled").value == 0.0
+    finally:
+        srv.close()
+
+
+def test_loop_stalled_detection(params):
+    eng = _engine(params)
+    srv = ServingServer(eng, port=0, secret=None)
+    # Never served: no loop thread, so "stalled" cannot trigger.
+    assert srv.loop_health()["stalled"] is False
+    # A live-but-wedged loop (thread alive, iteration age >> tick).
+    sleeper = threading.Thread(target=time.sleep, args=(5.0,),
+                               daemon=True)
+    sleeper.start()
+    srv._loop_thread = sleeper
+    srv._last_iter_mono = time.monotonic() - 120.0
+    h = srv.loop_health()
+    assert h["stalled"] is True and h["last_iteration_age_s"] > 100.0
+    assert registry().gauge("hvd_serving_loop_stalled").value == 1.0
+    sleeper.join()
+
+
+# ---------------------------------------------------------------------------
+# Knobs, histogram exemplars, flight vocabulary
+# ---------------------------------------------------------------------------
+
+def test_trace_slo_knobs_single_sourced_and_clamped(monkeypatch):
+    from horovod_tpu.core.config import Config
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "5.0")
+    monkeypatch.setenv("HVD_TPU_TRACE_SEED", "42")
+    monkeypatch.setenv("HVD_TPU_SLO_TARGET", "0.1")
+    monkeypatch.setenv("HVD_TPU_SLO_WINDOW_S", "-5")
+    monkeypatch.setenv("HVD_TPU_SLO_BURN_THRESHOLD", "0")
+    c = Config.from_env()
+    assert c.trace_sample == 1.0         # clamped into [0, 1]
+    assert c.trace_seed == 42
+    assert c.slo_target == 0.5           # clamped into [0.5, 0.9999]
+    assert c.slo_window_s == 1.0         # floor
+    assert c.slo_burn_threshold == 0.01  # floor
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "-1")
+    monkeypatch.setenv("HVD_TPU_SLO_TARGET", "2")
+    c = Config.from_env()
+    assert c.trace_sample == 0.0 and c.slo_target == 0.9999
+    # The use-sites read the same knobs.
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "1.0")
+    assert tracing.sample_rate() == 1.0
+    assert tracing.trace_seed() == 42
+    assert tracing.mint("any-request").sampled is True
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "0.0")
+    assert tracing.mint("any-request").sampled is False
+
+
+def test_histogram_exemplars_last_writer_wins():
+    reg = registry()
+    h = reg.histogram("test_exemplar_hist", buckets=(1.0, 10.0))
+    h.reset()
+    h.observe(0.5, exemplar="first")
+    h.observe(0.7, exemplar="second")
+    h.observe(5.0)                       # no exemplar: bucket untouched
+    h.observe(50.0, exemplar="tail")
+    ex = h.exemplars()
+    assert ex["1.0"]["ref"] == "second"            # last writer wins
+    assert ex["1.0"]["value"] == 0.7
+    assert "10.0" not in ex                        # never exemplared
+    assert ex["+Inf"] == {"value": 50.0, "ref": "tail"}
+    h.reset()
+    assert h.exemplars() == {}
+
+
+def test_flight_vocabulary_covers_trace_events():
+    assert R.EVENT_SUBSYSTEM.get("trace.") == "serving"
+    for kind in ("trace.ingress", "trace.plan", "trace.admit",
+                 "trace.prefix", "trace.prefill", "trace.decode",
+                 "trace.speculate", "trace.finish"):
+        assert kind in R._CORROBORATING
+    # Stalls, sheds, and migrations stay suspect-eligible.
+    for kind in ("trace.swap_stall", "trace.shed", "trace.migrate",
+                 "trace.migrate_export", "trace.migrate_adopt"):
+        assert kind not in R._CORROBORATING
