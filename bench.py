@@ -3823,6 +3823,230 @@ def bench_zero():
     })
 
 
+def bench_xla_quant():
+    """Quantized collectives INSIDE the compiled GSPMD plane
+    (`bench.py --bench xla_quant` → BENCH_XLA_QUANT.json):
+
+    (a) compiled-plane wire-bytes parity — the analytic per-step bytes
+        the traced schedule puts on the wire (the same accounting the
+        kind="gspmd" metrics record), int8 must beat 3.9x and int4 7.7x
+        vs fp32 at block 256, matching the eager BENCH_QUANT arithmetic;
+    (b) hierarchical cross-host byte reduction at (local, cross) =
+        (2, 2): the compiled plan's cross bytes vs the flat schedule's,
+        golden against the eager compressed_allreduce_hierarchical
+        formula (reduction == local-size on aligned payloads);
+    (c) stage-3 world-4 steps/sec, quantized vs fp32 wire — on this CPU
+        sandbox the wire is memory-local so the quantize/dequantize
+        FLOPs are pure overhead; parity-within-noise is disclosed, the
+        bytes win is the claim (the wire-constrained regime is TPU ICI);
+    (d) convergence: seeded toy run through make_zero_train_step, int8 +
+        error feedback within 1% of the fp32 loss, bit-identical when
+        compression=none.  Pure CPU; never touches an accelerator."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    n = int(os.environ.get("BENCH_SCALING_DEVICES", "4"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(n, 4)}"
+        ).strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    if jax.device_count() < n:
+        raise SystemExit(
+            f"bench xla_quant needs {n} virtual devices, got "
+            f"{jax.device_count()} (jax imported before the XLA flag?)")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import DATA_AXIS
+    from horovod_tpu.ops import gspmd
+    from horovod_tpu.ops import quantization as Qz
+    from horovod_tpu.ops import xla_collectives as XC
+
+    hvd.init()
+    mesh = Mesh(np.array(jax.devices()[:n]), (DATA_AXIS,))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    d = int(os.environ.get("BENCH_ZERO_WIDTH", "512"))
+    layers = int(os.environ.get("BENCH_ZERO_STACK", "4"))
+    key = jax.random.PRNGKey(0)
+    params = {}
+    for i in range(layers):
+        key, k1 = jax.random.split(key)
+        params[f"w{i}"] = jax.random.normal(k1, (d, d),
+                                            jnp.float32) * 0.02
+        params[f"b{i}"] = jnp.zeros((d,), jnp.float32)
+    sizes = [int(l.size) for l in jax.tree_util.tree_leaves(params)]
+
+    def loss_fn(p, batch):
+        x, = batch
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean(h ** 2)
+
+    tx = optax.adamw(1e-3)
+    batch = (jnp.asarray(np.random.RandomState(1).randn(8 * n, d),
+                         dtype=jnp.float32),)
+
+    # --- (a) compiled-plane wire parity (analytic, = metrics source) --
+    spec8 = Qz.QuantSpec(bits=8, block=256)
+    spec4 = Qz.QuantSpec(bits=4, block=256)
+    plan8 = XC.plan_allreduce_step(sizes, spec=spec8)
+    plan4 = XC.plan_allreduce_step(sizes, spec=spec4)
+    ratio8 = plan8.raw / plan8.sent
+    ratio4 = plan4.raw / plan4.sent
+    sys.stderr.write(
+        f"  compiled wire parity at block 256: int8 {ratio8:.3f}x "
+        f"(bar 3.9), int4 {ratio4:.3f}x (bar 7.7)\n")
+
+    # --- (b) hierarchical cross-byte reduction golden -----------------
+    L, Cx = 2, 2
+    n_elems = 1 << 20
+    hier = XC.hierarchical_allreduce_wire_bytes(n_elems, L, Cx, spec8)
+    cross_reduction = hier["cross_flat"] / hier["cross"]
+    # Eager formula: phase 2 moves the 1/L shard both ways.
+    npad = n_elems + (-n_elems) % (L * 256)
+    shard = npad // L
+    spad = shard + (-shard) % (Cx * 256)
+    assert hier["cross"] == 2 * Qz.wire_bytes(spad, spec8)
+    assert hier["cross_flat"] == 2 * Qz.wire_bytes(npad, spec8)
+    sys.stderr.write(
+        f"  hierarchical (L={L}, C={Cx}): cross bytes shrink "
+        f"{cross_reduction:.3f}x vs flat (golden: local size {L}x on "
+        "aligned payloads)\n")
+
+    # --- (c) stage-3 steps/sec, quantized vs fp32 wire ----------------
+    def runner(compression):
+        fns = gspmd.make_zero_train_step(loss_fn, tx, mesh, stage=3,
+                                         compression=compression)
+        p, s = fns.init(params)
+        p, s, _ = fns.step(p, s, batch)  # compile + warm
+
+        def run():
+            nonlocal p, s
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, s, loss = fns.step(p, s, batch)
+            jax.block_until_ready(loss)
+            return iters / (time.perf_counter() - t0)
+        return max(run() for _ in range(3))  # best-of: sandbox jitter
+
+    sps_fp32 = runner(None)
+    sps_int8 = runner(hvd.Compression.int8)
+    uplift = sps_int8 / sps_fp32
+    sys.stderr.write(
+        f"  stage-3 world {n}: fp32 wire {sps_fp32:.2f} steps/s, int8 "
+        f"wire {sps_int8:.2f} steps/s ({uplift:.3f}x)\n")
+
+    # --- (d) convergence: int8 + EF within 1% of fp32, none bit-eq ----
+    def converge(compression, steps=20):
+        fns = gspmd.make_zero_train_step(loss_fn, tx, mesh, stage=3,
+                                         compression=compression)
+        p, s = fns.init(params)
+        loss = None
+        for _ in range(steps):
+            p, s, loss = fns.step(p, s, batch)
+        return float(loss), p
+
+    loss_fp, p_fp = converge(None)
+    loss_q, _ = converge(hvd.Compression.int8)
+    loss_none, p_none = converge("none")
+    rel = abs(loss_q - loss_fp) / max(abs(loss_fp), 1e-12)
+    bit_identical = loss_none == loss_fp and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(p_fp),
+                        jax.tree_util.tree_leaves(p_none)))
+    sys.stderr.write(
+        f"  convergence: fp32 {loss_fp:.6f} vs int8+EF {loss_q:.6f} "
+        f"({rel * 100:.4f}% rel, bar 1%); compression=none "
+        f"bit-identical: {bit_identical}\n")
+
+    artifact = {
+        "schema": "horovod_tpu xla quantized collectives bench v1",
+        "world": n,
+        "environment": {
+            "host_cores": os.cpu_count(),
+            "note": ("virtual CPU mesh: the wire is memory-local, so "
+                     "steps/sec prices the quantize/dequantize compute "
+                     "overhead with NO bandwidth to win back, so the "
+                     "quantized arm reads SLOWER here; the uplift "
+                     "regime is wire-constrained TPU ICI.  The bytes "
+                     "ratios are "
+                     "exact analytic properties of the traced "
+                     "schedule (the kind=\"gspmd\" metrics source)."),
+        },
+        "wire_parity": {
+            "block": 256,
+            "int8_x": round(ratio8, 4),
+            "int8_bar_x": 3.9,
+            "int8_within_bar": bool(ratio8 >= 3.9),
+            "int4_x": round(ratio4, 4),
+            "int4_bar_x": 7.7,
+            "int4_within_bar": bool(ratio4 >= 7.7),
+            "param_bytes_per_step_fp32": int(plan8.raw),
+            "param_bytes_per_step_int8": int(plan8.sent),
+            "param_bytes_per_step_int4": int(plan4.sent),
+        },
+        "hierarchical": {
+            "local_size": L,
+            "cross_size": Cx,
+            "payload_elems": n_elems,
+            "cross_bytes_flat": int(hier["cross_flat"]),
+            "cross_bytes_hier": int(hier["cross"]),
+            "cross_reduction_x": round(cross_reduction, 4),
+            "golden": "matches eager compressed_allreduce_hierarchical",
+        },
+        "stage3_steps_per_sec": {
+            "fp32_wire": round(sps_fp32, 3),
+            "int8_wire": round(sps_int8, 3),
+            "int8_vs_fp32_x": round(uplift, 4),
+            "note": ("CPU sandbox: quantization is pure compute "
+                     "overhead here (no wire to shrink), so the int8 "
+                     "arm reads slower — disclosed, not hidden; the "
+                     "bytes parity above is the portable claim"),
+        },
+        "convergence": {
+            "loss_fp32": loss_fp,
+            "loss_int8_ef": loss_q,
+            "rel_err": round(rel, 6),
+            "bar": 0.01,
+            "within_bar": bool(rel <= 0.01),
+            "compression_none_bit_identical": bool(bit_identical),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_XLA_QUANT.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    _emit({
+        "metric": "xla_quant_wire_parity_int8",
+        "value": round(ratio8, 4),
+        "unit": ("x fp32 bytes per compiled stage-3 step on the int8 "
+                 "block-256 wire (analytic traced-schedule accounting; "
+                 f"int4 {ratio4:.3f}x)"),
+        "bar_x": 3.9,
+        "within_bar": bool(ratio8 >= 3.9),
+        "int4_x": round(ratio4, 4),
+        "int4_within_bar": bool(ratio4 >= 7.7),
+        "hier_cross_reduction_x": round(cross_reduction, 4),
+        "stage3_int8_vs_fp32_steps_x": round(uplift, 4),
+        "convergence_rel_err": round(rel, 6),
+        "convergence_within_1pct": bool(rel <= 0.01),
+        "compression_none_bit_identical": bool(bit_identical),
+        "artifact": "BENCH_XLA_QUANT.json",
+    })
+
+
 def bench_moe():
     """Third mesh dimensions (`bench.py --bench moe` → BENCH_MOE.json):
     (a) tokens/sec of the (dp, ep) MoE workload class across expert
@@ -4140,6 +4364,8 @@ def main():
         return bench_zero()  # CPU mesh + local TCP job; no chip
     if mode == "moe":
         return bench_moe()  # CPU mesh; never touches the chip
+    if mode == "xla_quant":
+        return bench_xla_quant()  # CPU mesh; never touches the chip
     if mode == "net_resilience":
         return bench_net_resilience()  # host-only TCP loopback job
     if mode == "fleet":

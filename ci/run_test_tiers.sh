@@ -95,6 +95,13 @@ TIER_FAST=(
   # surface (`bench.py --bench warmstart` measures time-to-best-config).
   test_tuning_loop.py
   test_utils_ops.py
+  # Compiled-plane quantized + topology-scheduled collectives (ISSUE
+  # 20): lowering purity (no host callbacks), N-rank sum-error analytic
+  # bounds under shard_map, EF convergence parity vs fp32, stage-2/3
+  # GSPMD parity quantized-vs-not + compression=none bit-identity,
+  # checkpointed residual round-trip, hierarchical cross-byte goldens,
+  # dispatch-table/pin schedule selection.
+  test_xla_collectives.py
   # ZeRO-2/3 weight-update sharding (ISSUE 14): stage parity, the
   # forward-prefetch gather, the GSPMD NamedSharding plane, and the
   # world-4 -> world-2 / (dp, mp) mesh-change restore drill.
@@ -149,15 +156,40 @@ tier_budget_s() {
   esac
 }
 
+# The budgets are sized for an idle machine; a loaded box stretches the
+# whole suite uniformly, so the printed VERDICT scales by the same
+# measured load factor the wall-clock tests use (tests/_loadprobe.py),
+# disclosed once on stderr.  The raw idle-machine budget stays in the
+# line so per-PR drift remains comparable across runs.
+load_factor() {
+  if [[ -z "${_LOAD_FACTOR:-}" ]]; then
+    _LOAD_FACTOR=$(python - <<'EOF' 2>/dev/null || echo 1.0
+import sys
+sys.path.insert(0, "tests")
+import _loadprobe
+print(f"{_loadprobe.load_factor('ci_tiers'):.2f}")
+EOF
+)
+    echo "ci_tiers: scaling tier budget verdicts by measured load" \
+         "factor ${_LOAD_FACTOR}x" >&2
+  fi
+  echo "$_LOAD_FACTOR"
+}
+
 report_tier_time() {
   # Printed on success AND failure (EXIT path): wall seconds vs budget
   # with the consumed percentage, e.g. "tier fast: 812s / 870s (93%)".
+  # The percentage is against the load-scaled budget; the idle budget
+  # and the factor are both in the line so neither is hidden.
   local name="$1" start="$2" rc="$3"
   local wall=$(( SECONDS - start ))
   local budget; budget=$(tier_budget_s "$name")
-  local pct=$(( wall * 100 / budget ))
-  echo "=== tier ${name} wall time: ${wall}s / ${budget}s budget" \
-       "(${pct}% used, exit ${rc}) ==="
+  local factor; factor=$(load_factor)
+  local scaled; scaled=$(awk -v b="$budget" -v f="$factor" \
+                         'BEGIN { printf "%d", b * f }')
+  local pct=$(( wall * 100 / scaled ))
+  echo "=== tier ${name} wall time: ${wall}s / ${scaled}s budget" \
+       "(${budget}s idle x ${factor} load, ${pct}% used, exit ${rc}) ==="
 }
 
 run_tier() {

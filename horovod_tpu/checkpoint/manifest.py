@@ -50,7 +50,18 @@ def spec_fingerprint(leaves: List["LeafSpec"]) -> str:
     model/optimizer (a different *run*) must not."""
     h = hashlib.sha256()
     for leaf in leaves:
-        h.update(f"{leaf.path}|{leaf.kind}|{leaf.dtype}|"
+        kind = leaf.kind
+        if len(leaf.shape) == 1 and int(leaf.shape[0]) == int(leaf.true_size):
+            # A full 1-D vector of true_size elements is the one layout
+            # two planes describe differently: the flat ZeRO plane calls
+            # it SHARDED (a padded buffer threaded over ranks), the GSPMD
+            # plane REPLICATED (a dense value the partitioner shards).
+            # Which label a restore TARGET gets depends on the world the
+            # plan is evaluated under, so hashing the label would make
+            # the fingerprint world-dependent exactly where the logical
+            # content is identical.  Canonicalize it.
+            kind = "vector"
+        h.update(f"{leaf.path}|{kind}|{leaf.dtype}|"
                  f"{leaf.true_size}\n".encode())
     return h.hexdigest()
 

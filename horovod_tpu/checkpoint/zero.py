@@ -167,6 +167,21 @@ def _plan_zero_state(z, path_prefix: str, world: int,
         vec_count += 1
         padded = true + ((-true) % world)
         size = int(np.prod(leaf.shape))
+        if ndim >= 2 or (size == true and size != padded
+                         and size != padded // world):
+            # GSPMD-plane state (ops/gspmd.py compressed steps): the
+            # moment leaves are PARAM-shaped global arrays — the XLA
+            # partitioner owns their sharding, so they commit as full
+            # dense values (world-invariant; single-controller commit,
+            # like every replicated leaf).  A 1-D param whose size
+            # happens to equal the padded flat buffer lands in the
+            # "global" branch instead — identical bytes and shape
+            # either way.
+            spec = M.LeafSpec(path=pstr, kind=M.REPLICATED,
+                              shape=list(leaf.shape),
+                              dtype=_leaf_dtype(leaf), true_size=size)
+            plans.append(_LeafPlan(spec, "replicated"))
+            continue
         if size == padded:
             threaded = "global"
         elif size == padded // world:
@@ -189,6 +204,46 @@ def _plan_zero_state(z, path_prefix: str, world: int,
             f"leaves, not a multiple of the {n_params} parameter leaves; "
             "the inner transform does not follow the optax per-parameter "
             "tree convention")
+    if getattr(z, "residual", None) is not None:
+        # Error-feedback residuals (quantized wires): one flat fp32 run
+        # per parameter leaf, rank-DISTINCT like the moments but sized
+        # in TRUE elements per rank — globally (world * true,), no
+        # padding (world divides the global size by construction).
+        # true_size records the global size, which pins the checkpoint
+        # to the writing world: an elastic N->M restore of EF residuals
+        # has no meaningful reshard (each rank's error belongs to the
+        # gradients IT quantized), so the fingerprint refusing the
+        # cross-world restore is the correct behavior — reset the
+        # residual to zeros for a world change (docs/zero.md).
+        res_paths, _ = jax.tree_util.tree_flatten_with_path(z.residual)
+        res_count = 0
+        for (path, leaf) in res_paths:
+            pstr = path_prefix + ".residual" + _keystr(path)
+            true = true_sizes[res_count % n_params]
+            res_count += 1
+            rt = true * world
+            size = int(np.prod(getattr(leaf, "shape", ()))) \
+                if getattr(leaf, "shape", ()) else 1
+            if size == rt:
+                threaded = "global"
+            elif size == true:
+                threaded = "per-rank"
+            elif not validate:
+                threaded = "global"
+            else:
+                raise ValueError(
+                    f"ZeRO residual leaf {pstr} has {size} elements; "
+                    f"expected the global buffer ({rt}) or one rank's "
+                    f"error view ({true}) for true size {true} at world "
+                    f"{world}")
+            spec = M.LeafSpec(path=pstr, kind=M.SHARDED, shape=[rt],
+                              dtype=_leaf_dtype(leaf), true_size=rt)
+            plans.append(_LeafPlan(spec, threaded))
+        if res_count % n_params != 0:
+            raise ValueError(
+                f"ZeRO state under {path_prefix} has {res_count} "
+                f"residual leaves, not a multiple of the {n_params} "
+                "parameter leaves")
     return plans
 
 
@@ -280,7 +335,12 @@ def zero_state_specs(state, axis_name: Optional[str] = None):
             lambda l: P(ax) if getattr(l, "ndim", 0) >= 1 else P(),
             z.inner)
         sizes = jax.tree_util.tree_map(lambda l: P(), z.sizes)
-        return type(z)(inner=inner, sizes=sizes)
+        kw = {}
+        if getattr(z, "residual", None) is not None:
+            kw["residual"] = jax.tree_util.tree_map(
+                lambda l: P(ax) if getattr(l, "ndim", 0) >= 1 else P(),
+                z.residual)
+        return type(z)(inner=inner, sizes=sizes, **kw)
 
     return jax.tree_util.tree_map(
         lambda l: _zero_specs(l) if _is_zero(l) else P(),
@@ -626,6 +686,8 @@ def _ordered_leaves(tree) -> List[Any]:
         if _is_zero(leaf):
             leaves.extend(jax.tree_util.tree_leaves(leaf.sizes))
             leaves.extend(jax.tree_util.tree_leaves(leaf.inner))
+            if getattr(leaf, "residual", None) is not None:
+                leaves.extend(jax.tree_util.tree_leaves(leaf.residual))
         else:
             leaves.append(leaf)
     return leaves
@@ -643,8 +705,17 @@ def _plan_tree_like(like, manifest: M.Manifest):
             f"{len(manifest.leaves)} leaves but the restore target has "
             f"{len(plans)}; structures must match "
             f"(first checkpoint leaf: {manifest.leaves[0].path})")
+    def _full_vector(spec):
+        # The flat-vs-dense ambiguity spec_fingerprint canonicalizes
+        # (manifest.py): a full 1-D vector classifies SHARDED or
+        # REPLICATED depending on the world the target plan was
+        # evaluated under.  The saved spec wins below either way.
+        return (len(spec.shape) == 1
+                and int(spec.shape[0]) == int(spec.true_size))
+
     for plan, saved in zip(plans, manifest.leaves):
-        if plan.spec.kind != saved.kind:
+        if plan.spec.kind != saved.kind and not (
+                _full_vector(plan.spec) and _full_vector(saved)):
             raise ValueError(
                 f"leaf {saved.path}: checkpoint kind {saved.kind} != "
                 f"target kind {plan.spec.kind}")
@@ -664,9 +735,16 @@ def _rebuild(groups, outer_def, new_leaves: List[Any]):
             outer_leaves.append(vals[0])
         else:
             n_sizes = len(jax.tree_util.tree_leaves(template.sizes))
+            n_inner = len(jax.tree_util.tree_leaves(template.inner))
             sizes_def = jax.tree_util.tree_structure(template.sizes)
             inner_def = jax.tree_util.tree_structure(template.inner)
             sizes = jax.tree_util.tree_unflatten(sizes_def, vals[:n_sizes])
-            inner = jax.tree_util.tree_unflatten(inner_def, vals[n_sizes:])
-            outer_leaves.append(ZeroState(inner=inner, sizes=sizes))
+            inner = jax.tree_util.tree_unflatten(
+                inner_def, vals[n_sizes:n_sizes + n_inner])
+            kw = {}
+            if getattr(template, "residual", None) is not None:
+                res_def = jax.tree_util.tree_structure(template.residual)
+                kw["residual"] = jax.tree_util.tree_unflatten(
+                    res_def, vals[n_sizes + n_inner:])
+            outer_leaves.append(ZeroState(inner=inner, sizes=sizes, **kw))
     return jax.tree_util.tree_unflatten(outer_def, outer_leaves)

@@ -74,6 +74,7 @@ OVERLAP_BUCKET_BYTES = "OVERLAP_BUCKET_BYTES"  # bucket size; pins autotune
 # (off = one monolithic gather before forward).
 ZERO_STAGE = "ZERO_STAGE"                      # 1 | 2 | 3
 ZERO_PREFETCH = "ZERO_PREFETCH"                # bucketed forward gathers
+ZERO_QUANT_GATHER = "ZERO_QUANT_GATHER"        # quantized stage-3 gathers
 # Metrics subsystem (horovod_tpu/metrics/).
 METRICS_SYNC_STEPS = "METRICS_SYNC_STEPS"      # cross-rank cadence; 0 = off
 METRICS_PORT = "METRICS_PORT"                  # Prometheus port; 0 = off
@@ -314,6 +315,12 @@ class Config:
     # and the stage-3 forward-prefetch schedule (docs/zero.md).
     zero_stage: int = 1
     zero_prefetch: bool = True
+    # Opt-in: put the stage-3 parameter gather itself on the quantized
+    # wire (ops/overlap.gather_in_forward, ops/gspmd).  Off by default —
+    # a gather has no error-feedback channel, so its loss (one bounded
+    # qdq round trip per step; the sharded master stays fp32) lands on
+    # the forward.  docs/compression.md prices the trade.
+    zero_quant_gather: bool = False
     # Metrics: registry always records locally; cross-rank aggregation
     # and the scrape endpoint are opt-in (both default off).
     metrics_sync_steps: int = 0
@@ -514,6 +521,8 @@ class Config:
         # run unsharded (0) or invent a stage 4.
         cfg.zero_stage = min(3, max(1, get_int(ZERO_STAGE, cfg.zero_stage)))
         cfg.zero_prefetch = get_bool(ZERO_PREFETCH, cfg.zero_prefetch)
+        cfg.zero_quant_gather = get_bool(ZERO_QUANT_GATHER,
+                                         cfg.zero_quant_gather)
         cfg.metrics_sync_steps = max(
             0, get_int(METRICS_SYNC_STEPS, cfg.metrics_sync_steps))
         cfg.metrics_port = get_int(METRICS_PORT, cfg.metrics_port)

@@ -246,7 +246,40 @@ def make_train_step(cfg: MoEConfig, par: MoEParallelConfig, mesh,
                 is_leaf=lambda x: isinstance(x, P)))
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    return jitted, shard_params
+
+    quant = cfg.quant_spec()
+    if quant is None:
+        return jitted, shard_params
+
+    # Quantized dispatch wire: account the per-step all_to_all bytes
+    # analytically (2 exchanges x n_layers per member; the compiled
+    # plane has no per-op host hook) into the kind="gspmd" wire
+    # counters — see docs/metrics.md.
+    from ..ops import xla_collectives as XC
+    members = par.dp * par.ep
+    n_local_experts = cfg.n_experts // par.ep
+    plans: Dict[int, XC.StepWireBytes] = {}
+
+    def _wire_plan(global_batch: int) -> XC.StepWireBytes:
+        n_local_tok = max(1, global_batch // members) * cfg.seq_len
+        cap = moe_lib.expert_capacity(
+            n_local_tok, cfg.n_experts, cfg.capacity_factor, cfg.top_k)
+        raw = 2 * cfg.n_layers * moe_lib.dispatch_wire_bytes(
+            par.ep, n_local_experts, cap, cfg.d_model, None)
+        sent = 2 * cfg.n_layers * moe_lib.dispatch_wire_bytes(
+            par.ep, n_local_experts, cap, cfg.d_model, quant)
+        return XC.StepWireBytes(raw=raw, sent=sent)
+
+    def metered_step(params, opt_state, tokens, labels):
+        out = jitted(params, opt_state, tokens, labels)
+        b = int(tokens.shape[0])
+        plan = plans.get(b)
+        if plan is None:
+            plan = plans[b] = _wire_plan(b)
+        XC.record_wire_bytes(plan.raw, plan.sent)
+        return out
+
+    return metered_step, shard_params
 
 
 def serial_forward_logits(cfg: MoEConfig, params: Dict[str, Any],

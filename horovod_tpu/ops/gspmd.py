@@ -51,17 +51,36 @@ def _shardable(leaf, world: int) -> bool:
     return len(shape) >= 1 and shape[0] % world == 0 and shape[0] > 0
 
 
-def leaf_spec(leaf, mesh, sharded: bool, axis: str = DATA_AXIS):
+def _axis_world(mesh, axis) -> int:
+    """Total shard count for ``axis`` — a mesh axis name or a tuple of
+    names (e.g. ``("local", "cross")``), whose world is the product."""
+    if isinstance(axis, str):
+        return int(mesh.shape[axis])
+    world = 1
+    for ax in axis:
+        world *= int(mesh.shape[ax])
+    return world
+
+
+def _dim0_spec(axis):
+    """The PartitionSpec dim-0 entry for ``axis``: the bare name, or the
+    tuple (dim 0 sharded over the axes jointly, local-major — matching
+    the nested hierarchical collective layouts)."""
+    return axis if isinstance(axis, str) else tuple(axis)
+
+
+def leaf_spec(leaf, mesh, sharded: bool, axis=DATA_AXIS):
     """The ``PartitionSpec`` for one leaf: dim-0 sharded over ``axis``
-    when requested and divisible, replicated otherwise."""
+    (a name or an axis tuple) when requested and divisible, replicated
+    otherwise."""
     from jax.sharding import PartitionSpec as P
-    world = int(mesh.shape[axis])
+    world = _axis_world(mesh, axis)
     if sharded and _shardable(leaf, world):
-        return P(axis)
+        return P(_dim0_spec(axis))
     return P()
 
 
-def zero_shardings(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+def zero_shardings(tree, mesh, sharded: bool, axis=DATA_AXIS):
     """NamedSharding pytree for ``tree``: dim-0 sharded over ``axis``
     where divisible (``sharded=True``), replicated otherwise."""
     jax = _jax()
@@ -70,7 +89,7 @@ def zero_shardings(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
         tree)
 
 
-def place(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+def place(tree, mesh, sharded: bool, axis=DATA_AXIS):
     """``device_put`` every leaf at its ZeRO residency."""
     jax = _jax()
     return jax.tree_util.tree_map(
@@ -79,7 +98,7 @@ def place(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
         tree)
 
 
-def constrain(tree, mesh, sharded: bool, axis: str = DATA_AXIS):
+def constrain(tree, mesh, sharded: bool, axis=DATA_AXIS):
     """``with_sharding_constraint`` every leaf — the in-trace pin the
     partitioner must honor (this is what makes gradient shards REAL at
     stage >= 2: the constraint forces the reduce-scatter early, so the
@@ -103,7 +122,7 @@ class ZeroStepFns(NamedTuple):
 
 
 def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
-                         axis: str = DATA_AXIS):
+                         axis=DATA_AXIS, compression=None):
     """Build the GSPMD-native ZeRO training step.
 
     ``loss_fn(params, batch) -> scalar`` is written for the GLOBAL
@@ -120,8 +139,33 @@ def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
       partitioner inserts per-tensor forward allgathers and schedules
       them ahead of first use).
 
-    The XLA partitioner owns every collective: the same step scales to
-    any mesh shape without touching this code.
+    ``axis`` is a mesh axis name or a ``("local", "cross")`` tuple —
+    the tuple shards over the product and unlocks the hierarchical
+    compressed schedules below.
+
+    ``compression`` (``hvd.Compression.{fp16,bf16,int8,int4}``, a name,
+    or None → the session ``HVD_TPU_COMPRESSION`` knob) puts the
+    gradient synchronization on the compressed wire INSIDE the compiled
+    step (``ops/xla_collectives.py``): the gradients are computed
+    per-shard in a ``shard_map`` island, error-feedback-corrected
+    (quantized wires carry a flat fp32 residual in the returned
+    ``_ZeroState``-wrapped optimizer state — checkpointed with the
+    moments), and allreduced on the two-pass quantized/cast schedule
+    with fp32 accumulation; with a tuple ``axis`` the hierarchical
+    schedule is selected per payload bucket at trace time from the
+    PR 11 dispatch table.  Two contract changes under compression:
+    (1) ``loss_fn`` must AVERAGE over the batch dimension (the standard
+    data-parallel contract — the global mean is recovered as the mean
+    of per-shard means); (2) the optimizer state is wrapped in
+    ``optimizers._ZeroState`` (``inner``/``sizes``/``residual``) so the
+    sharded checkpoint engine carries the residual.  With the wire
+    resolved to none, this function is BIT-IDENTICAL to the
+    uncompressed builder — same trace, same treedefs, no wrapper.
+
+    The XLA partitioner owns every structural collective (the stage-3
+    parameter gathers stay fp32 XLA-scheduled gathers; the shard_map
+    plane's ``gather_in_forward`` owns the quantized-gather opt-in);
+    the same step scales to any mesh shape without touching this code.
     """
     jax = _jax()
     import optax  # noqa: F401 — documented dependency of tx
@@ -133,8 +177,14 @@ def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
     if stage not in (1, 2, 3):
         raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
 
+    from . import xla_collectives as XC
+    spec, wire_dtype = XC.resolve_wire(compression)
+    compressed = spec is not None or wire_dtype is not None
+
     params_sharded = stage >= 3
-    batch_sh = named_sharding(mesh, axis)
+    axes = XC.axes_of(axis)
+    world = _axis_world(mesh, axis)
+    batch_sh = named_sharding(mesh, _dim0_spec(axis))
 
     def init(params):
         params = place(params, mesh, params_sharded, axis)
@@ -142,7 +192,23 @@ def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
             tx.init,
             out_shardings=zero_shardings(
                 jax.eval_shape(tx.init, params), mesh, True, axis))(params)
-        return params, opt_state
+        if not compressed:
+            return params, opt_state
+        import jax.numpy as jnp
+
+        from ..optimizers import _ZeroState
+        residual = None
+        if spec is not None:
+            # One flat fp32 residual element per (rank, param element):
+            # globally (world * n,), sharded over the dp axis so each
+            # rank holds exactly its own (n,) error view.
+            residual = place(jax.tree_util.tree_map(
+                lambda p: jnp.zeros((world * p.size,), jnp.float32),
+                params), mesh, True, axis)
+        sizes = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p.size, jnp.int32), params)
+        return params, _ZeroState(inner=opt_state, sizes=sizes,
+                                  residual=residual)
 
     def _step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -155,21 +221,99 @@ def make_zero_train_step(loss_fn, tx, mesh, stage: Optional[int] = None,
             params = constrain(params, mesh, True, axis)
         return params, opt_state, loss
 
+    def _island(params, residual, batch):
+        """Per-shard grads + EF + compressed allreduce, as a shard_map
+        island inside the jitted step: under automatic partitioning the
+        unreduced per-shard gradient never exists as a logical value,
+        so the quantized wire needs this one explicit-SPMD region.  The
+        rest of the step (optimizer update, param add, residency
+        constraints) stays on the automatic plane."""
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+        from . import collective as C
+        from . import quantization as Q
+
+        def body(p, r, b):
+            loss, g = jax.value_and_grad(loss_fn)(p, b)
+            new_r = r
+            if spec is not None:
+                fed = jax.tree_util.tree_map(
+                    lambda gi, ri: gi.astype(jnp.float32)
+                    + ri.reshape(gi.shape), g, r)
+                # Flat qdq == the exact first-pass wire error here: the
+                # schedule pads to world*block, whose blocks are a
+                # superset of the flat-padded blocks (extra blocks are
+                # all-zero and quantize exactly).
+                new_r = jax.tree_util.tree_map(
+                    lambda f: jnp.ravel(f) - jnp.ravel(Q.qdq(f, spec)),
+                    fed)
+                g = jax.tree_util.tree_map(
+                    lambda f, gi: f.astype(gi.dtype), fed, g)
+            g = jax.tree_util.tree_map(
+                lambda t: XC.allreduce_scheduled(
+                    t, C.Average, axes, spec=spec, wire_dtype=wire_dtype),
+                g)
+            loss = lax.pmean(loss, XC.axis_arg(axes))
+            return loss, g, new_r
+
+        dp = P(_dim0_spec(axis))
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P(), dp, dp),
+                         out_specs=(P(), P(), dp),
+                         check_vma=False)(params, residual, batch)
+
+    def _step_compressed(params, opt_state, batch):
+        loss, grads, new_residual = _island(params, opt_state.residual,
+                                            batch)
+        if stage >= 2:
+            grads = constrain(grads, mesh, True, axis)
+        updates, inner = tx.update(grads, opt_state.inner, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        if params_sharded:
+            params = constrain(params, mesh, True, axis)
+        opt_state = opt_state._replace(inner=inner,
+                                       residual=new_residual)
+        return params, opt_state, loss
+
     compiled = {}  # one jit wrapper per (params, state) treedef pair
 
     def step(params, opt_state, batch):
         key = (jax.tree_util.tree_structure(params),
                jax.tree_util.tree_structure(opt_state))
-        fn = compiled.get(key)
-        if fn is None:
+        entry = compiled.get(key)
+        if entry is None:
             p_sh = zero_shardings(params, mesh, params_sharded, axis)
             s_sh = zero_shardings(opt_state, mesh, True, axis)
             fn = jax.jit(
-                _step,
+                _step_compressed if compressed else _step,
                 in_shardings=(p_sh, s_sh, batch_sh),
                 out_shardings=(p_sh, s_sh, named_sharding(mesh)))
-            compiled[key] = fn
-        return fn(params, opt_state, batch)
+            plan = None
+            if compressed:
+                # Analytic wire accounting for the traced schedule —
+                # priced once per treedef, recorded per step call
+                # (kind="gspmd", docs/metrics.md).
+                if len(axes) == 2:
+                    lsz, csz = (int(mesh.shape[axes[0]]),
+                                int(mesh.shape[axes[1]]))
+                else:
+                    lsz, csz = world, 1
+                plan = XC.plan_allreduce_step(
+                    [int(l.size) for l in
+                     jax.tree_util.tree_leaves(params)],
+                    local_size=lsz, cross_size=csz, spec=spec,
+                    wire_dtype=wire_dtype)
+            entry = (fn, plan)
+            compiled[key] = entry
+        fn, plan = entry
+        out = fn(params, opt_state, batch)
+        if plan is not None:
+            XC.record_wire_bytes(plan.raw, plan.sent)
+        return out
 
     return ZeroStepFns(init=init, step=step, stage=stage)
 

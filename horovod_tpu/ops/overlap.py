@@ -570,21 +570,45 @@ def bucketed_reducescatter_tree(grads, op=None, axis_name=None,
 # compiled plane: ZeRO-3 forward-prefetch parameter gather
 # ---------------------------------------------------------------------------
 
-def _bucket_allgather(shards, likes, axis_name, world: int):
+def _bucket_allgather(shards, likes, axis_name, world: int, comp=None):
     """One bucket = one allgather: concatenate the per-rank flat param
     shards, gather once, and slice each leaf's full value back out.
 
     The gathered buffer is rank-major — ``(world, sum_k)`` with rank
     *r*'s row holding its slice of every leaf — so a leaf's full flat
     value is the column block ``[off, off+k)`` across all rows, exactly
-    the ``(world, k)`` padded layout ``_my_shard`` sliced at init."""
+    the ``(world, k)`` padded layout ``_my_shard`` sliced at init.
+
+    ``comp`` (opt-in — see ``gather_in_forward(quantize_gather=...)``)
+    puts the gather itself on the compressed wire: the concatenated
+    shard is quantized (or cast) ONCE, the payload + scales gather, and
+    the receiver dequantizes ONCE.  Lossy for quantized wires — a
+    gather has no error-feedback channel — but the error is one qdq
+    round trip per step and does not accumulate (the master copy stays
+    full-precision in the shards)."""
     import jax.numpy as jnp
     from jax import lax
 
     ks = [int(s.size) for s in shards]
     cat = jnp.concatenate([jnp.ravel(s) for s in shards]) \
         if len(shards) > 1 else jnp.ravel(shards[0])
-    full = lax.all_gather(cat, axis_name, tiled=True).reshape(world, -1)
+    if comp is not None and jnp.issubdtype(cat.dtype, jnp.floating):
+        from . import quantization as Q
+        spec = comp.spec()
+        if spec is not None:
+            q, s = Q.quantize(cat, spec)
+            q = lax.all_gather(q, axis_name, tiled=True)
+            s = lax.all_gather(s, axis_name, tiled=True)
+            npad = int(cat.size) + (-int(cat.size)) % spec.block
+            full = Q.dequantize(q, s, spec, world * npad)
+            full = full.reshape(world, npad)[:, :int(cat.size)]
+        else:
+            g = lax.all_gather(cat.astype(comp.wire_dtype), axis_name,
+                               tiled=True)
+            full = g.astype(jnp.float32).reshape(world, -1)
+    else:
+        full = lax.all_gather(cat, axis_name, tiled=True) \
+            .reshape(world, -1)
     outs, off = [], 0
     for like, k in zip(likes, ks):
         flat = full[:, off: off + k].reshape(-1)
@@ -594,7 +618,8 @@ def _bucket_allgather(shards, likes, axis_name, world: int):
     return outs
 
 
-def _make_gather_tag(likes, op, axis_name, compression, world: int):
+def _make_gather_tag(likes, op, axis_name, compression, world: int,
+                     gather_comp=None):
     """An identity from a bucket's param SHARDS to its FULL params whose
     forward is the bucket's allgather and whose VJP is the bucket's
     gradient reduce-scatter — ZeRO-3 in one ``custom_vjp``: reverse-mode
@@ -607,7 +632,7 @@ def _make_gather_tag(likes, op, axis_name, compression, world: int):
     @jax.custom_vjp
     def tag(*shards):
         return tuple(_bucket_allgather(list(shards), likes, axis_name,
-                                       world))
+                                       world, gather_comp))
 
     def fwd(*shards):
         return tag(*shards), None
@@ -626,7 +651,8 @@ def _make_gather_tag(likes, op, axis_name, compression, world: int):
 
 def gather_in_forward(shards_tree, like, op=None, axis_name=None,
                       compression=None, bucket_bytes: Optional[int] = None,
-                      prefetch: Optional[bool] = None):
+                      prefetch: Optional[bool] = None,
+                      quantize_gather: Optional[bool] = None):
     """ZeRO-3 forward-prefetch: rebuild full parameters from per-rank
     flat shards with one allgather per size-bounded bucket, emitted as
     independent collectives XLA can schedule AHEAD of the forward layers
@@ -634,7 +660,14 @@ def gather_in_forward(shards_tree, like, op=None, axis_name=None,
     Differentiating through the result reduce-scatters the cotangents
     per bucket, so gradients come back as shards (``compression`` rides
     that reduce-scatter exactly as in the stage-1/2 path; the parameter
-    gather itself stays full-precision).
+    gather itself stays full-precision by default).
+
+    ``quantize_gather`` (default: the ``HVD_TPU_ZERO_QUANT_GATHER``
+    knob, off) opts the parameter gather itself onto ``compression``'s
+    wire: quantize once → gather payload + scales → dequantize once.
+    Lossy — a gather has no error-feedback channel — but bounded to one
+    qdq round trip per step (the sharded master copy stays
+    full-precision), and the VJP reduce-scatter is unchanged.
 
     ``like`` supplies the static full shapes/dtypes (the params template
     — live arrays or ``jax.eval_shape`` structs).  ``prefetch=False``
@@ -661,6 +694,15 @@ def gather_in_forward(shards_tree, like, op=None, axis_name=None,
         # mismatched all_gather emissions (the exact desync
         # resolve_bucket_bytes(compiled=True) exists to prevent).
         bucket_bytes = _config().overlap_bucket_bytes
+    if quantize_gather is None:
+        # Env-derived config only, same rank-consistency argument as
+        # bucket_bytes above (this runs inside compiled SPMD traces).
+        quantize_gather = bool(getattr(_config(), "zero_quant_gather",
+                                       False))
+    gather_comp = None
+    if quantize_gather and \
+            getattr(compression, "wire", "none") != "none":
+        gather_comp = compression  # per-bucket float check at gather time
 
     s_leaves, s_def = jax.tree_util.tree_flatten(shards_tree)
     l_leaves = jax.tree_util.tree_leaves(like)
@@ -680,7 +722,7 @@ def gather_in_forward(shards_tree, like, op=None, axis_name=None,
     out: List[Any] = [None] * len(s_leaves)
     for idxs in plan.buckets:
         tag = _make_gather_tag([l_leaves[i] for i in idxs], op, ax,
-                               compression, world)
+                               compression, world, gather_comp)
         fulls = tag(*[s_leaves[i] for i in idxs])
         for j, i in enumerate(idxs):
             out[i] = fulls[j]

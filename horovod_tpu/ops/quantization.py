@@ -248,8 +248,15 @@ def page_wire_bytes(n: int, spec: Optional[QuantSpec]) -> int:
 # compiled-path schedules (inside jit/shard_map over a named mesh axis)
 # ---------------------------------------------------------------------------
 
-def _axis_size(axis_name: str) -> int:
+def _axis_size(axis_name) -> int:
     from ..compat import axis_size
+    if isinstance(axis_name, (tuple, list)):
+        # Joint axis (e.g. ("local", "cross")): the collective world is
+        # the product.  lax.axis_size rejects tuples on some versions.
+        world = 1
+        for ax in axis_name:
+            world *= axis_size(ax)
+        return world
     return axis_size(axis_name)
 
 
@@ -415,6 +422,58 @@ def compressed_allreduce_hierarchical(x, local_axis: str, cross_axis: str,
     if postscale != 1.0:
         out = out * postscale
     return out.reshape(x.shape).astype(x.dtype)
+
+
+def compressed_allgather(x, axis_name, spec: Optional[QuantSpec] = None,
+                         wire_dtype=None, nested: bool = True):
+    """Compressed all-gather over ``axis_name`` (a mesh axis name or a
+    tuple of names, e.g. ``("local", "cross")``): each member contributes
+    its local tensor; every member ends with the dim-0 concatenation in
+    the input dtype.
+
+    The payload is compressed ONCE at the source and decompressed ONCE at
+    the destination — for a tuple axis the quantized payload + scales ride
+    every intermediate hop untouched (``nested=True``, the hierarchical
+    schedule: gather over the last axis first, so only 1/L of the bytes
+    ever cross the outer axis), or a single gather over the joint axis
+    (``nested=False``, the flat schedule).  Either way there is no
+    re-quantization between hops, so the value is identical and the loss
+    is exactly one quantize→dequantize round trip.
+
+    Unlike the reduce schedules, a gather has NO error-feedback channel:
+    the quantization loss lands on the consumer.  Callers opt in
+    explicitly (see ``HVD_TPU_ZERO_QUANT_GATHER``).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if (spec is None) == (wire_dtype is None):
+        raise ValueError("exactly one of spec/wire_dtype must be set")
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    world = 1
+    for ax in axes:
+        world *= _axis_size(ax)
+    hops = [axes[i] for i in range(len(axes) - 1, -1, -1)] if nested \
+        else [axes[0] if len(axes) == 1 else axes]
+    flat = jnp.ravel(x).astype(jnp.float32)
+    n = flat.size
+    if spec is None:
+        g = flat.astype(wire_dtype)
+        for ax in hops:
+            g = lax.all_gather(g, ax, tiled=True)
+        full = g.astype(jnp.float32).reshape(world, n)
+    else:
+        q, s = quantize(flat, spec)
+        for ax in hops:
+            q = lax.all_gather(q, ax, tiled=True)
+            s = lax.all_gather(s, ax, tiled=True)
+        npad = n + (-n) % spec.block
+        full = dequantize(q, s, spec, world * npad).reshape(world, npad)
+        full = full[:, :n]
+    if x.ndim == 0:
+        return full.reshape(world).astype(x.dtype)
+    out = full.reshape((world * x.shape[0],) + x.shape[1:])
+    return out.astype(x.dtype)
 
 
 def compressed_reducescatter(x, axis_name: str, op: int,

@@ -1,0 +1,325 @@
+"""Quantized + topology-scheduled collectives for the compiled GSPMD plane.
+
+The eager/native plane rides the block-scaled int8/int4 two-pass wire
+(ops/quantization.py) and the probed hierarchical dispatch tables
+(ops/dispatch.py).  This module closes the eager/compiled feature gap
+(ROADMAP item 3): the same wire formats and the same schedule selection,
+expressed as jit-traceable, shard_map-safe primitives — EQuARX
+(arXiv:2506.17615) is "quantized allreduce *in XLA*", and this is where
+the XLA half lives.
+
+Three layers:
+
+* **Scheduled collectives** — :func:`allreduce_scheduled`,
+  :func:`reducescatter_scheduled`, :func:`allgather_scheduled`,
+  :func:`all_to_all_wire`: pure-``jnp`` wrappers over the quantization
+  engine that accept a mesh axis name OR a ``("local", "cross")`` axis
+  tuple and pick flat vs hierarchical per payload bucket AT TRACE TIME
+  from the same dispatch table the native controller stamps
+  (:func:`choose_schedule`).  No host callbacks — the choice is burned
+  into the lowered program, exactly like the coordinator's
+  response-stream stamp is burned into a negotiated batch.
+* **Analytic wire accounting** — the compiled plane cannot meter bytes
+  per op at runtime (XLA owns the schedule), so
+  :func:`plan_allreduce_step` / :func:`hierarchical_allreduce_wire_bytes`
+  price the traced schedule analytically from static shapes, and
+  :func:`record_wire_bytes` feeds the ``kind="gspmd"`` wire counters
+  (``hvd_wire_bytes_{raw,sent}_total`` / ``hvd_wire_compression_ratio``)
+  once per host-level step call — the PR 10 attribution/drift machinery
+  sees the compiled plane with the same metric names as the eager one.
+* **Wire resolution** — :func:`resolve_wire` normalizes a
+  ``compression=`` argument (class / name / None → the session
+  ``HVD_TPU_COMPRESSION`` knob) to the ``(QuantSpec, wire_dtype)`` pair
+  the schedules consume.
+
+Accumulation contract is inherited from ops/quantization.py: the wire
+dtype is never the accumulation dtype — every reduction runs in fp32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, NamedTuple, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import quantization as Q
+from .quantization import QuantSpec
+
+Axis = Union[str, Sequence[str]]
+
+
+def _cfg():
+    from ..core.state import global_state
+    cfg = getattr(global_state, "config", None)
+    if cfg is not None:
+        return cfg
+    from ..core.config import Config
+    return Config.from_env()
+
+
+def axes_of(axis: Axis) -> Tuple[str, ...]:
+    """Normalize a mesh-axis argument to a tuple of axis names."""
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def axis_arg(axis: Axis):
+    """The value to hand ``lax`` collectives: a bare name for a single
+    axis, the tuple for a joint axis."""
+    axes = axes_of(axis)
+    return axes[0] if len(axes) == 1 else axes
+
+
+def resolve_wire(compression):
+    """``compression=`` (Compressor class, name, or None → session knob)
+    → ``(spec, wire_dtype)``.  Both None means the fp32 wire (no
+    compression); otherwise exactly one is set."""
+    from . import collective as C
+    comp = C._resolve_compression(compression)
+    if comp is None:
+        return None, None
+    if getattr(comp, "bits", None) is not None:
+        return comp.spec(), None
+    return None, comp.wire_dtype
+
+
+def choose_schedule(kind: str, nbytes: int) -> str:
+    """Flat vs hierarchical for one payload, PR 11 precedence: the
+    active probed/pinned dispatch table first, then the explicit
+    ``HVD_TPU_HIERARCHICAL_*`` pins, then the legacy booleans, else
+    flat.  Called at TRACE time — the choice is a static property of
+    the lowered program, like the native coordinator's stamp."""
+    from . import dispatch as D
+    table = D.active_table()
+    if table is not None and kind in D.KINDS:
+        return table.choose(kind, int(nbytes))
+    cfg = _cfg()
+    pin = getattr(cfg, f"hierarchical_{kind}_pin", None)
+    if pin is not None:
+        return "hier" if pin else "flat"
+    return "hier" if getattr(cfg, f"hierarchical_{kind}", False) else "flat"
+
+
+# ---------------------------------------------------------------------------
+# scheduled collectives (inside jit/shard_map over named mesh axes)
+# ---------------------------------------------------------------------------
+
+def allreduce_scheduled(x, op: int, axis: Axis,
+                        spec: Optional[QuantSpec] = None,
+                        wire_dtype=None,
+                        prescale: float = 1.0, postscale: float = 1.0):
+    """Compressed allreduce over ``axis`` with trace-time schedule
+    selection.  ``axis`` may be a single mesh axis name or a
+    ``(local, cross)`` tuple; with a tuple and a "hier" table verdict
+    for this payload the two-level ``compressed_allreduce_hierarchical``
+    schedule runs (cross bytes shrink by local-size × wire-format),
+    otherwise the flat two-pass schedule over the joint axis.  The fp32
+    wire (both ``spec`` and ``wire_dtype`` None) lowers to a plain psum
+    — XLA's own schedule."""
+    axes = axes_of(axis)
+    if spec is None and wire_dtype is None:
+        from jax import lax
+
+        from . import collective as C
+        if op not in (C.Sum, C.Average):
+            raise ValueError("allreduce_scheduled supports Sum/Average")
+        y = x * prescale if prescale != 1.0 else x
+        acc = lax.psum(y, axis_arg(axes))
+        if op == C.Average:
+            acc = acc / Q._axis_size(axis_arg(axes))
+        return (acc * postscale if postscale != 1.0 else acc).astype(x.dtype)
+    if len(axes) == 2 and \
+            choose_schedule("allreduce", 4 * x.size) == "hier":
+        return Q.compressed_allreduce_hierarchical(
+            x, axes[0], axes[1], op, spec=spec, wire_dtype=wire_dtype,
+            prescale=prescale, postscale=postscale)
+    return Q.compressed_allreduce(x, axis_arg(axes), op, spec=spec,
+                                  wire_dtype=wire_dtype,
+                                  prescale=prescale, postscale=postscale)
+
+
+def reducescatter_scheduled(x, op: int, axis: Axis,
+                            spec: Optional[QuantSpec] = None,
+                            wire_dtype=None):
+    """Compressed reduce-scatter over ``axis`` (name or tuple — the
+    tuple runs the flat first-pass schedule over the joint axis; a
+    reduce-scatter's single pass has no cross-phase to restructure)."""
+    if spec is None and wire_dtype is None:
+        from jax import lax
+
+        from . import collective as C
+        if op not in (C.Sum, C.Average):
+            raise ValueError("reducescatter_scheduled supports Sum/Average")
+        acc = lax.psum_scatter(x, axis_arg(axes_of(axis)),
+                               scatter_dimension=0, tiled=True)
+        if op == C.Average:
+            acc = acc / Q._axis_size(axis_arg(axes_of(axis)))
+        return acc.astype(x.dtype)
+    return Q.compressed_reducescatter(x, axis_arg(axes_of(axis)), op,
+                                      spec=spec, wire_dtype=wire_dtype)
+
+
+def allgather_scheduled(x, axis: Axis,
+                        spec: Optional[QuantSpec] = None,
+                        wire_dtype=None):
+    """Compressed all-gather over ``axis`` with trace-time schedule
+    selection.  The table keys on the FULL gathered payload (the
+    coordinator's convention).  With a tuple axis and a "hier" verdict
+    the payload is compressed once and gathered cross-first so only
+    1/local-size of the bytes cross the outer axis; flat gathers once
+    over the joint axis.  NOTE a gather has no error-feedback channel —
+    quantization loss lands on the consumer (callers opt in, see
+    ``HVD_TPU_ZERO_QUANT_GATHER``)."""
+    axes = axes_of(axis)
+    if spec is None and wire_dtype is None:
+        from jax import lax
+        return lax.all_gather(x, axis_arg(axes), tiled=True)
+    world = Q._axis_size(axis_arg(axes))
+    nested = len(axes) == 2 and \
+        choose_schedule("allgather", 4 * x.size * world) == "hier"
+    return Q.compressed_allgather(x, axis_arg(axes), spec=spec,
+                                  wire_dtype=wire_dtype, nested=nested)
+
+
+def all_to_all_wire(v, axis_name: str, quant: Optional[QuantSpec]):
+    """Exchange rows of ``v`` (leading dim = mesh axis size) over
+    ``axis_name``, optionally on the block-scaled quantized wire — the
+    MoE dispatch/combine primitive, jit-traceable.
+
+    Each destination's chunk ``v[p]`` is quantized independently so the
+    receiver can dequantize without cross-rank metadata: the int8/int4
+    payload and the fp32 per-block scales travel as two all_to_alls —
+    exactly the EQuARX first-pass wire.  Output is fp32.
+    """
+    import jax
+    from jax import lax
+    if quant is None:
+        return lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    row_elems = int(v[0].size)
+    row_shape = v.shape[1:]
+    q, s = jax.vmap(lambda row: Q.quantize(row, quant))(v)
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                       tiled=False)
+    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                       tiled=False)
+    return jax.vmap(lambda qi, si: Q.dequantize(qi, si, quant, row_elems,
+                                                row_shape, jnp_f32()))(q, s)
+
+
+def jnp_f32():
+    import jax.numpy as jnp
+    return jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# analytic wire accounting (static shapes — the traced schedule, priced)
+# ---------------------------------------------------------------------------
+
+def wire_bytes_of(n: int, spec: Optional[QuantSpec] = None,
+                  wire_dtype=None) -> int:
+    """Bytes ``n`` fp32 elements occupy in the selected wire format
+    (block padding ignored, like :func:`Q.wire_bytes`)."""
+    if spec is not None:
+        return Q.wire_bytes(n, spec)
+    if wire_dtype is not None:
+        return n * int(np.dtype(wire_dtype).itemsize)
+    return 4 * n
+
+
+def allreduce_wire_bytes(n: int, spec: Optional[QuantSpec] = None,
+                         wire_dtype=None) -> Tuple[int, int]:
+    """Per-rank ``(raw, sent)`` bytes for one flat two-pass allreduce of
+    ``n`` elements: both passes move the payload, so raw is ``2 × 4n``
+    and sent is ``2 ×`` the wire format."""
+    return 2 * 4 * n, 2 * wire_bytes_of(n, spec, wire_dtype)
+
+
+def reducescatter_wire_bytes(n: int, spec: Optional[QuantSpec] = None,
+                             wire_dtype=None) -> Tuple[int, int]:
+    """Per-rank ``(raw, sent)`` for one reduce-scatter (first pass only)."""
+    return 4 * n, wire_bytes_of(n, spec, wire_dtype)
+
+
+def allgather_wire_bytes(n: int, spec: Optional[QuantSpec] = None,
+                         wire_dtype=None) -> Tuple[int, int]:
+    """Per-rank ``(raw, sent)`` for one all-gather of ``n`` local
+    elements (the compressed gather compresses once, gathers once)."""
+    return 4 * n, wire_bytes_of(n, spec, wire_dtype)
+
+
+def hierarchical_allreduce_wire_bytes(n: int, local_size: int,
+                                      cross_size: int,
+                                      spec: Optional[QuantSpec] = None,
+                                      wire_dtype=None) -> dict:
+    """Byte accounting for one hierarchical allreduce of ``n`` elements
+    over a (local, cross) = (L, C) axis pair — the exact arithmetic of
+    ``Q.compressed_allreduce_hierarchical``:
+
+    * phase 1 (local reduce-scatter): ``wire(n_pad)`` intra-node;
+    * phase 2 (cross two-pass allreduce of the 1/L shard):
+      ``2 × wire(shard)`` CROSS-node — the only bytes that leave the
+      node, shrunk by local-size × wire-format vs the flat fp32 cross
+      cost of ``2 × 4n``;
+    * phase 3 (local all-gather): ``wire(n_pad)`` intra-node.
+
+    Returns ``{"raw", "sent", "local", "cross", "cross_flat"}`` where
+    ``cross_flat`` is what the FLAT schedule of the same wire format
+    would push across nodes (``2 × wire(n_pad)``) — the golden-tested
+    local-size reduction is ``cross_flat / cross ≈ L``."""
+    block = spec.block if spec is not None else 1
+    npad = n + (-n) % (local_size * block)
+    shard = npad // local_size
+    spad = shard + (-shard) % (cross_size * block)
+    local_b = 2 * wire_bytes_of(npad, spec, wire_dtype)
+    cross_b = 2 * wire_bytes_of(spad, spec, wire_dtype)
+    return {
+        "raw": 2 * 4 * n,
+        "local": local_b,
+        "cross": cross_b,
+        "sent": local_b + cross_b,
+        "cross_flat": 2 * wire_bytes_of(npad, spec, wire_dtype),
+    }
+
+
+class StepWireBytes(NamedTuple):
+    """Per-rank analytic bytes one compiled step puts on the wire."""
+    raw: int
+    sent: int
+
+
+def plan_allreduce_step(sizes: Sequence[int], local_size: int = 1,
+                        cross_size: int = 1,
+                        spec: Optional[QuantSpec] = None,
+                        wire_dtype=None) -> StepWireBytes:
+    """Price one step's gradient allreduces: per-leaf, apply the SAME
+    per-payload schedule selection the trace applied (hier only when a
+    real (local, cross) split exists) and sum the per-rank bytes.
+    Computed once per treedef at compile time, recorded per step call
+    by :func:`record_wire_bytes`."""
+    raw = sent = 0
+    hier_avail = local_size > 1 and cross_size > 1
+    for n in sizes:
+        n = int(n)
+        r, s = allreduce_wire_bytes(n, spec, wire_dtype)
+        if (spec is not None or wire_dtype is not None) and hier_avail \
+                and choose_schedule("allreduce", 4 * n) == "hier":
+            s = hierarchical_allreduce_wire_bytes(
+                n, local_size, cross_size, spec, wire_dtype)["sent"]
+        raw += r
+        sent += s
+    return StepWireBytes(raw=raw, sent=sent)
+
+
+def record_wire_bytes(raw: int, sent: int, kind: str = "gspmd") -> None:
+    """Feed the wire-byte counters for one compiled step (analytic
+    accounting — the compiled plane has no per-op host hook, so the
+    host-level step wrapper calls this once per step with the traced
+    schedule's priced bytes)."""
+    if raw <= 0 or sent <= 0:
+        return
+    from . import collective as C
+    _ops, _bts, _lat, raw_c, sent_c, ratio_g = C._collective_metrics(kind)
+    raw_c.inc(raw)
+    sent_c.inc(sent)
+    ratio_g.set(raw / sent)

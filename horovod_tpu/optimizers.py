@@ -228,6 +228,16 @@ class _ZeroState(NamedTuple):
     sizes: Any                # params-structured true flat sizes (static at
                               # init; the checkpoint engine reads them to
                               # reshard moments across world-size changes)
+    # Error-feedback residual for quantized gradient wires (None
+    # otherwise): params-structured FLAT fp32 leaves, one element per
+    # true param element — this rank's quantization error of the last
+    # communicated gradient, added back before the next communicate
+    # (same EF lineage as _AggState.residual).  Rank-distinct, so it
+    # rides the sharded checkpoint engine with the rest of the state
+    # (checkpoint/zero.py plans it alongside the moment shards).
+    # Defaults to None: states, checkpoints and fingerprints from
+    # uncompressed runs are bit-identical to the pre-residual layout.
+    residual: Any = None
 
 
 def _is_zero_param_state(x) -> bool:
@@ -267,7 +277,8 @@ class ZeroGradientTransformation(NamedTuple):
 def ZeroShardedOptimizer(optimizer, op: int = C.Average,
                          axis_name: Optional[str] = None,
                          compression=None, overlap=None,
-                         stage: Optional[int] = None):
+                         stage: Optional[int] = None,
+                         quantize_gather: Optional[bool] = None):
     """ZeRO weight-update sharding over the data-parallel axis — a
     TPU-native capability beyond the reference (Horovod replicates
     optimizer state on every rank; here each dp rank owns 1/N of it,
@@ -308,10 +319,25 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     gradient reduce-scatter through the quantized/cast one-pass schedule
     (``ops.quantization.compressed_reducescatter``): contributions move
     compressed, accumulation is fp32, and the optimizer sees a
-    full-precision gradient shard.  The all_gathers (update shards at
-    stage <= 2, parameter shards at stage 3) stay full-precision —
-    their consumers have no error-feedback channel to absorb
-    quantization loss.
+    full-precision gradient shard.  With a quantized wire the state
+    carries an error-feedback residual (``_ZeroState.residual``, flat
+    fp32 per param): at stage 1 — and at stages 2/3 when ``update``
+    receives FULL local gradients — the residual is added back before
+    the reduce and refreshed with the new quantization error, the same
+    EF story as ``DistributedOptimizer``.  Stage-2/3 gradients that
+    arrive as shards (the ``gather_in_forward`` VJP path) were reduced
+    inside the backward where no residual can thread; they ride the
+    quantized wire EF-less, as before.
+
+    The all_gathers (update shards at stage <= 2, parameter shards at
+    stage 3) stay full-precision by default — a gather has no
+    error-feedback channel, so its quantization loss lands directly on
+    the consumer.  ``quantize_gather=True`` (or the
+    ``HVD_TPU_ZERO_QUANT_GATHER`` knob) opts the stage-3 parameter
+    gather onto the quantized wire anyway: params are quantized once,
+    gathered, dequantized once — a lossy-but-bounded approximation
+    whose error does NOT accumulate across steps (the master copy
+    stays full-precision in the shards).
 
     ``overlap`` (same semantics as ``DistributedOptimizer``) buckets the
     gradient reduce-scatter and the stage-3 parameter gather: one wire
@@ -331,6 +357,21 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
     stage = int(stage)
     if stage not in (1, 2, 3):
         raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
+
+    # Error feedback pairs with lossy-quantized wires on a reduced
+    # gradient (same gate as DistributedOptimizer): cast wires round-trip
+    # through fp32 accumulation and need no residual.
+    quant_spec = None
+    if getattr(compression, "bits", None) is not None and \
+            op in (C.Average, C.Sum):
+        quant_spec = compression.spec()
+
+    def _resolve_qgather() -> bool:
+        if quantize_gather is not None:
+            return bool(quantize_gather)
+        from .core.state import global_state
+        cfg = getattr(global_state, "config", None)
+        return bool(getattr(cfg, "zero_quant_gather", False))
 
     def _pad_flat(x, world):
         flat = x.reshape(-1)
@@ -378,19 +419,29 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
                 compression=(compression if C._compressible(g, op)
                              else None)), grads)
 
+    def _zero_residual(sizes):
+        # Flat fp32, one element per TRUE param element: the leaf is the
+        # quantization error of this rank's full local gradient, raveled.
+        if quant_spec is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda n: jnp.zeros((int(n),), jnp.float32), sizes)
+
     def init_fn(params):
         # At stage 3 ``params`` may already be the sharded state
         # (shard_params output) — init the moments straight on its
         # shards; full params work at any stage.
         if _is_zero_param_state(params):
             return _ZeroState(inner=optimizer.init(params.inner),
-                              sizes=params.sizes)
+                              sizes=params.sizes,
+                              residual=_zero_residual(params.sizes))
         shards = _shard_tree(params)
         # True (unpadded) flat sizes are static shape facts, recorded in
         # the state so the checkpoint engine can reshard the moments
         # when a restore lands on a different world size.
         sizes = jax.tree_util.tree_map(lambda p: p.size, params)
-        return _ZeroState(inner=optimizer.init(shards), sizes=sizes)
+        return _ZeroState(inner=optimizer.init(shards), sizes=sizes,
+                          residual=_zero_residual(sizes))
 
     def shard_params_fn(params):
         """Params → their sharded residency: a ``_ZeroState`` whose
@@ -414,7 +465,7 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
             shards, like, op=op, axis_name=ax, compression=compression,
             bucket_bytes=_overlap.resolve_bucket_bytes(overlap,
                                                        compiled=True),
-            prefetch=prefetch)
+            prefetch=prefetch, quantize_gather=_resolve_qgather())
 
     def apply_updates_fn(pstate, updates):
         """Apply update shards to a sharded param state (params never
@@ -425,16 +476,61 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
             return pstate._replace(inner=new)
         return new
 
+    def _with_feedback(grads, residual):
+        """(grads + residual, new residual) over FULL local gradients —
+        the EF-corrected communicate input and the flat quantization
+        error it will leave behind.  The flat per-leaf qdq is the exact
+        first-pass error of the flat-padded wire and a convergence-grade
+        approximation of the per-row-padded reduce-scatter grids (same
+        approximation DistributedOptimizer's bucketed wire uses)."""
+        from .ops.quantization import qdq
+        fed = jax.tree_util.tree_map(
+            lambda g, r: g + r.reshape(g.shape).astype(g.dtype),
+            grads, residual)
+        new_residual = jax.tree_util.tree_map(
+            lambda f: (f.astype(jnp.float32)
+                       - qdq(f.astype(jnp.float32), quant_spec)
+                       ).reshape(-1), fed)
+        return fed, new_residual
+
+    def _grads_are_full(grads, sizes) -> bool:
+        """Distinguish full local gradients from flat per-rank shards
+        (the stage-2/3 EF path accepts either).  Any non-1-D leaf is
+        full; an all-1-D tree is full iff every leaf has its TRUE size
+        (a shard is the padded size / world, which only collides with
+        the true size at world == 1, where the two are identical)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        if any(getattr(l, "ndim", 1) != 1 for l in leaves):
+            return True
+        world = axis_size(ax)
+        if world == 1:
+            return False
+        szs = jax.tree_util.tree_leaves(sizes)
+        return len(leaves) == len(szs) and all(
+            int(l.size) == int(s) for l, s in zip(leaves, szs))
+
     def update_fn(grads, state: _ZeroState, params=None):
+        residual = getattr(state, "residual", None)
         if stage == 1:
+            if residual is not None:
+                grads, residual = _with_feedback(grads, residual)
             g_shards = reduce_grads_fn(grads)
             p_shards = None if params is None else _shard_tree(params)
         else:
-            # Stage 2/3 contract: gradients ARRIVE as shards — the full
-            # tree was consumed bucket-by-bucket inside the backward
-            # (gather_in_forward's VJP) or by an explicit reduce_grads,
-            # so no full-gradient object persists into the update.
-            _check_shards(grads, "gradients")
+            # Stage 2/3 contract: gradients normally ARRIVE as shards —
+            # the full tree was consumed bucket-by-bucket inside the
+            # backward (gather_in_forward's VJP) or by an explicit
+            # reduce_grads, so no full-gradient object persists into the
+            # update.  With a quantized wire, FULL local gradients are
+            # also accepted: that is the error-feedback path (the
+            # residual must correct the gradient BEFORE it is reduced,
+            # which a VJP-internal reduce-scatter cannot thread).
+            if residual is not None and _grads_are_full(grads,
+                                                        state.sizes):
+                grads, residual = _with_feedback(grads, residual)
+                grads = reduce_grads_fn(grads)
+            else:
+                _check_shards(grads, "gradients")
             g_shards = grads
             if params is None:
                 p_shards = None
@@ -448,7 +544,8 @@ def ZeroShardedOptimizer(optimizer, op: int = C.Average,
                 p_shards = _shard_tree(params)
         upd_shards, inner = optimizer.update(g_shards, state.inner,
                                              p_shards)
-        new_state = _ZeroState(inner=inner, sizes=state.sizes)
+        new_state = _ZeroState(inner=inner, sizes=state.sizes,
+                               residual=residual)
         if stage == 3:
             # Params stay sharded: return update shards for
             # apply_updates; the next forward's gather moves the fresh

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..compat import axis_size
-from ..ops.quantization import QuantSpec, dequantize, quantize, wire_bytes
+from ..ops.quantization import QuantSpec, wire_bytes
 
 
 class MoEParams(NamedTuple):
@@ -134,17 +134,12 @@ def _all_to_all_wire(v: jax.Array, axis_name: str,
     receiver can dequantize without cross-rank metadata: the int8/int4
     payload and the fp32 per-block scales travel as two all_to_alls —
     exactly the EQuARX first-pass wire.  Output is fp32.
+
+    The primitive lives in ops/xla_collectives.py (the compiled-plane
+    collective layer); this alias keeps the historical call site.
     """
-    if quant is None:
-        return lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0,
-                              tiled=False)
-    row_elems = int(v[0].size)
-    row_shape = v.shape[1:]
-    q, s = jax.vmap(lambda row: quantize(row, quant))(v)
-    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
-    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
-    return jax.vmap(lambda qi, si: dequantize(qi, si, quant, row_elems,
-                                              row_shape, jnp.float32))(q, s)
+    from ..ops import xla_collectives as XC
+    return XC.all_to_all_wire(v, axis_name, quant)
 
 
 def dispatch_wire_bytes(ep: int, n_local: int, capacity: int, d_model: int,

@@ -61,3 +61,16 @@ def load_factor(tag: str = "loadprobe") -> float:
         f"cpu probe {cpu_s:.2f}s vs {_NOMINAL_CPU_S}s nominal); "
         "harness deadlines scaled accordingly\n")
     return factor
+
+
+def oversubscription(procs: int) -> float:
+    """How much slower ``procs`` concurrently CPU-bound processes run
+    than one: pure core-count arithmetic, >= 1.  Orthogonal to
+    :func:`load_factor` — the probe measures how slow ONE task is under
+    external load, this measures the drill's own contention when it
+    spawns more workers than the box has cores (a 2-worker shm pair on
+    a 1-core sandbox runs at half speed on an otherwise idle machine,
+    and the probe correctly reads ~1.0 there)."""
+    import os
+    cores = os.cpu_count() or 1
+    return max(1.0, float(procs) / cores)

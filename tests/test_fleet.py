@@ -6,6 +6,7 @@ preempted job's post-resume state is bit-identical to an uninterrupted
 run of the same seeded schedule)."""
 
 import json
+import multiprocessing as mp
 import os
 import socket
 import sys
@@ -16,7 +17,19 @@ import time
 import numpy as np
 import pytest
 
+import _loadprobe
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The multiplex drill's wall clocks (worker pacing x epochs vs the
+# wait_job/_wait_for budgets) are sized for an idle machine; scale by
+# the measured load factor (tests/_loadprobe.py) so sandbox load
+# stretches drill and harness together.  Guarded: a spawn-context
+# child re-importing this module must not re-run the probe.
+if mp.current_process().name == "MainProcess":
+    _FACTOR = _loadprobe.load_factor("fleet")
+else:
+    _FACTOR = 1.0
 
 import horovod_tpu.fleet as fleet
 from horovod_tpu.fleet.job import JobSpec
@@ -823,7 +836,7 @@ def _write_worker(tmp_path, tag, seed, epochs, pace, mark=""):
     return script, log, final
 
 
-@pytest.mark.timeout(420)
+@pytest.mark.timeout(int(420 * _FACTOR))
 def test_fleet_multiplex_preemption_drill(tmp_path, monkeypatch):
     """Acceptance: two jobs on one 4-rank fleet.  A (low priority) takes
     all 4 slots; B (high priority) preempts via commit → shrink →
@@ -849,13 +862,14 @@ def test_fleet_multiplex_preemption_drill(tmp_path, monkeypatch):
         # Let A run wide and commit before the preemptor shows up.
         _wait_for(lambda: sum(1 for e in _read_logs(a_log, a_slots)
                               if e["size"] == 4) >= 4,
-                  120, "job A committing at the full 4-rank width")
+                  120 * _FACTOR, "job A committing at the full 4-rank "
+                  "width")
         b = fleet.submit_job(
             JobSpec(command=[sys.executable, str(b_script)], min_np=2,
                     max_np=2, priority=9, tenant="t2"), addr=addr)
-        b_rec = fleet.wait_job(b.id, addr=addr, timeout=180)
+        b_rec = fleet.wait_job(b.id, addr=addr, timeout=180 * _FACTOR)
         assert b_rec.state == fleet.DONE, b_rec.reason
-        a_rec = fleet.wait_job(a.id, addr=addr, timeout=180)
+        a_rec = fleet.wait_job(a.id, addr=addr, timeout=180 * _FACTOR)
         assert a_rec.state == fleet.DONE, a_rec.reason
         assert a_rec.preemptions >= 1
         assert a_rec.preempt_generation is not None
